@@ -1,0 +1,32 @@
+"""fedml_tpu — a TPU-native federated learning framework.
+
+A from-scratch rebuild of the capabilities of FedML (reference:
+Starry-Hu/FedML, a PyTorch + mpi4py federated-learning research library)
+designed TPU-first:
+
+- A federated round is a single jitted SPMD program: clients are shards on a
+  ``jax.sharding.Mesh`` axis, local training is a ``lax.scan`` over padded
+  batches, and server aggregation is a weighted ``psum`` over ICI — no message
+  passing, no host round-trips inside the round.
+- Standalone simulation (the reference's ``fedml_api/standalone``) batches all
+  sampled clients through ``jax.vmap`` instead of a sequential Python loop.
+- Cross-silo communication (the reference's MPI/gRPC/MQTT backends,
+  ``fedml_core/distributed/communication``) is re-founded on XLA collectives
+  intra-slice, with a thin host-side Message/RPC seam kept only for true
+  cross-trust-domain federation.
+
+Layer map (mirrors reference SURVEY §1):
+  core/        runtime kernel: pytrees, sampling, partitioning, topology,
+               robustness, checkpointing   (~ fedml_core)
+  trainer/     ModelTrainer protocol + Flax/Optax implementation
+               (~ fedml_core/trainer/model_trainer.py)
+  models/      flax model zoo              (~ fedml_api/model)
+  data/        federated dataset contract + loaders (~ fedml_api/data_preprocessing)
+  algorithms/  FedAvg, FedOpt, FedNova, robust, hierarchical, decentralized,
+               split/vertical/GKT/NAS/secure-agg (~ fedml_api/{standalone,distributed})
+  parallel/    mesh builders + SPMD round programs (replaces MPI rank dispatch)
+  comm/        cross-silo message layer    (~ fedml_core/distributed/communication)
+  experiments/ CLI entry points            (~ fedml_experiments)
+"""
+
+__version__ = "0.1.0"

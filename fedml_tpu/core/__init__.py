@@ -1,0 +1,20 @@
+"""Core runtime kernel: the TPU-native re-expression of ``fedml_core``."""
+
+from fedml_tpu.core import pytree
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.partition import (
+    non_iid_partition_with_dirichlet_distribution,
+    partition_class_samples_with_dirichlet_distribution,
+    record_data_stats,
+)
+from fedml_tpu.core.topology import (
+    BaseTopologyManager,
+    SymmetricTopologyManager,
+    AsymmetricTopologyManager,
+)
+from fedml_tpu.core.robust import (
+    vectorize_weights,
+    norm_diff_clipping,
+    add_weak_dp_noise,
+    is_weight_param,
+)

@@ -1,0 +1,130 @@
+"""Parameter-pytree algebra.
+
+In the reference, model state travels as a torch ``state_dict`` and server
+aggregation is a Python loop over its keys (reference:
+fedml_api/distributed/fedavg/FedAVGAggregator.py:58-87). Here model state is a
+JAX pytree and every aggregation rule is a pure, jittable function over
+pytrees, so it can run inside the compiled round program (vmapped in
+simulation, psum-ed on a mesh) instead of on the host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a, b):
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(tree, s):
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def tree_axpy(a, x, y):
+    """a * x + y, elementwise over matching pytrees."""
+    return jax.tree.map(lambda xi, yi: a * xi + yi, x, y)
+
+
+def tree_dot(a, b):
+    """Sum of elementwise products across the whole pytree (a scalar)."""
+    leaves = jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b)
+    return jax.tree.reduce(jnp.add, leaves)
+
+
+def tree_norm(tree):
+    """Global L2 norm over all leaves."""
+    return jnp.sqrt(tree_dot(tree, tree))
+
+
+def tree_weighted_mean(stacked, weights):
+    """Weighted mean over the leading axis of every leaf.
+
+    ``stacked`` is a pytree whose leaves have a leading ``num_clients`` axis
+    (the result of vmapping local training); ``weights`` is ``[num_clients]``.
+    Normalizes by ``weights.sum()`` — the sample-weighted average FedAvg rule
+    (reference: FedAVGAggregator.py:72-80, standalone fedavg_api.py:123-141).
+    """
+    total = jnp.sum(weights)
+
+    def leaf_mean(x):
+        w = weights.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        return jnp.sum(x * w, axis=0) / total.astype(x.dtype)
+
+    return jax.tree.map(leaf_mean, stacked)
+
+
+def tree_mean(stacked):
+    """Unweighted mean over the leading axis of every leaf."""
+    return jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked)
+
+
+def tree_stack(trees):
+    """Stack a list of congruent pytrees along a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_unstack(stacked, n):
+    """Inverse of tree_stack: a list of n pytrees."""
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def tree_index(stacked, i):
+    """Slice client ``i`` out of a stacked pytree."""
+    return jax.tree.map(lambda x: x[i], stacked)
+
+
+def tree_cast(tree, dtype):
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_size(tree):
+    """Total number of scalars in the pytree."""
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def tree_bytes(tree):
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def tree_ravel(tree):
+    """Flatten every leaf into one 1-D vector (like torch cat of flattened
+    params; reference robust_aggregation.py:4-10 ``vectorize_weight``)."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.concatenate([jnp.ravel(x) for x in leaves]) if leaves else jnp.zeros((0,))
+
+
+def tree_unravel(tree_like, flat):
+    """Inverse of tree_ravel given a template pytree."""
+    leaves, treedef = jax.tree.flatten(tree_like)
+    out, off = [], 0
+    for leaf in leaves:
+        n = leaf.size
+        out.append(flat[off : off + n].reshape(leaf.shape).astype(leaf.dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+def tree_map_with_path_filter(fn, tree, predicate):
+    """Apply ``fn`` only to leaves whose key-path satisfies ``predicate``;
+    other leaves pass through unchanged.
+
+    Used to implement the reference's weight-param filter that excludes BN
+    running statistics from clipping/noise (robust_aggregation.py:28-36).
+    ``predicate`` receives the joined string path of the leaf.
+    """
+
+    def apply(path, leaf):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        return fn(leaf) if predicate(name) else leaf
+
+    return jax.tree_util.tree_map_with_path(apply, tree)

@@ -1,0 +1,45 @@
+"""Client sampling with exact RNG parity to the reference.
+
+The reference seeds numpy with the round index before each draw so that any
+two implementations select the same clients every round (reference:
+fedml_api/distributed/fedavg/FedAVGAggregator.py:89-97 and
+fedml_api/standalone/fedavg/fedavg_api.py:96-114). We preserve that contract
+bit-for-bit — it is the hook all cross-implementation parity tests hang on.
+
+Sampling happens on the host (it is O(clients) integer work per round); the
+resulting index vector is what gets fed to the device gather that re-points
+each mesh core at its sampled client's shard (client virtualization, see
+reference FedAVGTrainer.update_dataset semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def sample_clients(
+    round_idx: int,
+    client_num_in_total: int,
+    client_num_per_round: int,
+    delete_client: Optional[int] = None,
+) -> np.ndarray:
+    """Sample the participating client indices for one round.
+
+    Full participation (``per_round == total``) returns ``[0..total)`` in
+    order with no RNG draw. Otherwise numpy is seeded with ``round_idx`` and
+    ``min(per_round, total)`` clients are drawn without replacement.
+    ``delete_client`` (leave-one-out contribution measurement, reference
+    fedml_api/contribution/horizontal/fedavg_api.py) removes one client from
+    the candidate pool before drawing.
+    """
+    if client_num_in_total == client_num_per_round and delete_client is None:
+        return np.arange(client_num_in_total)
+    num_clients = min(client_num_per_round, client_num_in_total)
+    np.random.seed(round_idx)
+    candidates: Sequence[int] = range(client_num_in_total)
+    if delete_client is not None:
+        candidates = [c for c in range(client_num_in_total) if c != delete_client]
+        num_clients = min(num_clients, len(candidates))
+    return np.random.choice(candidates, num_clients, replace=False)

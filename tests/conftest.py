@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the reference's trick of simulating a cluster on one box
+(`hostname > mpi_host_file; mpirun -np N` — run_fedavg_distributed_pytorch.sh)
+with JAX's host-platform device multiplexing: all mesh/SPMD tests run against
+8 virtual CPU devices, the same code path the driver validates via
+`dryrun_multichip` and production runs over real TPU ICI.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# parity/equivalence tests need f32 math, not TPU-default bf16 matmuls
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    return jax.devices()

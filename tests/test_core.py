@@ -1,0 +1,233 @@
+"""Unit tests for the core runtime kernel (pytree algebra, sampling parity,
+Dirichlet partition, topology, robust defenses) against numpy oracles —
+the unit layer of the test pyramid SURVEY §4 calls for."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.core import robust
+from fedml_tpu.core.partition import (
+    non_iid_partition_with_dirichlet_distribution,
+    partition_data,
+    record_data_stats,
+)
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.topology import (
+    AsymmetricTopologyManager,
+    SymmetricTopologyManager,
+    ring_lattice_adjacency,
+)
+
+
+def make_tree(key, scale=1.0):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "dense": {"kernel": scale * jax.random.normal(k1, (4, 3)),
+                  "bias": scale * jax.random.normal(k2, (3,))},
+        "out": scale * jax.random.normal(k3, (3, 2)),
+    }
+
+
+class TestPytree:
+    def test_weighted_mean_matches_numpy(self):
+        trees = [make_tree(jax.random.key(i)) for i in range(4)]
+        weights = jnp.array([1.0, 2.0, 3.0, 4.0])
+        stacked = pt.tree_stack(trees)
+        avg = pt.tree_weighted_mean(stacked, weights)
+        w = np.array(weights)
+        for leaf_path in [("dense", "kernel"), ("dense", "bias"), ("out",)]:
+            got = avg
+            for p in leaf_path:
+                got = got[p]
+            ref = sum(
+                w[i] * np.asarray(jax.tree.leaves(trees[i])[0] if False else _get(trees[i], leaf_path))
+                for i in range(4)
+            ) / w.sum()
+            np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-6)
+
+    def test_ravel_unravel_roundtrip(self):
+        tree = make_tree(jax.random.key(0))
+        flat = pt.tree_ravel(tree)
+        assert flat.shape == (4 * 3 + 3 + 3 * 2,)
+        back = pt.tree_unravel(tree, flat)
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_norm_and_dot(self):
+        tree = make_tree(jax.random.key(1))
+        flat = np.asarray(pt.tree_ravel(tree))
+        np.testing.assert_allclose(float(pt.tree_norm(tree)), np.linalg.norm(flat), rtol=1e-6)
+        np.testing.assert_allclose(float(pt.tree_dot(tree, tree)), flat @ flat, rtol=1e-6)
+
+    def test_stack_unstack(self):
+        trees = [make_tree(jax.random.key(i)) for i in range(3)]
+        back = pt.tree_unstack(pt.tree_stack(trees), 3)
+        for t, b in zip(trees, back):
+            for a, c in zip(jax.tree.leaves(t), jax.tree.leaves(b)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def _get(tree, path):
+    for p in path:
+        tree = tree[p]
+    return np.asarray(tree)
+
+
+class TestSampling:
+    def test_full_participation_no_rng(self):
+        np.testing.assert_array_equal(sample_clients(7, 5, 5), np.arange(5))
+
+    def test_parity_with_reference_rng_protocol(self):
+        # the reference seeds np.random with round_idx then draws choice
+        # without replacement — byte-for-byte reproduction
+        for round_idx in [0, 1, 42]:
+            got = sample_clients(round_idx, 100, 10)
+            np.random.seed(round_idx)
+            want = np.random.choice(range(100), 10, replace=False)
+            np.testing.assert_array_equal(got, want)
+
+    def test_per_round_determinism_and_variation(self):
+        a = sample_clients(3, 1000, 10)
+        b = sample_clients(3, 1000, 10)
+        c = sample_clients(4, 1000, 10)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_delete_client_excluded(self):
+        for r in range(5):
+            got = sample_clients(r, 20, 10, delete_client=7)
+            assert 7 not in got
+            assert len(got) == 10
+
+
+class TestPartition:
+    def test_dirichlet_partition_properties(self):
+        np.random.seed(0)
+        labels = np.random.randint(0, 10, size=2000)
+        mapping = non_iid_partition_with_dirichlet_distribution(labels, 8, 10, 0.5)
+        all_idx = np.sort(np.concatenate([mapping[i] for i in range(8)]))
+        np.testing.assert_array_equal(all_idx, np.arange(2000))  # exact cover
+        assert min(len(mapping[i]) for i in range(8)) >= 10  # min-10 invariant
+
+    def test_dirichlet_heterogeneity_increases_with_small_alpha(self):
+        np.random.seed(0)
+        labels = np.random.randint(0, 10, size=5000)
+        skewed = non_iid_partition_with_dirichlet_distribution(labels, 5, 10, 0.05)
+        np.random.seed(0)
+        uniform = non_iid_partition_with_dirichlet_distribution(labels, 5, 10, 100.0)
+
+        def class_entropy(mapping):
+            ents = []
+            for i in mapping:
+                _, cnt = np.unique(labels[np.asarray(mapping[i])], return_counts=True)
+                p = cnt / cnt.sum()
+                ents.append(-(p * np.log(p)).sum())
+            return np.mean(ents)
+
+        assert class_entropy(skewed) < class_entropy(uniform)
+
+    def test_homo_partition_even_cover(self):
+        np.random.seed(0)
+        labels = np.zeros(1003)
+        mapping = partition_data(labels, "homo", 4)
+        sizes = sorted(len(v) for v in mapping.values())
+        assert sizes == [250, 251, 251, 251]
+        all_idx = np.sort(np.concatenate(list(mapping.values())))
+        np.testing.assert_array_equal(all_idx, np.arange(1003))
+
+    def test_record_data_stats(self):
+        labels = np.array([0, 0, 1, 2, 2, 2])
+        stats = record_data_stats(labels, {0: [0, 1, 2], 1: [3, 4, 5]})
+        assert stats == {0: {0: 2, 1: 1}, 1: {2: 3}}
+
+    def test_segmentation_partition(self):
+        np.random.seed(0)
+        # ragged multi-label instances
+        labels = [np.random.choice(5, size=np.random.randint(1, 4), replace=False)
+                  for _ in range(300)]
+        mapping = non_iid_partition_with_dirichlet_distribution(
+            labels, 4, list(range(5)), 0.5, task="segmentation"
+        )
+        covered = sorted(i for v in mapping.values() for i in v)
+        assert covered == sorted(set(covered))  # no duplicates
+
+
+class TestTopology:
+    def test_ring_lattice_matches_definition(self):
+        adj = ring_lattice_adjacency(6, 2)
+        for i in range(6):
+            assert adj[i, (i + 1) % 6] == 1 and adj[i, (i - 1) % 6] == 1
+        assert adj.sum() == 12
+
+    def test_symmetric_topology_row_stochastic(self):
+        mgr = SymmetricTopologyManager(8, 4)
+        W = mgr.generate_topology()
+        np.testing.assert_allclose(W.sum(axis=1), np.ones(8), rtol=1e-6)
+        np.testing.assert_array_equal((W > 0), (W.T > 0))  # symmetric support
+        assert all(np.diag(W) > 0)
+
+    def test_symmetric_neighbor_queries(self):
+        mgr = SymmetricTopologyManager(6, 2)
+        mgr.generate_topology()
+        out = mgr.get_out_neighbor_idx_list(1)
+        assert out == [0, 2]
+        assert mgr.get_in_neighbor_idx_list(1) == out
+
+    def test_asymmetric_topology_row_stochastic(self):
+        np.random.seed(0)
+        mgr = AsymmetricTopologyManager(8, 4, 3)
+        W = mgr.generate_topology()
+        np.testing.assert_allclose(W.sum(axis=1), np.ones(8), rtol=1e-6)
+
+    def test_gossip_mixing_preserves_average(self):
+        # doubly-stochastic-ish: symmetric W preserves the mean parameter
+        mgr = SymmetricTopologyManager(8, 2)
+        W = mgr.generate_topology()
+        x = np.random.RandomState(0).randn(8, 5)
+        mixed = W @ x
+        # ring with equal degrees -> doubly stochastic -> average preserved
+        np.testing.assert_allclose(mixed.mean(0), x.mean(0), rtol=1e-5)
+
+
+class TestRobust:
+    def test_is_weight_param_filter(self):
+        assert robust.is_weight_param("dense/kernel")
+        assert not robust.is_weight_param("batch_stats/conv/mean")
+        assert not robust.is_weight_param("bn/running_mean")
+
+    def test_clipping_inside_bound_is_identity(self):
+        g = make_tree(jax.random.key(0))
+        local = pt.tree_axpy(1e-3, make_tree(jax.random.key(1)), g)
+        clipped = robust.norm_diff_clipping(local, g, norm_bound=10.0)
+        for a, b in zip(jax.tree.leaves(clipped), jax.tree.leaves(local)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_clipping_scales_to_bound(self):
+        g = make_tree(jax.random.key(0))
+        local = pt.tree_axpy(100.0, make_tree(jax.random.key(1)), g)
+        bound = 1.0
+        clipped = robust.norm_diff_clipping(local, g, norm_bound=bound)
+        diff_norm = float(pt.tree_norm(pt.tree_sub(clipped, g)))
+        np.testing.assert_allclose(diff_norm, bound, rtol=1e-4)
+
+    def test_noise_statistics_and_bn_exclusion(self):
+        params = {
+            "kernel": jnp.zeros((200, 200)),
+            "batch_stats": {"mean": jnp.zeros((50,))},
+        }
+        noised = robust.add_weak_dp_noise(params, stddev=0.1, key=jax.random.key(0))
+        assert float(jnp.std(noised["kernel"])) == pytest.approx(0.1, rel=0.05)
+        np.testing.assert_array_equal(np.asarray(noised["batch_stats"]["mean"]), 0.0)
+
+    def test_defense_dispatch(self):
+        g = make_tree(jax.random.key(0))
+        local = make_tree(jax.random.key(1), scale=100.0)
+        out = robust.apply_defense(local, g, "weak_dp", 1.0, 0.01, jax.random.key(2))
+        assert float(pt.tree_norm(pt.tree_sub(out, g))) < 2.0
+        ident = robust.apply_defense(local, g, None, 1.0, 0.01, jax.random.key(2))
+        assert ident is local
+        with pytest.raises(ValueError):
+            robust.apply_defense(local, g, "bogus", 1.0, 0.01, jax.random.key(2))
