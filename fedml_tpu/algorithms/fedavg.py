@@ -1,0 +1,152 @@
+"""FedAvg — the flagship algorithm, as one compiled round program.
+
+Reference semantics (kept exactly): per-round seeded client sampling
+(FedAVGAggregator.py:89-97), local SGD from the current global model
+(FedAVGTrainer/MyModelTrainer), sample-weighted averaging of the full model
+state (FedAVGAggregator.py:58-87), periodic evaluation over the federation
+(fedavg_api.py:142-207).
+
+TPU-first re-design (SURVEY §7): the reference runs clients as MPI processes
+(distributed) or a sequential Python loop (standalone). Here one round =
+
+    vmap over sampled clients ( local_train: lax.scan over epochs x batches )
+    -> tree_weighted_mean over the client axis
+
+compiled once; the same round body runs under ``shard_map`` on a device mesh
+for the distributed path (fedml_tpu/parallel/spmd.py), where the weighted
+mean lowers to a ``psum`` over ICI. Client heterogeneity (ragged LEAF sizes)
+is handled by pad-and-mask packing (data/base.py), client virtualization
+(total clients >> per-round slots) by re-pointing each slot at its sampled
+client's shard every round — the same trick as the reference's
+``update_dataset`` (FedAVGTrainer.py:25-30).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
+                                          make_local_train)
+def _normalized(stats, prefix: str) -> Dict[str, float]:
+    """Stat sums -> {prefix}_{acc,loss,total} means (+precision/recall)."""
+    total = max(1.0, float(stats["count"]))
+    out = {
+        f"{prefix}_acc": float(stats["correct_sum"]) / total,
+        f"{prefix}_loss": float(stats["loss_sum"]) / total,
+        f"{prefix}_total": float(stats["count"]),
+    }
+    if "precision_sum" in stats:
+        out[f"{prefix}_precision"] = float(stats["precision_sum"]) / total
+        out[f"{prefix}_recall"] = float(stats["recall_sum"]) / total
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class FedAvgConfig:
+    """Round-level knobs (reference argparse: --comm_round
+    --client_num_in_total --client_num_per_round --frequency_of_the_test)."""
+
+    comm_round: int = 10
+    client_num_per_round: int = 10
+    frequency_of_the_test: int = 5
+    seed: int = 0
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+
+
+class FedAvgAPI:
+    """Standalone simulation API (parity:
+    fedml_api/standalone/fedavg/fedavg_api.py), all clients vmapped."""
+
+    def __init__(self, dataset: FederatedDataset, module,
+                 task: str = "classification",
+                 config: Optional[FedAvgConfig] = None,
+                 delete_client: Optional[int] = None):
+        self.dataset = dataset
+        self.module = module
+        self.task = task
+        self.config = config or FedAvgConfig()
+        self.delete_client = delete_client
+        cfg = self.config.train
+
+        local_train = make_local_train(module, task, cfg)
+
+        def round_fn(variables, x, y, mask, keys, weights):
+            stacked, stats = jax.vmap(
+                local_train, in_axes=(None, 0, 0, 0, 0))(variables, x, y,
+                                                         mask, keys)
+            new_vars = pt.tree_weighted_mean(stacked, weights)
+            totals = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
+            return new_vars, totals
+
+        self._round_fn = jax.jit(round_fn)
+        self._eval_fn = jax.jit(make_eval(module, task))
+        self._n_pad = dataset.padded_len(cfg.batch_size)
+        self._base_key = jax.random.key(self.config.seed)
+
+        sample_x = dataset.train_data_global[0][:1]
+        self.variables = module.init(jax.random.key(self.config.seed),
+                                     jnp.asarray(sample_x), train=False)
+        self.history: List[Dict] = []
+
+    # -- one round ---------------------------------------------------------
+    def run_round(self, round_idx: int):
+        cfg = self.config
+        idxs = sample_clients(round_idx, self.dataset.client_num,
+                              cfg.client_num_per_round,
+                              delete_client=self.delete_client)
+        x, y, mask = self.dataset.pack_clients(idxs, cfg.train.batch_size,
+                                               n_pad=self._n_pad)
+        weights = self.dataset.client_weights(idxs)
+        round_key = jax.random.fold_in(self._base_key, round_idx)
+        keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
+            jnp.asarray(idxs, dtype=jnp.uint32))
+        self.variables, stats = self._round_fn(
+            self.variables, jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(mask), keys, jnp.asarray(weights))
+        return idxs, stats
+
+    # -- the outer loop (reference fedavg_api.py:46-95) ---------------------
+    def train(self) -> Dict:
+        cfg = self.config
+        t0 = time.time()
+        for round_idx in range(cfg.comm_round):
+            _, train_stats = self.run_round(round_idx)
+            last = round_idx == cfg.comm_round - 1
+            if round_idx % cfg.frequency_of_the_test == 0 or last:
+                rec = self.evaluate(round_idx)
+                # mean local-optimization loss this round (distinct from the
+                # post-aggregation train_loss evaluate() reports)
+                rec["train_loss_local"] = float(train_stats["loss_sum"]) / max(
+                    1.0, float(train_stats["count"]))
+                rec["wall_s"] = time.time() - t0
+                self.history.append(rec)
+                logging.info("round %d: %s", round_idx, rec)
+        return self.history[-1] if self.history else {}
+
+    # -- evaluation (reference _local_test_on_all_clients; the per-client
+    #    weighted sums equal the global-union sums, so we evaluate globally) --
+    def evaluate(self, round_idx: int) -> Dict:
+        """Normalized federation metrics: {train,test}_{acc,loss,total} as
+        means over the global train/test unions (equal to the reference's
+        per-client weighted sums in _local_test_on_all_clients)."""
+        rec = {"round": round_idx}
+        xg, yg = self.dataset.train_data_global
+        rec.update(_normalized(self._eval_fn(
+            self.variables, jnp.asarray(xg), jnp.asarray(yg),
+            jnp.ones(len(xg), jnp.float32)), "train"))
+        xt, yt = self.dataset.test_data_global
+        if len(xt):
+            rec.update(_normalized(self._eval_fn(
+                self.variables, jnp.asarray(xt), jnp.asarray(yt),
+                jnp.ones(len(xt), jnp.float32)), "test"))
+        return rec

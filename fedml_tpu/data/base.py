@@ -1,0 +1,108 @@
+"""The federated dataset contract and its device-ready array packing.
+
+The reference's framework-wide ABI is a 9-tuple every loader returns:
+``client_num, train_data_num, test_data_num, train_data_global,
+test_data_global, train_data_local_num_dict, train_data_local_dict,
+test_data_local_dict, class_num`` (e.g.
+fedml_api/data_preprocessing/FederatedEMNIST/data_loader.py:149-150, consumed
+at fedml_experiments/distributed/fedavg/main_fedavg.py:120-227). We keep that
+contract but hold **numpy arrays**, not torch DataLoaders, and add the one
+operation the TPU path needs: ``pack_clients`` — gather a set of sampled
+clients into rectangular padded-and-masked arrays whose leading axis is the
+client/mesh axis. Ragged LEAF-style client sizes become a static shape
+(max client size rounded to a batch multiple) + a 0/1 mask, which is what lets
+the whole round run as one compiled SPMD program (SURVEY §7 "pad-and-mask").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+Arrays = Tuple[np.ndarray, np.ndarray]  # (x, y)
+
+
+@dataclasses.dataclass
+class FederatedDataset:
+    client_num: int
+    train_data_num: int
+    test_data_num: int
+    train_data_global: Arrays
+    test_data_global: Arrays
+    train_data_local_num_dict: Dict[int, int]
+    train_data_local_dict: Dict[int, Arrays]
+    test_data_local_dict: Dict[int, Optional[Arrays]]
+    class_num: int
+
+    @classmethod
+    def from_client_arrays(cls, train_local: Dict[int, Arrays],
+                           test_local: Dict[int, Optional[Arrays]],
+                           class_num: int) -> "FederatedDataset":
+        clients = sorted(train_local)
+        xg = np.concatenate([train_local[c][0] for c in clients])
+        yg = np.concatenate([train_local[c][1] for c in clients])
+        tests = [test_local.get(c) for c in clients]
+        tests = [t for t in tests if t is not None and len(t[0])]
+        xt = np.concatenate([t[0] for t in tests]) if tests else xg[:0]
+        yt = np.concatenate([t[1] for t in tests]) if tests else yg[:0]
+        return cls(
+            client_num=len(clients),
+            train_data_num=len(xg),
+            test_data_num=len(xt),
+            train_data_global=(xg, yg),
+            test_data_global=(xt, yt),
+            train_data_local_num_dict={c: len(train_local[c][0]) for c in clients},
+            train_data_local_dict=train_local,
+            test_data_local_dict=test_local,
+            class_num=class_num,
+        )
+
+    def as_tuple(self):
+        """The reference 9-tuple, verbatim order."""
+        return (self.client_num, self.train_data_num, self.test_data_num,
+                self.train_data_global, self.test_data_global,
+                self.train_data_local_num_dict, self.train_data_local_dict,
+                self.test_data_local_dict, self.class_num)
+
+    # -- TPU packing -------------------------------------------------------
+    @property
+    def max_client_samples(self) -> int:
+        return max(self.train_data_local_num_dict.values())
+
+    def padded_len(self, batch_size: Optional[int]) -> int:
+        """Static per-client length: max client size rounded up to a batch
+        multiple (full batch => exactly the max size)."""
+        n = self.max_client_samples
+        if not batch_size:
+            return n
+        return ((n + batch_size - 1) // batch_size) * batch_size
+
+    def pack_clients(self, client_idxs, batch_size: Optional[int] = None,
+                     n_pad: Optional[int] = None):
+        """Gather sampled clients into [P, n_pad, ...] x / [P, n_pad, ...] y /
+        [P, n_pad] mask arrays — the device-ready round input. ``n_pad``
+        defaults to the dataset-wide static shape so every round compiles
+        once."""
+        n_pad = n_pad or self.padded_len(batch_size)
+        x0, y0 = self.train_data_local_dict[int(client_idxs[0])]
+        P = len(client_idxs)
+        x = np.zeros((P, n_pad) + x0.shape[1:], dtype=x0.dtype)
+        y = np.zeros((P, n_pad) + y0.shape[1:], dtype=y0.dtype)
+        mask = np.zeros((P, n_pad), dtype=np.float32)
+        for i, c in enumerate(client_idxs):
+            cx, cy = self.train_data_local_dict[int(c)]
+            n = len(cx)
+            if n > n_pad:
+                raise ValueError(f"client {c} has {n} samples > n_pad={n_pad}")
+            x[i, :n] = cx
+            y[i, :n] = cy
+            mask[i, :n] = 1.0
+        return x, y, mask
+
+    def client_weights(self, client_idxs) -> np.ndarray:
+        """Sample counts n_i for the weighted FedAvg average."""
+        return np.array(
+            [self.train_data_local_num_dict[int(c)] for c in client_idxs],
+            dtype=np.float32)

@@ -1,0 +1,19 @@
+"""Flax model zoo — TPU-native re-expression of ``fedml_api/model``.
+
+All modules share one calling convention: ``module.apply(variables, x,
+train=bool)`` with NHWC image layout (TPU-friendly; the reference uses torch
+NCHW). ``create_model`` mirrors the reference's experiment-level factory
+(fedml_experiments/distributed/fedavg/main_fedavg.py:229-266).
+"""
+
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.models.cnn import CNN_DropOut
+
+
+def create_model(model_name: str, output_dim: int = 10, **kw):
+    """Model factory with reference naming (main_fedavg.py:229-266)."""
+    if model_name == "lr":
+        return LogisticRegression(num_classes=output_dim)
+    if model_name == "cnn":
+        return CNN_DropOut(only_digits=(output_dim == 10))
+    raise ValueError(f"unknown model: {model_name!r}")

@@ -1,0 +1,34 @@
+"""The FedAvg 2-conv CNN ("Adaptive Federated Optimization", arXiv:2003.00295).
+
+Reference: fedml_api/model/cv/cnn.py:75-144 ``CNN_DropOut`` (NB the reference
+file is corrupted by a bad F->self replace — ``nn.selflatten`` etc.; we build
+the documented architecture from its own summary table):
+
+    28x28x1 -> conv3x3(32) VALID + relu -> conv3x3(64) VALID + relu
+    -> maxpool2x2 -> dropout(.25) -> flatten(9216) -> dense(128) + relu
+    -> dropout(.5) -> dense(10 | 62)
+
+1,199,882 params for the 10-class variant. NHWC layout; accepts [B, 28, 28]
+or [B, 28, 28, 1].
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class CNN_DropOut(nn.Module):
+    only_digits: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else 62)(x)

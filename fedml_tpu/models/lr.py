@@ -1,0 +1,21 @@
+"""Logistic regression (reference: fedml_api/model/linear/lr.py:4-13).
+
+The reference applies a sigmoid to the linear output *and then* feeds it to
+``nn.CrossEntropyLoss`` — a quirk, not a spec; we emit raw logits and let the
+task head apply the proper link (softmax CE for classification, sigmoid BCE
+for multi-label tag prediction), which is both numerically saner and what the
+cited benchmark model actually is.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class LogisticRegression(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)

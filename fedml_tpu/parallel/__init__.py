@@ -1,0 +1,6 @@
+from fedml_tpu.parallel.spmd import (
+    build_mesh,
+    make_spmd_round,
+    make_hierarchical_spmd_round,
+    DistributedFedAvgAPI,
+)

@@ -1,0 +1,10 @@
+from fedml_tpu.trainer.model_trainer import ModelTrainer
+from fedml_tpu.trainer.tasks import TASK_HEADS, classification_head
+from fedml_tpu.trainer.functional import (
+    TrainConfig,
+    make_optimizer,
+    make_forward,
+    make_local_train,
+    make_eval,
+)
+from fedml_tpu.trainer.flax_trainer import FlaxModelTrainer

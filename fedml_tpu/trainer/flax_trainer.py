@@ -1,0 +1,88 @@
+"""Flax/Optax implementation of the ModelTrainer protocol.
+
+The host-facing glue object: algorithms that want the reference's
+object-oriented seam (get/set params, train, test — reference
+fedml_core/trainer/model_trainer.py) use this class; the compiled inner
+programs come from :mod:`fedml_tpu.trainer.functional` and are shared with
+the vmapped/SPMD round programs, so the class and the pure paths cannot
+drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
+                                          make_local_train)
+from fedml_tpu.trainer.model_trainer import ModelTrainer
+from fedml_tpu.trainer.tasks import stats_to_metrics
+
+Arrays = Tuple[np.ndarray, np.ndarray]  # (x, y)
+
+
+class FlaxModelTrainer(ModelTrainer):
+    def __init__(self, module, task: str = "classification",
+                 cfg: Optional[TrainConfig] = None, seed: int = 0):
+        super().__init__(module, cfg)
+        self.module = module
+        self.task = task
+        self.cfg = cfg or TrainConfig()
+        self._rng = jax.random.key(seed)
+        self._variables = None
+        self._train_fn = jax.jit(make_local_train(module, task, self.cfg))
+        self._eval_fn = jax.jit(make_eval(module, task))
+
+    # -- state ------------------------------------------------------------
+    def init(self, sample_x: np.ndarray, seed: int = 0):
+        init_rng = jax.random.key(seed)
+        self._variables = self.module.init(init_rng, jnp.asarray(sample_x),
+                                           train=False)
+        return self._variables
+
+    def get_model_params(self):
+        return self._variables
+
+    def set_model_params(self, model_parameters):
+        self._variables = model_parameters
+
+    # -- compute ----------------------------------------------------------
+    def train(self, train_data, device=None, args=None):
+        """train_data: (x, y) arrays or (x, y, mask); trains in place on the
+        currently installed params and returns summed train stats."""
+        x, y, mask = _with_mask(train_data)
+        bsz = self.cfg.batch_size or x.shape[0]
+        x, y, mask = _pad_to_multiple(x, y, mask, bsz)
+        self._rng, sub = jax.random.split(self._rng)
+        self._variables, stats = self._train_fn(
+            self._variables, jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(mask), sub)
+        return jax.tree.map(float, stats)
+
+    def test(self, test_data, device=None, args=None) -> Dict[str, float]:
+        x, y, mask = _with_mask(test_data)
+        stats = self._eval_fn(self._variables, jnp.asarray(x), jnp.asarray(y),
+                              jnp.asarray(mask))
+        return stats_to_metrics(stats)
+
+
+def _with_mask(data):
+    if len(data) == 3:
+        return data
+    x, y = data
+    return x, y, np.ones(len(x), dtype=np.float32)
+
+
+def _pad_to_multiple(x, y, mask, bsz: int):
+    n = len(x)
+    n_pad = ((n + bsz - 1) // bsz) * bsz
+    pad = n_pad - n
+    if pad == 0:
+        return x, y, mask
+    x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+    y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+    mask = np.concatenate([mask, np.zeros(pad, mask.dtype)])
+    return x, y, mask

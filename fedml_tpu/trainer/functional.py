@@ -1,0 +1,194 @@
+"""Pure, jittable local-training and evaluation programs.
+
+This is the TPU-native replacement for the reference's hot loop
+(fedml_api/distributed/fedavg/MyModelTrainer.py:19-49: python epochs × torch
+DataLoader batches). Here one client's whole local-training pass —
+``epochs × batches`` of forward/CE/backward/SGD — is a single ``lax.scan``
+over a precomputed (epoch-shuffled) index array of padded batches, so XLA
+compiles it into one fused device program. Under ``jax.vmap`` it trains every
+sampled client simultaneously (standalone simulation); under ``shard_map`` it
+becomes the per-shard body of the distributed SPMD round.
+
+Data layout per client: flat padded arrays ``x: [n_pad, ...]``, ``y``,
+``mask: [n_pad]`` with ``n_pad`` a multiple of the batch size; the mask
+weights the loss so padding rows contribute zero gradient and the per-batch
+loss equals torch's mean over the real examples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.trainer.tasks import TASK_HEADS, TaskHead
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Local-training hyperparameters (reference argparse flags:
+    --epochs --batch_size --client_optimizer --lr --wd)."""
+
+    epochs: int = 1
+    batch_size: Optional[int] = None  # None = full batch (one step per epoch)
+    lr: float = 0.03
+    client_optimizer: str = "sgd"  # "sgd" | "adam"
+    wd: float = 0.0
+    momentum: float = 0.0
+    shuffle: bool = True
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    """Client optimizer factory, matching the reference's two choices
+    (MyModelTrainer.py:26-31): plain SGD, or Adam(amsgrad) with L2-style
+    weight decay folded into the gradient like torch's ``weight_decay``."""
+    if cfg.client_optimizer == "sgd":
+        if cfg.momentum:
+            return optax.sgd(cfg.lr, momentum=cfg.momentum)
+        return optax.sgd(cfg.lr)
+    if cfg.client_optimizer == "adam":
+        steps = []
+        if cfg.wd:
+            steps.append(optax.add_decayed_weights(cfg.wd))
+        steps.append(optax.amsgrad(cfg.lr))
+        return optax.chain(*steps)
+    raise ValueError(f"unknown client_optimizer: {cfg.client_optimizer!r}")
+
+
+def make_forward(module) -> Callable:
+    """Uniform apply over a variables dict {'params', [other collections]}.
+
+    Returns ``(outputs, updated_collections)``; in train mode non-param
+    collections (e.g. flax ``batch_stats``) are mutable, mirroring how the
+    reference ships the *full* state_dict (weights + BN running stats) through
+    aggregation (FedAVGAggregator.py:58-87 averages every key).
+    """
+
+    def forward(variables, x, train: bool, rng=None):
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        mutable = [k for k in variables if k != "params"]
+        if train:
+            out, updates = module.apply(variables, x, train=True, rngs=rngs,
+                                        mutable=mutable)
+            return out, {**variables, **updates}
+        out = module.apply(variables, x, train=False)
+        return out, variables
+
+    return forward
+
+
+def make_local_train(module, task: str, cfg: TrainConfig):
+    """Build ``local_train(variables, x, y, mask, rng) -> (variables, stats)``.
+
+    One call = the reference's ``ModelTrainer.train`` for one client: fresh
+    optimizer (the reference constructs a new torch optimizer every call, so
+    client momentum never crosses rounds), ``cfg.epochs`` passes with per-epoch
+    reshuffling, mask-weighted per-batch mean loss.
+    """
+    head: TaskHead = TASK_HEADS[task]
+    forward = make_forward(module)
+    tx = make_optimizer(cfg)
+
+    def local_train(variables, x, y, mask, rng):
+        n_pad = x.shape[0]
+        bsz = cfg.batch_size or n_pad
+        assert n_pad % bsz == 0, "data must be padded to a batch multiple"
+        nb = n_pad // bsz
+
+        perm_key, step_key = jax.random.split(rng)
+        epoch_keys = jax.random.split(perm_key, cfg.epochs)
+        if cfg.shuffle:
+            perms = jnp.stack(
+                [jax.random.permutation(k, n_pad) for k in epoch_keys])
+        else:
+            perms = jnp.tile(jnp.arange(n_pad), (cfg.epochs, 1))
+        batch_idx = perms.reshape(cfg.epochs * nb, bsz)
+        step_keys = jax.random.split(step_key, cfg.epochs * nb)
+
+        params = variables["params"]
+        opt_state = tx.init(params)
+        init = (params, {k: v for k, v in variables.items() if k != "params"},
+                opt_state)
+
+        def step(carry, inp):
+            params, colls, opt_state = carry
+            idx, key = inp
+            xb = jnp.take(x, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+            mb = jnp.take(mask, idx, axis=0)
+
+            def loss_fn(p):
+                out, new_vars = forward({"params": p, **colls}, xb, True, key)
+                stats = head(out, yb, mb)
+                loss = stats["loss_sum"] / jnp.maximum(stats["count"], 1.0)
+                return loss, (new_vars, stats)
+
+            grads, (new_vars, stats) = jax.grad(loss_fn, has_aux=True)(params)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # padding-only batches (small client, dataset-wide n_pad) must be
+            # true no-ops: zero grads still move stateful optimizers
+            # (weight decay, momentum, adam count), so gate the whole update
+            has_real = stats["count"] > 0
+
+            def sel(new, old):
+                return jax.tree.map(lambda a, b: jnp.where(has_real, a, b),
+                                    new, old)
+
+            params = sel(new_params, params)
+            opt_state = sel(new_opt_state, opt_state)
+            colls = sel({k: v for k, v in new_vars.items() if k != "params"},
+                        colls)
+            return (params, colls, opt_state), stats
+
+        (params, colls, _), stats = jax.lax.scan(
+            step, init, (batch_idx, step_keys))
+        total = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
+        return {"params": params, **colls}, total
+
+    return local_train
+
+
+def make_eval(module, task: str, eval_batch_size: int = 512):
+    """Build ``evaluate(variables, x, y, mask) -> stat sums`` that scans fixed
+    eval batches (deterministic mode, no dropout), the jittable analogue of
+    the reference's ``ModelTrainer.test`` loop (MyModelTrainer.py:51-96)."""
+    head: TaskHead = TASK_HEADS[task]
+    forward = make_forward(module)
+
+    def evaluate(variables, x, y, mask):
+        n = x.shape[0]
+        if n == 0:
+            # empty eval set: run the head once on a zero dummy batch with a
+            # zero mask so the stat keys exist and all sums are 0
+            dummy_x = jnp.zeros((1,) + x.shape[1:], x.dtype)
+            dummy_y = jnp.zeros((1,) + y.shape[1:], y.dtype)
+            out, _ = forward(variables, dummy_x, False)
+            return head(out, dummy_y, jnp.zeros((1,), jnp.float32))
+        bsz = min(eval_batch_size, n)
+        n_pad = ((n + bsz - 1) // bsz) * bsz
+        pad = n_pad - n
+        if pad:
+            x_p = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            y_p = jnp.pad(y, [(0, pad)] + [(0, 0)] * (y.ndim - 1))
+            m_p = jnp.pad(mask, (0, pad))
+        else:
+            x_p, y_p, m_p = x, y, mask
+        nb = n_pad // bsz
+        xb = x_p.reshape((nb, bsz) + x.shape[1:])
+        yb = y_p.reshape((nb, bsz) + y.shape[1:])
+        mb = m_p.reshape(nb, bsz)
+
+        def step(carry, batch):
+            bx, by, bm = batch
+            out, _ = forward(variables, bx, False)
+            stats = head(out, by, bm)
+            return carry, stats
+
+        _, stats = jax.lax.scan(step, 0, (xb, yb, mb))
+        return jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
+
+    return evaluate

@@ -1,0 +1,103 @@
+"""Task heads: loss + metric kernels for the three federated task families.
+
+The reference hardwires these into per-task trainer subclasses
+(fedml_api/standalone/fedavg/my_model_trainer_{classification,nwp,
+tag_prediction}.py and the stackoverflow_lr branch in
+fedml_api/distributed/fedavg/MyModelTrainer.py:72-83). Here each head is a
+pure function ``head(logits, targets, mask) -> stat sums`` so it can run
+inside jit/vmap/shard_map; all stats are *sums* (not means) so they aggregate
+correctly across batches, clients and mesh shards by plain addition / psum.
+
+Masking convention: every example row carries a 0/1 ``mask`` weight (padding
+rows are 0). Sequence heads additionally mask padding tokens inside each
+example. The per-batch training loss is ``loss_sum / count`` — identical to
+torch's reduction='mean' over the real examples in the batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Stats = Dict[str, jnp.ndarray]
+TaskHead = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], Stats]
+
+PAD_TOKEN = 0  # sequence pad id (LEAF/TFF convention: 0-padded batches)
+
+
+def classification_head(logits: jnp.ndarray, targets: jnp.ndarray,
+                        mask: jnp.ndarray) -> Stats:
+    """Softmax CE + top-1 accuracy. logits [B, C], integer targets [B]."""
+    per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    return {
+        "loss_sum": jnp.sum(per_ex * mask),
+        "count": jnp.sum(mask),
+        "correct_sum": jnp.sum(correct * mask),
+    }
+
+
+def nwp_head(logits: jnp.ndarray, targets: jnp.ndarray,
+             mask: jnp.ndarray) -> Stats:
+    """Next-word/char prediction: per-token CE over [B, T, V] logits.
+
+    The accounting unit is the *token* (reference my_model_trainer_nwp
+    counts correct tokens and divides by token totals); pad tokens
+    (``PAD_TOKEN``) and padded example rows are excluded.
+    """
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    tok_mask = (targets != PAD_TOKEN).astype(jnp.float32) * mask[:, None]
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    return {
+        "loss_sum": jnp.sum(per_tok * tok_mask),
+        "count": jnp.sum(tok_mask),
+        "correct_sum": jnp.sum(correct * tok_mask),
+    }
+
+
+def tag_prediction_head(logits: jnp.ndarray, targets: jnp.ndarray,
+                        mask: jnp.ndarray) -> Stats:
+    """Multi-label tag prediction (stackoverflow_lr): sigmoid BCE.
+
+    Metrics mirror MyModelTrainer.py:72-83: an example is "correct" only when
+    every label matches at threshold 0.5; precision/recall are per-example
+    ratios summed over examples (averaged by the caller via ``count``).
+    """
+    per_label = optax.sigmoid_binary_cross_entropy(logits, targets)
+    per_ex = jnp.mean(per_label, axis=-1)
+    pred = (jax.nn.sigmoid(logits) > 0.5).astype(jnp.float32)
+    exact = jnp.all(pred == targets, axis=-1).astype(jnp.float32)
+    tp = jnp.sum(pred * targets, axis=-1)
+    precision = tp / (jnp.sum(pred, axis=-1) + 1e-13)
+    recall = tp / (jnp.sum(targets, axis=-1) + 1e-13)
+    return {
+        "loss_sum": jnp.sum(per_ex * mask),
+        "count": jnp.sum(mask),
+        "correct_sum": jnp.sum(exact * mask),
+        "precision_sum": jnp.sum(precision * mask),
+        "recall_sum": jnp.sum(recall * mask),
+    }
+
+
+TASK_HEADS: Dict[str, TaskHead] = {
+    "classification": classification_head,
+    "nwp": nwp_head,
+    "tag_prediction": tag_prediction_head,
+}
+
+
+def stats_to_metrics(stats: Stats, prefix: str = "test") -> Dict[str, float]:
+    """Convert device stat sums to the reference metrics dict shape
+    (MyModelTrainer.test: test_correct/test_loss/test_total...)."""
+    out = {
+        f"{prefix}_correct": float(stats["correct_sum"]),
+        f"{prefix}_loss": float(stats["loss_sum"]),
+        f"{prefix}_total": float(stats["count"]),
+    }
+    if "precision_sum" in stats:
+        out[f"{prefix}_precision"] = float(stats["precision_sum"])
+        out[f"{prefix}_recall"] = float(stats["recall_sum"])
+    return out

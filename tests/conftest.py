@@ -20,9 +20,13 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
 import pytest  # noqa: E402
 
+# the environment's axon plugin (sitecustomize) sets jax_platforms
+# programmatically, which overrides the env var — force CPU via config too
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def devices():
-    import jax
-
     return jax.devices()
