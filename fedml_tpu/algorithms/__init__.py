@@ -1,2 +1,12 @@
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 from fedml_tpu.algorithms.centralized import CentralizedTrainer
+from fedml_tpu.algorithms.fedopt import (FedOptAPI, FedOptConfig,
+                                         get_server_optimizer)
+from fedml_tpu.algorithms.fednova import FedNovaAPI, FedNovaConfig
+from fedml_tpu.algorithms.fedavg_robust import (FedAvgRobustAPI,
+                                                FedAvgRobustConfig,
+                                                poison_client_labelflip)
+from fedml_tpu.algorithms.hierarchical import (HierarchicalFedAvgAPI,
+                                               HierarchicalConfig)
+from fedml_tpu.algorithms.decentralized import (DecentralizedOnlineAPI,
+                                                DecentralizedConfig)

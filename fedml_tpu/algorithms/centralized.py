@@ -12,8 +12,6 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-import jax
-import jax.numpy as jnp
 
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.flax_trainer import FlaxModelTrainer

@@ -37,6 +37,21 @@ from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train)
+def make_vmapped_body(local_train):
+    """vmap local training over the client axis and sum stats — the shared
+    round body every FedAvg-family algorithm composes with its own
+    aggregation rule."""
+
+    def body(variables, x, y, mask, keys):
+        stacked, stats = jax.vmap(
+            local_train, in_axes=(None, 0, 0, 0, 0))(variables, x, y, mask,
+                                                     keys)
+        totals = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
+        return stacked, totals
+
+    return body
+
+
 def _normalized(stats, prefix: str) -> Dict[str, float]:
     """Stat sums -> {prefix}_{acc,loss,total} means (+precision/recall)."""
     total = max(1.0, float(stats["count"]))
@@ -70,7 +85,11 @@ class FedAvgAPI:
     def __init__(self, dataset: FederatedDataset, module,
                  task: str = "classification",
                  config: Optional[FedAvgConfig] = None,
-                 delete_client: Optional[int] = None):
+                 delete_client: Optional[int] = None,
+                 aggregate_hook=None):
+        """``aggregate_hook(variables, stacked, weights, key) -> new_vars``
+        customizes server aggregation (e.g. robust defenses) while keeping
+        one round body; default is the sample-weighted mean."""
         self.dataset = dataset
         self.module = module
         self.task = task
@@ -78,14 +97,16 @@ class FedAvgAPI:
         self.delete_client = delete_client
         cfg = self.config.train
 
-        local_train = make_local_train(module, task, cfg)
+        self._local_train = make_local_train(module, task, cfg)
+        self._vmapped_body = make_vmapped_body(self._local_train)
+        hook = aggregate_hook or (
+            lambda variables, stacked, weights, key:
+            pt.tree_weighted_mean(stacked, weights))
+        body = self._vmapped_body
 
-        def round_fn(variables, x, y, mask, keys, weights):
-            stacked, stats = jax.vmap(
-                local_train, in_axes=(None, 0, 0, 0, 0))(variables, x, y,
-                                                         mask, keys)
-            new_vars = pt.tree_weighted_mean(stacked, weights)
-            totals = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
+        def round_fn(variables, x, y, mask, keys, weights, agg_key):
+            stacked, totals = body(variables, x, y, mask, keys)
+            new_vars = hook(variables, stacked, weights, agg_key)
             return new_vars, totals
 
         self._round_fn = jax.jit(round_fn)
@@ -99,7 +120,9 @@ class FedAvgAPI:
         self.history: List[Dict] = []
 
     # -- one round ---------------------------------------------------------
-    def run_round(self, round_idx: int):
+    def _prepare_round(self, round_idx: int):
+        """Host side of a round: seeded sampling, pad-and-mask packing,
+        per-client keys. Shared by all FedAvg-family algorithms."""
         cfg = self.config
         idxs = sample_clients(round_idx, self.dataset.client_num,
                               cfg.client_num_per_round,
@@ -109,10 +132,16 @@ class FedAvgAPI:
         weights = self.dataset.client_weights(idxs)
         round_key = jax.random.fold_in(self._base_key, round_idx)
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
-            jnp.asarray(idxs, dtype=jnp.uint32))
-        self.variables, stats = self._round_fn(
-            self.variables, jnp.asarray(x), jnp.asarray(y),
-            jnp.asarray(mask), keys, jnp.asarray(weights))
+            jnp.asarray(np.asarray(idxs), dtype=jnp.uint32))
+        agg_key = jax.random.fold_in(round_key, 2**31 - 1)
+        return idxs, (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+                      keys, jnp.asarray(weights), agg_key)
+
+    def run_round(self, round_idx: int):
+        idxs, (x, y, mask, keys, weights, agg_key) = self._prepare_round(
+            round_idx)
+        self.variables, stats = self._round_fn(self.variables, x, y, mask,
+                                               keys, weights, agg_key)
         return idxs, stats
 
     # -- the outer loop (reference fedavg_api.py:46-95) ---------------------

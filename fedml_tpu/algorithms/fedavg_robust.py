@@ -1,0 +1,83 @@
+"""Robust FedAvg — per-update defenses against Byzantine/backdoor clients.
+
+Reference: fedml_api/distributed/fedavg_robust/ — FedAvgRobustAggregator
+applies norm-diff clipping and/or weak-DP gaussian noise to each client
+update before the weighted average (FedAvgRobustAggregator.py:166-220,
+kernels in fedml_core/robustness/robust_aggregation.py), with flags
+``--defense_type {norm_diff_clipping,weak_dp} --norm_bound --stddev``
+(main_fedavg_robust.py:56-63). The attacker in the reference is a client
+whose loader is swapped for a poisoned dataset (FedAvgRobustTrainer.py:23-28,
+edge_case_examples); here :func:`poison_client_labelflip` provides an
+equivalent in-memory poisoning hook (trigger pattern + label flip) since the
+poisoned corpora are external downloads.
+
+The defense runs inside the jitted round: vmapped over client updates before
+the weighted tree-mean (and, on a mesh, per-shard before the psum).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.core.robust import apply_defense
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.data.base import FederatedDataset
+
+
+@dataclasses.dataclass(frozen=True)
+class FedAvgRobustConfig(FedAvgConfig):
+    defense_type: Optional[str] = "norm_diff_clipping"
+    norm_bound: float = 5.0
+    stddev: float = 0.025
+
+
+class FedAvgRobustAPI(FedAvgAPI):
+    """FedAvg with a defended aggregation rule — implemented purely as an
+    aggregate hook on the shared round body, so sampling, packing and local
+    training are identical to FedAvgAPI (incl. leave-one-out)."""
+
+    def __init__(self, dataset: FederatedDataset, module,
+                 task: str = "classification",
+                 config: Optional[FedAvgRobustConfig] = None,
+                 delete_client: Optional[int] = None):
+        config = config or FedAvgRobustConfig()
+        defense_type = config.defense_type
+        norm_bound, stddev = config.norm_bound, config.stddev
+
+        def defended_mean(variables, stacked, weights, key):
+            dkeys = jax.random.split(key, weights.shape[0])
+            defended = jax.vmap(
+                lambda upd, k: apply_defense(upd, variables, defense_type,
+                                             norm_bound, stddev, k))(
+                                                 stacked, dkeys)
+            return pt.tree_weighted_mean(defended, weights)
+
+        super().__init__(dataset, module, task, config,
+                         delete_client=delete_client,
+                         aggregate_hook=defended_mean)
+
+
+def poison_client_labelflip(dataset: FederatedDataset, client_idx: int,
+                            target_label: int, trigger_value: float = 2.0,
+                            fraction: float = 1.0,
+                            seed: int = 0) -> FederatedDataset:
+    """Backdoor a client in place of the reference's poisoned loaders:
+    stamp a trigger patch into a fraction of the client's inputs and flip
+    their labels to ``target_label``. Returns a new FederatedDataset."""
+    rng = np.random.RandomState(seed)
+    train_local = dict(dataset.train_data_local_dict)
+    x, y = train_local[client_idx]
+    x, y = x.copy(), y.copy()
+    n = len(x)
+    chosen = rng.choice(n, max(1, int(n * fraction)), replace=False)
+    xv = x.reshape(n, -1)
+    xv[chosen, : max(1, xv.shape[1] // 16)] = trigger_value
+    y[chosen] = target_label
+    train_local[client_idx] = (xv.reshape(x.shape), y)
+    return FederatedDataset.from_client_arrays(
+        train_local, dataset.test_data_local_dict, dataset.class_num)

@@ -1,0 +1,227 @@
+"""FedNova — federated normalized averaging (Wang et al., NeurIPS 2020).
+
+Reference: fedml_api/standalone/fednova/{fednova.py,fednova_trainer.py}. The
+torch version is a custom Optimizer that, per local step, applies
+momentum/dampening/nesterov + weight decay + a proximal pull toward the round
+start, accumulates ``cum_grad += lr * d_p``, and tracks the normalizing
+scalar a_i (fednova.py:96-151); the server recombines normalized gradients
+``ratio_i * cum_grad_i / a_i`` scaled by ``tau_eff = sum_i ratio_i * a_i``
+(fednova.py:155-176, fednova_trainer.py:97-121), optionally through a global
+momentum buffer (gmf).
+
+Here the whole local pass is one ``lax.scan``; a_i counts only real
+(non-padding) batches, so heterogeneous client sizes produce exactly the
+heterogeneous local-step counts FedNova exists to correct for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.trainer.functional import TrainConfig, make_eval, make_forward
+from fedml_tpu.trainer.tasks import TASK_HEADS
+
+
+@dataclasses.dataclass(frozen=True)
+class FedNovaConfig:
+    comm_round: int = 10
+    client_num_per_round: int = 10
+    frequency_of_the_test: int = 5
+    seed: int = 0
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    gmf: float = 0.0  # global (server) momentum factor
+    mu: float = 0.0  # proximal coefficient
+    dampening: float = 0.0
+    nesterov: bool = False
+
+
+def make_fednova_local_train(module, task: str, cfg: FedNovaConfig):
+    """Build ``local(variables, x, y, mask, rng) ->
+    (cum_grad, a_i, local_steps, stats)`` — the client side of FedNova."""
+    head = TASK_HEADS[task]
+    forward = make_forward(module)
+    tc = cfg.train
+
+    def local(variables, x, y, mask, rng):
+        from fedml_tpu.trainer.functional import make_batch_schedule
+        n_pad = x.shape[0]
+        bsz = tc.batch_size or n_pad
+        batch_idx, step_keys = make_batch_schedule(n_pad, tc.epochs, bsz,
+                                                   tc.shuffle, rng)
+
+        params0 = variables["params"]
+        colls0 = {k: v for k, v in variables.items() if k != "params"}
+        zeros = pt.tree_zeros_like(params0)
+        # carry: params, colls, momentum buffer, cum_grad, scalars
+        # (counter, a_i, steps); steps also flags buf initialization
+        init = (params0, colls0, zeros, zeros,
+                jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
+
+        def step(carry, inp):
+            params, colls, buf, cum, counter, a_i, steps = carry
+            idx, key = inp
+            xb = jnp.take(x, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+            mb = jnp.take(mask, idx, axis=0)
+
+            def loss_fn(p):
+                out, new_vars = forward({"params": p, **colls}, xb, True, key)
+                stats = head(out, yb, mb)
+                return stats["loss_sum"] / jnp.maximum(stats["count"], 1.0), (
+                    new_vars, stats)
+
+            grads, (new_vars, stats) = jax.grad(loss_fn, has_aux=True)(params)
+            has_real = stats["count"] > 0
+
+            # d_p = grad + wd * p
+            d_p = jax.tree.map(lambda g, p: g + tc.wd * p, grads, params)
+            # momentum buffer: buf = m*buf + (1 - dampening)*d_p, except the
+            # FIRST real step initializes buf = d_p with no dampening
+            # (reference fednova.py:112-117 torch-SGD convention)
+            if tc.momentum:
+                first = steps == 0
+
+                def buf_update(b, d):
+                    accum = tc.momentum * b + (1.0 - cfg.dampening) * d
+                    return jnp.where(first, d, accum)
+
+                new_buf = jax.tree.map(buf_update, buf, d_p)
+                if cfg.nesterov:
+                    d_p = jax.tree.map(lambda d, b: d + tc.momentum * b,
+                                       d_p, new_buf)
+                else:
+                    d_p = new_buf
+            else:
+                new_buf = buf
+            # proximal pull toward round start
+            if cfg.mu:
+                d_p = jax.tree.map(lambda d, p, p0: d + cfg.mu * (p - p0),
+                                   d_p, params, params0)
+            new_cum = jax.tree.map(lambda c, d: c + tc.lr * d, cum, d_p)
+            new_params = jax.tree.map(lambda p, d: p - tc.lr * d, params, d_p)
+
+            # normalizing-vector recurrences (fednova.py:139-151), counting
+            # only real steps
+            new_counter = counter * tc.momentum + 1.0
+            if tc.momentum:
+                new_a = a_i + new_counter
+            else:
+                new_a = a_i
+            etamu = tc.lr * cfg.mu
+            if etamu:
+                new_a = new_a * (1.0 - etamu) + 1.0
+            if not tc.momentum and not etamu:
+                new_a = a_i + 1.0
+
+            def sel(new, old):
+                return jax.tree.map(
+                    lambda a, b: jnp.where(has_real, a, b), new, old)
+
+            carry = (sel(new_params, params),
+                     sel({k: v for k, v in new_vars.items()
+                          if k != "params"}, colls),
+                     sel(new_buf, buf), sel(new_cum, cum),
+                     jnp.where(has_real, new_counter, counter),
+                     jnp.where(has_real, new_a, a_i),
+                     steps + jnp.where(has_real, 1.0, 0.0))
+            return carry, stats
+
+        (params, colls, _, cum, _, a_i, steps), stats = jax.lax.scan(
+            step, init, (batch_idx, step_keys))
+        totals = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
+        return cum, a_i, steps, colls, totals
+
+    return local
+
+
+class FedNovaAPI:
+    """Standalone FedNova simulation (parity: FedNovaTrainer.train)."""
+
+    def __init__(self, dataset: FederatedDataset, module,
+                 task: str = "classification",
+                 config: Optional[FedNovaConfig] = None):
+        self.dataset = dataset
+        self.module = module
+        self.config = config or FedNovaConfig()
+        cfg = self.config
+        local = make_fednova_local_train(module, task, cfg)
+
+        def round_fn(variables, momentum_buf, x, y, mask, keys, ratios):
+            cums, a_is, steps, colls, stats = jax.vmap(
+                local, in_axes=(None, 0, 0, 0, 0))(variables, x, y, mask,
+                                                   keys)
+            # tau_eff = sum_i ratio_i * (steps_i if mu else a_i)
+            per_client_tau = steps if cfg.mu else a_is
+            tau_eff = jnp.sum(ratios * per_client_tau)
+            # cum_grad = tau_eff * sum_i ratio_i * cum_i / a_i
+            def combine(leaf):
+                w = (ratios / a_is).reshape(
+                    (-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+                return tau_eff.astype(leaf.dtype) * jnp.sum(leaf * w, axis=0)
+
+            cum_grad = jax.tree.map(combine, cums)
+            if cfg.gmf:
+                new_buf = jax.tree.map(
+                    lambda b, c: cfg.gmf * b + c / cfg.train.lr,
+                    momentum_buf, cum_grad)
+                new_params = jax.tree.map(
+                    lambda p, b: p - cfg.train.lr * b,
+                    variables["params"], new_buf)
+            else:
+                new_buf = momentum_buf
+                new_params = jax.tree.map(lambda p, c: p - c,
+                                          variables["params"], cum_grad)
+            # non-param collections: weighted average (as FedAvg would)
+            new_colls = pt.tree_weighted_mean(colls, ratios) if colls else colls
+            totals = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
+            return {**new_colls, "params": new_params}, new_buf, totals
+
+        self._round_fn = jax.jit(round_fn)
+        self._eval_fn = jax.jit(make_eval(module, task))
+        self._n_pad = dataset.padded_len(cfg.train.batch_size)
+        self._base_key = jax.random.key(cfg.seed)
+        sample_x = dataset.train_data_global[0][:1]
+        self.variables = module.init(jax.random.key(cfg.seed),
+                                     jnp.asarray(sample_x), train=False)
+        self.momentum_buf = pt.tree_zeros_like(self.variables["params"])
+        self.history: List[Dict] = []
+
+    def run_round(self, round_idx: int):
+        cfg = self.config
+        idxs = sample_clients(round_idx, self.dataset.client_num,
+                              cfg.client_num_per_round)
+        x, y, mask = self.dataset.pack_clients(idxs, cfg.train.batch_size,
+                                               n_pad=self._n_pad)
+        counts = self.dataset.client_weights(idxs)
+        ratios = counts / counts.sum()  # ratio_i = n_i / round_sample_num
+        round_key = jax.random.fold_in(self._base_key, round_idx)
+        keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
+            jnp.asarray(np.asarray(idxs), dtype=jnp.uint32))
+        self.variables, self.momentum_buf, stats = self._round_fn(
+            self.variables, self.momentum_buf, jnp.asarray(x),
+            jnp.asarray(y), jnp.asarray(mask), keys, jnp.asarray(ratios))
+        return idxs, stats
+
+    def train(self) -> Dict:
+        from fedml_tpu.algorithms.fedavg import _normalized
+        cfg = self.config
+        for round_idx in range(cfg.comm_round):
+            _, stats = self.run_round(round_idx)
+            last = round_idx == cfg.comm_round - 1
+            if round_idx % cfg.frequency_of_the_test == 0 or last:
+                rec = {"round": round_idx}
+                xt, yt = self.dataset.test_data_global
+                if len(xt):
+                    rec.update(_normalized(self._eval_fn(
+                        self.variables, jnp.asarray(xt), jnp.asarray(yt),
+                        jnp.ones(len(xt), jnp.float32)), "test"))
+                self.history.append(rec)
+        return self.history[-1] if self.history else {}

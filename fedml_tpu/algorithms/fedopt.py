@@ -1,0 +1,95 @@
+"""FedOpt — adaptive server optimization (FedAdam / FedAdagrad / FedYogi...).
+
+Reference semantics (fedml_api/distributed/fedopt/FedOptAggregator.py:70-123
+and standalone/fedopt/fedopt_api.py): do the FedAvg sample-weighted average,
+form the pseudo-gradient ``w_old - w_avg``, and hand it to a persistent
+server-side optimizer; non-parameter state (BN buffers) takes the plain
+average. The reference reflects over ``torch.optim.Optimizer.__subclasses__``
+(optrepo.py:7) to resolve ``--server_optimizer`` by name; we mirror that with
+an optax registry. Everything — local training, aggregation, pseudo-grad,
+server update — runs inside the one jitted round program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import optax
+
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.data.base import FederatedDataset
+
+#: name -> constructor(lr, **kw); parity with OptRepo's name2cls lookup
+OPTIMIZER_REPO = {
+    "sgd": lambda lr, momentum=0.0, **kw: optax.sgd(lr, momentum=momentum or None),
+    "adam": lambda lr, **kw: optax.adam(lr, **kw),
+    "adamw": lambda lr, **kw: optax.adamw(lr, **kw),
+    "adagrad": lambda lr, **kw: optax.adagrad(lr, **kw),
+    "yogi": lambda lr, **kw: optax.yogi(lr, **kw),
+    "rmsprop": lambda lr, **kw: optax.rmsprop(lr, **kw),
+    "lamb": lambda lr, **kw: optax.lamb(lr, **kw),
+}
+
+
+def get_server_optimizer(name: str, lr: float, **kw) -> optax.GradientTransformation:
+    try:
+        return OPTIMIZER_REPO[name.lower()](lr, **kw)
+    except KeyError:
+        raise ValueError(
+            f"unknown server_optimizer {name!r}; have {sorted(OPTIMIZER_REPO)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FedOptConfig(FedAvgConfig):
+    """Adds the reference's --server_optimizer / --server_lr flags
+    (main_fedopt.py:54-60)."""
+
+    server_optimizer: str = "adam"
+    server_lr: float = 1e-3
+    server_momentum: float = 0.0
+
+
+class FedOptAPI(FedAvgAPI):
+    """FedAvg outer loop with a persistent server optimizer on the
+    pseudo-gradient. ``config`` must be a FedOptConfig."""
+
+    def __init__(self, dataset: FederatedDataset, module,
+                 task: str = "classification",
+                 config: Optional[FedOptConfig] = None,
+                 delete_client: Optional[int] = None):
+        config = config or FedOptConfig()
+        super().__init__(dataset, module, task, config,
+                         delete_client=delete_client)
+        kw = {}
+        if config.server_optimizer == "sgd" and config.server_momentum:
+            kw["momentum"] = config.server_momentum
+        self._server_tx = get_server_optimizer(config.server_optimizer,
+                                               config.server_lr, **kw)
+        self.server_opt_state = self._server_tx.init(self.variables["params"])
+
+        body = self._vmapped_body
+        server_tx = self._server_tx
+
+        def round_fn(variables, opt_state, x, y, mask, keys, weights):
+            stacked, totals = body(variables, x, y, mask, keys)
+            avg = pt.tree_weighted_mean(stacked, weights)
+            # pseudo-gradient: w_old - w_avg (the server walks opposite the
+            # aggregate displacement; FedOptAggregator.py:109-123)
+            pseudo_grad = pt.tree_sub(variables["params"], avg["params"])
+            updates, opt_state = server_tx.update(pseudo_grad, opt_state,
+                                                  variables["params"])
+            new_params = optax.apply_updates(variables["params"], updates)
+            # non-param collections (BN stats) keep the plain average
+            new_vars = {**avg, "params": new_params}
+            return new_vars, opt_state, totals
+
+        self._fedopt_round_fn = jax.jit(round_fn)
+
+    def run_round(self, round_idx: int):
+        idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
+        self.variables, self.server_opt_state, stats = self._fedopt_round_fn(
+            self.variables, self.server_opt_state, x, y, mask, keys, weights)
+        return idxs, stats

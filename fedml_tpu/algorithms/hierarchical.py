@@ -1,0 +1,144 @@
+"""Hierarchical (cloud-edge-client) FedAvg — standalone simulation.
+
+Reference: fedml_api/standalone/hierarchical_fl/{trainer,group,client}.py —
+clients are randomly assigned to groups (trainer.py:10-30); each global round
+samples clients (seeded by the global round index), routes them to their
+groups, runs ``group_comm_round`` FedAvg rounds inside each group, then
+aggregates group models into the global model weighted by group sample counts
+(trainer.py:43-69, group.py:94).
+
+TPU shape: each group round is the same vmapped round program as FedAvg;
+group client sets are padded to power-of-two buckets so XLA compiles a
+handful of shapes. (The mesh variant — groups as a second mesh axis — lives
+in parallel/spmd.make_hierarchical_spmd_round.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
+                                          make_local_train)
+
+
+@dataclasses.dataclass(frozen=True)
+class HierarchicalConfig:
+    global_comm_round: int = 5
+    group_comm_round: int = 2
+    group_num: int = 2
+    group_method: str = "random"
+    client_num_per_round: int = 10
+    frequency_of_the_test: int = 5
+    seed: int = 0
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class HierarchicalFedAvgAPI:
+    def __init__(self, dataset: FederatedDataset, module,
+                 task: str = "classification",
+                 config: Optional[HierarchicalConfig] = None):
+        self.dataset = dataset
+        self.module = module
+        self.config = config or HierarchicalConfig()
+        cfg = self.config
+        if cfg.group_method != "random":
+            raise ValueError(f"unknown group_method {cfg.group_method!r}")
+        np.random.seed(cfg.seed)
+        self.group_indexes = np.random.randint(0, cfg.group_num,
+                                               dataset.client_num)
+
+        from fedml_tpu.algorithms.fedavg import make_vmapped_body
+        body = make_vmapped_body(make_local_train(module, task, cfg.train))
+
+        def round_fn(variables, x, y, mask, keys, weights):
+            stacked, totals = body(variables, x, y, mask, keys)
+            return pt.tree_weighted_mean(stacked, weights), totals
+
+        self._round_fn = jax.jit(round_fn)
+        self._eval_fn = jax.jit(make_eval(module, task))
+        self._n_pad = dataset.padded_len(cfg.train.batch_size)
+        self._base_key = jax.random.key(cfg.seed)
+        sample_x = dataset.train_data_global[0][:1]
+        self.variables = module.init(jax.random.key(cfg.seed),
+                                     jnp.asarray(sample_x), train=False)
+        self.history: List[Dict] = []
+
+    def _group_clients(self, global_round_idx: int) -> Dict[int, List[int]]:
+        sampled = sample_clients(global_round_idx, self.dataset.client_num,
+                                 self.config.client_num_per_round)
+        groups: Dict[int, List[int]] = {}
+        for c in np.asarray(sampled):
+            groups.setdefault(int(self.group_indexes[int(c)]), []).append(int(c))
+        return groups
+
+    def _train_group(self, variables, global_round_idx: int,
+                     client_idxs: List[int]):
+        """group_comm_round FedAvg rounds among this group's sampled clients
+        (zero-weight padded to a pow2 bucket to bound compile count)."""
+        cfg = self.config
+        bucket = _next_pow2(len(client_idxs))
+        padded = np.asarray(
+            client_idxs + [client_idxs[-1]] * (bucket - len(client_idxs)))
+        alive = np.concatenate([np.ones(len(client_idxs)),
+                                np.zeros(bucket - len(client_idxs))])
+        x, y, mask = self.dataset.pack_clients(padded, cfg.train.batch_size,
+                                               n_pad=self._n_pad)
+        mask = mask * alive[:, None].astype(np.float32)
+        weights = self.dataset.client_weights(padded) * alive.astype(np.float32)
+        for gr in range(cfg.group_comm_round):
+            round_key = jax.random.fold_in(
+                jax.random.fold_in(self._base_key, global_round_idx), gr)
+            keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
+                jnp.asarray(padded, dtype=jnp.uint32))
+            variables, stats = self._round_fn(
+                variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+                keys, jnp.asarray(weights))
+        return variables, float(weights.sum())
+
+    def run_global_round(self, global_round_idx: int):
+        groups = self._group_clients(global_round_idx)
+        group_vars, group_weights = [], []
+        for gidx in sorted(groups):
+            gv, gw = self._train_group(self.variables, global_round_idx,
+                                       groups[gidx])
+            group_vars.append(gv)
+            group_weights.append(gw)
+        stacked = pt.tree_stack(group_vars)
+        self.variables = pt.tree_weighted_mean(
+            stacked, jnp.asarray(group_weights, jnp.float32))
+        return groups
+
+    def train(self) -> Dict:
+        from fedml_tpu.algorithms.fedavg import _normalized
+        cfg = self.config
+        for gr in range(cfg.global_comm_round):
+            self.run_global_round(gr)
+            last = gr == cfg.global_comm_round - 1
+            if gr % cfg.frequency_of_the_test == 0 or last:
+                rec = {"round": gr}
+                xt, yt = self.dataset.test_data_global
+                if len(xt):
+                    rec.update(_normalized(self._eval_fn(
+                        self.variables, jnp.asarray(xt), jnp.asarray(yt),
+                        jnp.ones(len(xt), jnp.float32)), "test"))
+                xg, yg = self.dataset.train_data_global
+                rec.update(_normalized(self._eval_fn(
+                    self.variables, jnp.asarray(xg), jnp.asarray(yg),
+                    jnp.ones(len(xg), jnp.float32)), "train"))
+                self.history.append(rec)
+        return self.history[-1] if self.history else {}

@@ -17,7 +17,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.core import pytree as pt
 
 _NON_WEIGHT_MARKERS = ("running_mean", "running_var", "num_batches_tracked",
                        "batch_stats", "mean", "var")

@@ -80,6 +80,26 @@ def make_forward(module) -> Callable:
     return forward
 
 
+def make_batch_schedule(n_pad: int, epochs: int, bsz: int, shuffle: bool,
+                        rng):
+    """Shared epochs×batches schedule: per-epoch permutations reshaped to
+    [epochs*nb, bsz] index batches plus one dropout key per step. Used by the
+    FedAvg local trainer and custom local trainers (FedNova) so shuffle
+    semantics cannot diverge."""
+    assert n_pad % bsz == 0, "data must be padded to a batch multiple"
+    nb = n_pad // bsz
+    perm_key, step_key = jax.random.split(rng)
+    epoch_keys = jax.random.split(perm_key, epochs)
+    if shuffle:
+        perms = jnp.stack(
+            [jax.random.permutation(k, n_pad) for k in epoch_keys])
+    else:
+        perms = jnp.tile(jnp.arange(n_pad), (epochs, 1))
+    batch_idx = perms.reshape(epochs * nb, bsz)
+    step_keys = jax.random.split(step_key, epochs * nb)
+    return batch_idx, step_keys
+
+
 def make_local_train(module, task: str, cfg: TrainConfig):
     """Build ``local_train(variables, x, y, mask, rng) -> (variables, stats)``.
 
@@ -95,19 +115,8 @@ def make_local_train(module, task: str, cfg: TrainConfig):
     def local_train(variables, x, y, mask, rng):
         n_pad = x.shape[0]
         bsz = cfg.batch_size or n_pad
-        assert n_pad % bsz == 0, "data must be padded to a batch multiple"
-        nb = n_pad // bsz
-
-        perm_key, step_key = jax.random.split(rng)
-        epoch_keys = jax.random.split(perm_key, cfg.epochs)
-        if cfg.shuffle:
-            perms = jnp.stack(
-                [jax.random.permutation(k, n_pad) for k in epoch_keys])
-        else:
-            perms = jnp.tile(jnp.arange(n_pad), (cfg.epochs, 1))
-        batch_idx = perms.reshape(cfg.epochs * nb, bsz)
-        step_keys = jax.random.split(step_key, cfg.epochs * nb)
-
+        batch_idx, step_keys = make_batch_schedule(n_pad, cfg.epochs, bsz,
+                                                   cfg.shuffle, rng)
         params = variables["params"]
         opt_state = tx.init(params)
         init = (params, {k: v for k, v in variables.items() if k != "params"},
