@@ -16,4 +16,28 @@ def create_model(model_name: str, output_dim: int = 10, **kw):
         return LogisticRegression(num_classes=output_dim)
     if model_name == "cnn":
         return CNN_DropOut(only_digits=(output_dim == 10))
+    if model_name in ("resnet18_gn", "resnet18"):
+        from fedml_tpu.models.resnet_gn import resnet18_gn
+        return resnet18_gn(num_classes=output_dim, **kw)
+    if model_name == "resnet56":
+        from fedml_tpu.models.resnet import resnet56
+        return resnet56(num_classes=output_dim, **kw)
+    if model_name == "resnet110":
+        from fedml_tpu.models.resnet import resnet110
+        return resnet110(num_classes=output_dim, **kw)
+    if model_name == "mobilenet":
+        from fedml_tpu.models.mobilenet import MobileNet
+        return MobileNet(num_classes=output_dim, **kw)
+    if model_name == "mobilenet_v3":
+        from fedml_tpu.models.mobilenet_v3 import MobileNetV3
+        return MobileNetV3(num_classes=output_dim, **kw)
+    if model_name == "rnn":
+        from fedml_tpu.models.rnn import RNN_OriginalFedAvg
+        return RNN_OriginalFedAvg(**kw)
+    if model_name == "rnn_stackoverflow":
+        from fedml_tpu.models.rnn import RNN_StackOverflow
+        return RNN_StackOverflow(**kw)
+    if model_name in ("vgg11", "vgg13", "vgg16", "vgg19"):
+        from fedml_tpu.models.vgg import VGG
+        return VGG(arch=model_name, num_classes=output_dim, **kw)
     raise ValueError(f"unknown model: {model_name!r}")

@@ -1,0 +1,13 @@
+"""Shared building blocks for the model zoo."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+def bn(train: bool) -> nn.BatchNorm:
+    """The zoo-wide BatchNorm configuration (torch defaults: momentum 0.1 ->
+    flax momentum 0.9, eps 1e-5), running stats in the ``batch_stats``
+    collection, frozen in eval mode."""
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                        epsilon=1e-5)

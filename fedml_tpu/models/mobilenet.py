@@ -1,0 +1,56 @@
+"""MobileNet v1 (depthwise-separable), CIFAR variant.
+
+Reference: fedml_api/model/cv/mobilenet.py:60 — stride-1 stem for 32x32
+inputs, 3x3 depthwise + 1x1 pointwise blocks with BN+ReLU after each, width
+multiplier alpha, channel ladder 32-64-128-256-512(x5)-1024, global average
+pool + linear head (the cross-silo CIFAR/CINIC benchmark model,
+benchmark rows 108-110).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.common import bn
+
+
+class DepthwiseSeparable(nn.Module):
+    out_channels: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda: bn(train)
+        in_ch = x.shape[-1]
+        # depthwise: feature_group_count == in_channels
+        x = nn.Conv(in_ch, (3, 3), strides=(self.stride, self.stride),
+                    padding=1, feature_group_count=in_ch, use_bias=False)(x)
+        x = nn.relu(norm()(x))
+        x = nn.Conv(self.out_channels, (1, 1), use_bias=False)(x)
+        return nn.relu(norm()(x))
+
+
+class MobileNet(nn.Module):
+    num_classes: int = 100
+    width_multiplier: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.width_multiplier
+        ch = lambda c: int(c * a)
+        norm = bn(train)
+        x = nn.Conv(ch(32), (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(norm(x))
+        x = DepthwiseSeparable(ch(64))(x, train)
+        x = DepthwiseSeparable(ch(128), stride=2)(x, train)
+        x = DepthwiseSeparable(ch(128))(x, train)
+        x = DepthwiseSeparable(ch(256), stride=2)(x, train)
+        x = DepthwiseSeparable(ch(256))(x, train)
+        x = DepthwiseSeparable(ch(512), stride=2)(x, train)
+        for _ in range(5):
+            x = DepthwiseSeparable(ch(512))(x, train)
+        x = DepthwiseSeparable(ch(1024), stride=2)(x, train)
+        x = DepthwiseSeparable(ch(1024))(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,135 @@
+"""MobileNetV3 (LARGE / SMALL) with squeeze-excite and h-swish.
+
+Reference: fedml_api/model/cv/mobilenet_v3.py:137 ``MobileNetV3``:
+inverted-residual bottlenecks with per-block expand size, optional SE module
+(reduction 4, hard-sigmoid gate), ReLU or h-swish nonlinearity, width
+multiplier, dropout before the classifier. NB: the LARGE table here follows
+the paper (Howard et al., arXiv:1905.02244, Table 1) — the reference file's
+last 160-stage differs slightly from the paper (stride-2 on its second block
+with exp 672/672/960 instead of the paper's first-block stride-2 with
+672/960/960); we keep the paper layout, so reference checkpoints for that
+stage would not map 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.common import bn
+
+# (kernel, exp_size, out_ch, SE, nonlinearity, stride) per block
+LARGE: Sequence[Tuple] = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2),
+    (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1),
+    (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2),
+    (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+]
+SMALL: Sequence[Tuple] = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1),
+    (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2),
+    (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+]
+
+
+def hswish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+def hsigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def act(name: str):
+    return hswish if name == "hswish" else nn.relu
+
+
+class SqueezeExcite(nn.Module):
+    reduction: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(1, ch // self.reduction))(s))
+        s = hsigmoid(nn.Dense(ch)(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    kernel: int
+    exp_size: int
+    out_channels: int
+    se: bool
+    nonlinearity: str
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda: bn(train)
+        fn = act(self.nonlinearity)
+        identity = x
+        out = nn.Conv(self.exp_size, (1, 1), use_bias=False)(x)
+        out = fn(norm()(out))
+        out = nn.Conv(self.exp_size, (self.kernel, self.kernel),
+                      strides=(self.stride, self.stride),
+                      padding=self.kernel // 2,
+                      feature_group_count=self.exp_size, use_bias=False)(out)
+        out = fn(norm()(out))
+        if self.se:
+            out = SqueezeExcite()(out)
+        out = nn.Conv(self.out_channels, (1, 1), use_bias=False)(out)
+        out = norm()(out)
+        if self.stride == 1 and x.shape[-1] == self.out_channels:
+            out = out + identity
+        return out
+
+
+class MobileNetV3(nn.Module):
+    num_classes: int = 1000
+    model_mode: str = "LARGE"
+    multiplier: float = 1.0
+    dropout_rate: float = 0.0
+    small_images: bool = True  # stride-1 stem for CIFAR-size inputs
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = LARGE if self.model_mode.upper() == "LARGE" else SMALL
+        m = self.multiplier
+        norm = lambda: bn(train)
+        stem_stride = 1 if self.small_images else 2
+        x = nn.Conv(int(16 * m), (3, 3), strides=(stem_stride, stem_stride),
+                    padding=1, use_bias=False)(x)
+        x = hswish(norm()(x))
+        for k, exp, out, se, nl, s in cfg:
+            x = InvertedResidual(k, int(exp * m), int(out * m), se, nl,
+                                 s)(x, train=train)
+        last_exp = int((960 if cfg is LARGE else 576) * m)
+        x = nn.Conv(last_exp, (1, 1), use_bias=False)(x)
+        x = hswish(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = hswish(nn.Dense(1280 if cfg is LARGE else 1024)(x))
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,75 @@
+"""CIFAR ResNet-56/110 (bottleneck), flax/NHWC.
+
+Reference: fedml_api/model/cv/resnet.py — CIFAR-style stem (3x3 conv, 16
+channels, no maxpool), three stages at 16/32/64 planes with Bottleneck blocks
+(expansion 4) of depth [6,6,6] (resnet56, :202) / [12,12,12] (resnet110,
+:225), BatchNorm throughout, global average pool, linear head. The ``kd``
+flag returns (features, logits) — used by GKT/knowledge-distillation setups
+(resnet.py forward, KD branch).
+
+BatchNorm runs through flax's ``batch_stats`` collection; the trainer treats
+any non-``params`` collection as mutable in train mode and FedAvg aggregates
+it like the reference averages the full state_dict.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.common import bn
+
+
+class BottleneckBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda: bn(train)
+        identity = x
+        out = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                      padding=1, use_bias=False)(out)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False)(out)
+        out = norm()(out)
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            identity = nn.Conv(self.planes * self.expansion, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False)(x)
+            identity = norm()(identity)
+        return nn.relu(out + identity)
+
+
+class CifarResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    kd: bool = False  # return (features, logits) for distillation
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(bn(train)(x))
+        for stage, blocks in enumerate(self.stage_sizes):
+            planes = 16 * (2 ** stage)
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = BottleneckBlock(planes, stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = nn.Dense(self.num_classes)(x)
+        if self.kd:
+            return x, logits
+        return logits
+
+
+def resnet56(num_classes: int = 10, **kw) -> CifarResNet:
+    return CifarResNet(stage_sizes=[6, 6, 6], num_classes=num_classes, **kw)
+
+
+def resnet110(num_classes: int = 10, **kw) -> CifarResNet:
+    return CifarResNet(stage_sizes=[12, 12, 12], num_classes=num_classes, **kw)

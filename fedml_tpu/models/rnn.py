@@ -1,0 +1,53 @@
+"""LSTM language models for the federated text benchmarks.
+
+Reference: fedml_api/model/nlp/rnn.py —
+- ``RNN_OriginalFedAvg`` (:5): the McMahan et al. AISTATS'17 Shakespeare
+  char-LM: embedding(8, pad=0) -> 2x LSTM(256) -> dense(vocab 90). LEAF
+  shakespeare predicts the single next char from the final hidden state;
+  the TFF ``fed_shakespeare`` variant scores every position
+  (``seq_output=True``, the commented branch in the reference forward).
+- ``RNN_StackOverflow`` (:41): Adaptive Federated Optimization Table 9
+  next-word model: embedding(96, extended vocab 10000+4 for pad/bos/eos/oov,
+  pad=0) -> LSTM(670) -> dense(96) -> dense(extended vocab), scoring every
+  position.
+
+Both run the LSTM as ``nn.RNN`` (a lax.scan over OptimizedLSTMCell) with
+fresh zero carries per batch, matching the reference's stateless batches.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class RNN_OriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+    seq_output: bool = False  # True for fed_shakespeare (score every step)
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embedding_dim)(input_seq)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(x)
+        if not self.seq_output:
+            x = x[:, -1]
+        return nn.Dense(self.vocab_size)(x)
+
+
+class RNN_StackOverflow(nn.Module):
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+    num_layers: int = 1
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False):
+        extended = self.vocab_size + 3 + self.num_oov_buckets
+        x = nn.Embed(extended, self.embedding_size)(input_seq)
+        for _ in range(self.num_layers):
+            x = nn.RNN(nn.OptimizedLSTMCell(self.latent_size))(x)
+        x = nn.Dense(self.embedding_size)(x)
+        return nn.Dense(extended)(x)  # [B, T, extended_vocab]

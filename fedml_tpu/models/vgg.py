@@ -1,0 +1,54 @@
+"""VGG-11/13/16/19 with optional BatchNorm.
+
+Reference: fedml_api/model/cv/vgg.py:13-159 — the torchvision config-letter
+construction ('A'/'B'/'D'/'E' channel lists with 'M' maxpools) and factory
+functions vgg11..vgg19_bn. CIFAR-sized head: the flattened features feed a
+4096-4096-classes classifier with dropout.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+
+from fedml_tpu.models.common import bn
+
+CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+ARCH_TO_CFG = {"vgg11": "A", "vgg13": "B", "vgg16": "D", "vgg19": "E"}
+
+
+class VGG(nn.Module):
+    arch: str = "vgg11"
+    num_classes: int = 10
+    batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.shape[1] < 32 or x.shape[2] < 32:
+            raise ValueError(
+                f"VGG needs inputs >= 32x32 (five 2x2 maxpools); got "
+                f"{x.shape[1]}x{x.shape[2]}")
+        cfg: Sequence[Union[int, str]] = CFGS[ARCH_TO_CFG[self.arch]]
+        for v in cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding=1)(x)
+                if self.batch_norm:
+                    x = bn(train)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,181 @@
+"""Model-zoo tests: output shapes, parameter counts against the reference's
+documented numbers, BatchNorm state flowing through vmapped FedAvg rounds,
+and the sequence/tag task heads driving the RNN models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.pytree import tree_size
+from fedml_tpu.models import create_model
+
+
+def init_and_apply(model, x, train=False):
+    variables = model.init(jax.random.key(0), x, train=False)
+    if train:
+        mutable = [k for k in variables if k != "params"]
+        out, _ = model.apply(variables, x, train=True,
+                             rngs={"dropout": jax.random.key(1)},
+                             mutable=mutable)
+    else:
+        out = model.apply(variables, x, train=False)
+    return variables, out
+
+
+class TestShapes:
+    def test_cnn_param_count_matches_reference(self):
+        # reference cv/cnn.py docstring: 1,199,882 params for 10 classes
+        model = create_model("cnn", output_dim=10)
+        variables, out = init_and_apply(model, jnp.zeros((2, 28, 28, 1)))
+        assert tree_size(variables["params"]) == 1_199_882
+        assert out.shape == (2, 10)
+
+    def test_resnet56_and_110(self):
+        x = jnp.zeros((2, 32, 32, 3))
+        for name, blocks, shortcut_convs in [("resnet56", 18, 3),
+                                             ("resnet110", 36, 3)]:
+            model = create_model(name, output_dim=10)
+            variables, out = init_and_apply(model, x)
+            assert out.shape == (2, 10)
+            assert "batch_stats" in variables
+            conv_kernels = {
+                "/".join(str(getattr(k, "key", k)) for k in path)
+                for path, _ in jax.tree_util.tree_flatten_with_path(
+                    variables["params"])[0]
+                if "Conv" in str(path)}
+            n_convs = len({p.rsplit("/", 1)[0] for p in conv_kernels})
+            # stem + 3 convs per bottleneck + per-stage shortcut 1x1s
+            assert n_convs == 1 + 3 * blocks + shortcut_convs, (name, n_convs)
+
+    def test_resnet56_kd_returns_features(self):
+        model = create_model("resnet56", output_dim=10, kd=True)
+        variables = model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)),
+                               train=False)
+        feats, logits = model.apply(variables, jnp.zeros((2, 32, 32, 3)),
+                                    train=False)
+        assert feats.shape == (2, 64 * 4)
+        assert logits.shape == (2, 10)
+
+    def test_resnet18_gn_no_mutable_state(self):
+        model = create_model("resnet18_gn", output_dim=100)
+        variables, out = init_and_apply(model, jnp.zeros((2, 24, 24, 3)))
+        assert out.shape == (2, 100)
+        assert set(variables) == {"params"}  # GN: no running stats
+
+    def test_mobilenet_v1(self):
+        model = create_model("mobilenet", output_dim=100)
+        variables, out = init_and_apply(model, jnp.zeros((2, 32, 32, 3)))
+        assert out.shape == (2, 100)
+        # ~3.2M params at width 1.0 for 100 classes (torch: 3,305,348)
+        assert 3.0e6 < tree_size(variables["params"]) < 3.6e6
+
+    def test_mobilenet_v3_modes(self):
+        for mode in ["LARGE", "SMALL"]:
+            model = create_model("mobilenet_v3", output_dim=10,
+                                 model_mode=mode)
+            variables, out = init_and_apply(model, jnp.zeros((1, 32, 32, 3)))
+            assert out.shape == (1, 10)
+
+    def test_vgg11(self):
+        model = create_model("vgg11", output_dim=10)
+        variables, out = init_and_apply(model, jnp.zeros((1, 32, 32, 3)))
+        assert out.shape == (1, 10)
+
+    def test_rnn_shakespeare_variants(self):
+        seq = jnp.zeros((3, 20), jnp.int32)
+        model = create_model("rnn")  # LEAF: next-char from final state
+        variables, out = init_and_apply(model, seq)
+        assert out.shape == (3, 90)
+        model2 = create_model("rnn", seq_output=True)  # fed_shakespeare
+        _, out2 = init_and_apply(model2, seq)
+        assert out2.shape == (3, 20, 90)
+
+    def test_rnn_stackoverflow(self):
+        seq = jnp.zeros((2, 12), jnp.int32)
+        model = create_model("rnn_stackoverflow")
+        variables, out = init_and_apply(model, seq)
+        assert out.shape == (2, 12, 10004)  # vocab 10000 + pad/bos/eos/oov
+
+    def test_unknown_model_raises(self):
+        with pytest.raises(ValueError):
+            create_model("alexnet")
+
+
+class TestBatchNormThroughFedAvg:
+    def test_batch_stats_trained_and_aggregated(self):
+        # a BN model's running stats must update during local training and
+        # average across clients (the reference averages the full state_dict)
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+        from fedml_tpu.data.base import FederatedDataset
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        rng = np.random.RandomState(0)
+        clients = {}
+        for c in range(3):
+            y = rng.randint(0, 10, 24).astype(np.int32)
+            x = rng.randn(24, 32, 32, 3).astype(np.float32) + c
+            clients[c] = (x, y)
+        ds = FederatedDataset.from_client_arrays(
+            clients, {c: None for c in clients}, 10)
+        model = create_model("resnet56", output_dim=10)
+        api = FedAvgAPI(ds, model, config=FedAvgConfig(
+            comm_round=1, client_num_per_round=3, frequency_of_the_test=100,
+            train=TrainConfig(epochs=1, batch_size=8, lr=0.01)))
+        before = api.variables["batch_stats"]
+        api.run_round(0)
+        after = api.variables["batch_stats"]
+        changed = any(
+            not np.allclose(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)))
+        assert changed, "BN running stats did not update through the round"
+
+    def test_robust_defense_skips_bn_stats(self):
+        # weak-DP noise must leave batch_stats untouched even on a BN model
+        from fedml_tpu.core.robust import add_weak_dp_noise
+        model = create_model("resnet56", output_dim=10)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                               train=False)
+        noised = add_weak_dp_noise(variables, 0.5, jax.random.key(1))
+        for a, b in zip(jax.tree.leaves(noised["batch_stats"]),
+                        jax.tree.leaves(variables["batch_stats"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestSequenceTasks:
+    def test_nwp_head_with_stackoverflow_rnn(self):
+        from fedml_tpu.trainer.functional import TrainConfig, make_local_train
+        model = create_model("rnn_stackoverflow", vocab_size=50,
+                             latent_size=32, embedding_size=16)
+        T = 10
+        rng = np.random.RandomState(0)
+        x = rng.randint(1, 54, (16, T)).astype(np.int32)
+        x[:, -2:] = 0  # padded token tail
+        y = np.roll(x, -1, axis=1)
+        fn = make_local_train(model, "nwp",
+                              TrainConfig(epochs=1, batch_size=8, lr=0.5,
+                                          shuffle=False))
+        variables = model.init(jax.random.key(0), jnp.asarray(x[:1]),
+                               train=False)
+        new_vars, stats = fn(variables, jnp.asarray(x), jnp.asarray(y),
+                             jnp.ones(16, jnp.float32), jax.random.key(1))
+        # token accounting: pad targets excluded
+        n_real_tokens = int((y != 0).sum())
+        assert float(stats["count"]) == n_real_tokens
+        assert np.isfinite(float(stats["loss_sum"]))
+
+    def test_tag_prediction_head_multilabel(self):
+        from fedml_tpu.trainer.functional import TrainConfig, make_local_train
+        from fedml_tpu.models.lr import LogisticRegression
+        model = LogisticRegression(num_classes=8)
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 20).astype(np.float32)
+        y = (rng.rand(32, 8) > 0.7).astype(np.float32)
+        fn = make_local_train(model, "tag_prediction",
+                              TrainConfig(epochs=3, batch_size=16, lr=0.5,
+                                          shuffle=False))
+        variables = model.init(jax.random.key(0), jnp.asarray(x[:1]))
+        new_vars, stats = fn(variables, jnp.asarray(x), jnp.asarray(y),
+                             jnp.ones(32, jnp.float32), jax.random.key(1))
+        assert {"precision_sum", "recall_sum"} <= set(stats)
+        assert float(stats["loss_sum"]) < float(stats["count"])  # learned some
