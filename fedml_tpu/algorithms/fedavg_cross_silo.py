@@ -1,0 +1,256 @@
+"""Distributed FedAvg over the message layer — the cross-silo path.
+
+When clients are separate trust domains / hosts (no shared mesh), the round
+cannot be one SPMD program; it is the reference's actor protocol
+(fedml_api/distributed/fedavg/): server broadcasts the global model, each
+client runs local training and sends back ``(model_params, num_samples)``,
+the server aggregates when all have arrived and starts the next round.
+
+Parity map:
+- message schema  -> reference message_define.py:1-31 (same 4 types)
+- FedAvgAggregator -> FedAVGAggregator.py:13-107 (all-received barrier,
+  sample-weighted average, per-round seeded sampling)
+- FedAvgServerManager / FedAvgClientManager -> FedAvgServerManager.py:18-93,
+  FedAvgClientManager.py:18-71 — minus the off-by-one Abort shutdown quirk;
+  here the server sends an explicit FINISH message.
+
+TPU-first deltas: each silo's local training is the jitted
+``make_local_train`` program (scan over epochs x batches on its own chip) —
+if a silo packs several virtual clients they are vmapped; aggregation is a
+jitted weighted tree-mean on the server's device; transport frames are the
+zero-copy codec, not pickled dicts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.comm import (ClientManager, Message, ServerManager,
+                            create_comm_manager)
+from fedml_tpu.comm.inproc import InProcRouter
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.trainer.functional import TrainConfig, make_eval, make_local_train
+
+# -- message schema (reference message_define.py) ---------------------------
+MSG_TYPE_S2C_INIT_CONFIG = 1
+MSG_TYPE_S2C_SYNC_MODEL = 2
+MSG_TYPE_S2C_FINISH = 3
+MSG_TYPE_C2S_SEND_MODEL = 4
+
+MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
+MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
+MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
+MSG_ARG_KEY_ROUND = "round_idx"
+
+
+def _to_numpy(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+class FedAvgAggregator:
+    """Server state machine: collect worker results, barrier, aggregate.
+
+    Reference: FedAVGAggregator.py — ``add_local_trained_result`` (:44),
+    ``check_whether_all_receive`` (:50), ``aggregate`` (:58), seeded
+    ``client_sampling`` (:89)."""
+
+    def __init__(self, worker_num: int, aggregate_fn=None):
+        self.worker_num = worker_num
+        self.model_dict: Dict[int, object] = {}
+        self.sample_num_dict: Dict[int, float] = {}
+        self.flag_client_model_uploaded = [False] * worker_num
+        self._aggregate = jax.jit(aggregate_fn or pt.tree_weighted_mean)
+
+    def add_local_trained_result(self, worker_idx: int, model_params,
+                                 sample_num: float) -> None:
+        self.model_dict[worker_idx] = model_params
+        self.sample_num_dict[worker_idx] = sample_num
+        self.flag_client_model_uploaded[worker_idx] = True
+
+    def check_whether_all_receive(self) -> bool:
+        if all(self.flag_client_model_uploaded):
+            self.flag_client_model_uploaded = [False] * self.worker_num
+            return True
+        return False
+
+    def aggregate(self):
+        stacked = pt.tree_stack(
+            [self.model_dict[i] for i in range(self.worker_num)])
+        weights = jnp.asarray(
+            [self.sample_num_dict[i] for i in range(self.worker_num)],
+            jnp.float32)
+        return self._aggregate(stacked, weights)
+
+    def client_sampling(self, round_idx: int, client_num_in_total: int,
+                        client_num_per_round: int) -> np.ndarray:
+        return sample_clients(round_idx, client_num_in_total,
+                              client_num_per_round)
+
+
+class FedAvgServerManager(ServerManager):
+    def __init__(self, rank: int, size: int, com_manager,
+                 aggregator: FedAvgAggregator, comm_round: int,
+                 client_num_in_total: int, global_model,
+                 on_round_done=None):
+        super().__init__(rank, size, com_manager)
+        self.aggregator = aggregator
+        self.comm_round = comm_round
+        self.client_num_in_total = client_num_in_total
+        self.global_model = global_model
+        self.round_idx = 0
+        self.on_round_done = on_round_done
+        self.worker_num = size - 1
+
+    def send_init_msg(self) -> None:
+        idxs = self.aggregator.client_sampling(
+            0, self.client_num_in_total, self.worker_num)
+        payload = _to_numpy(self.global_model)
+        for worker in range(1, self.size):
+            msg = Message(MSG_TYPE_S2C_INIT_CONFIG, self.rank, worker)
+            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
+            msg.add(MSG_ARG_KEY_ROUND, 0)
+            self.send_message(msg)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_SEND_MODEL,
+            self.handle_message_receive_model_from_client)
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        worker = msg.get_sender_id() - 1
+        self.aggregator.add_local_trained_result(
+            worker, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+            msg.get(MSG_ARG_KEY_NUM_SAMPLES))
+        if not self.aggregator.check_whether_all_receive():
+            return
+        self.global_model = self.aggregator.aggregate()
+        if self.on_round_done is not None:
+            self.on_round_done(self.round_idx, self.global_model)
+        self.round_idx += 1
+        if self.round_idx == self.comm_round:
+            for worker in range(1, self.size):
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
+            self.finish()
+            return
+        idxs = self.aggregator.client_sampling(
+            self.round_idx, self.client_num_in_total, self.worker_num)
+        payload = _to_numpy(self.global_model)
+        for worker in range(1, self.size):
+            msg = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, worker)
+            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
+            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(msg)
+
+
+class FedAvgClientManager(ClientManager):
+    """A silo: receives the global model, re-points at its sampled client's
+    shard (client virtualization — reference FedAVGTrainer.update_dataset),
+    runs the jitted local program, ships (params, n_i) back."""
+
+    def __init__(self, rank: int, size: int, com_manager,
+                 dataset: FederatedDataset, module, task: str,
+                 train_cfg: TrainConfig, seed: int = 0):
+        super().__init__(rank, size, com_manager)
+        self.dataset = dataset
+        self._local_train = jax.jit(make_local_train(module, task, train_cfg))
+        self._n_pad = dataset.padded_len(train_cfg.batch_size)
+        self._bsz = train_cfg.batch_size
+        self._base_key = jax.random.key(seed)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_SYNC_MODEL, self.handle_message_init)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_FINISH, lambda msg: self.finish())
+
+    def handle_message_init(self, msg: Message) -> None:
+        client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
+        round_idx = msg.get(MSG_ARG_KEY_ROUND)
+        variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        x, y, mask = self.dataset.pack_clients([client_idx], self._bsz,
+                                               n_pad=self._n_pad)
+        key = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, round_idx), client_idx)
+        new_vars, _ = self._local_train(
+            variables, jnp.asarray(x[0]), jnp.asarray(y[0]),
+            jnp.asarray(mask[0]), key)
+        n_i = float(self.dataset.train_data_local_num_dict[int(client_idx)])
+        reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        reply.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))
+        reply.add(MSG_ARG_KEY_NUM_SAMPLES, n_i)
+        self.send_message(reply)
+
+
+def run_fedavg_cross_silo(dataset: FederatedDataset, module,
+                          task: str = "classification",
+                          worker_num: int = 2, comm_round: int = 2,
+                          train_cfg: Optional[TrainConfig] = None,
+                          backend: str = "INPROC",
+                          addresses=None, wire_codec: bool = True):
+    """Launch server + ``worker_num`` client actors (threads; one per silo)
+    and run the full protocol. Returns (final global model, round history).
+
+    The reference's equivalent is `mpirun -np worker_num+1 main_fedavg.py`
+    (FedAvgAPI.py:20-67 rank dispatch); here ranks are threads over the
+    selected backend, so the same protocol code also drives TCP/GRPC
+    processes for true multi-host runs.
+    """
+    train_cfg = train_cfg or TrainConfig()
+    size = worker_num + 1
+    router = InProcRouter() if backend.upper() in ("INPROC", "MPI") else None
+
+    sample_x = dataset.train_data_global[0][:1]
+    global_model = module.init(jax.random.key(0), jnp.asarray(sample_x),
+                               train=False)
+    history: List[Dict] = []
+    eval_fn = jax.jit(make_eval(module, task))
+
+    def on_round_done(round_idx, model):
+        xt, yt = dataset.test_data_global
+        if len(xt):
+            stats = eval_fn(model, jnp.asarray(xt), jnp.asarray(yt),
+                            jnp.ones(len(xt), jnp.float32))
+            history.append({
+                "round": round_idx,
+                "test_acc": float(stats["correct_sum"]) /
+                max(1.0, float(stats["count"])),
+                "test_loss": float(stats["loss_sum"]) /
+                max(1.0, float(stats["count"])),
+            })
+
+    aggregator = FedAvgAggregator(worker_num)
+    server_com = create_comm_manager(backend, 0, size, router=router,
+                                     addresses=addresses,
+                                     wire_codec=wire_codec)
+    server = FedAvgServerManager(0, size, server_com, aggregator, comm_round,
+                                 dataset.client_num, global_model,
+                                 on_round_done=on_round_done)
+    clients = []
+    for rank in range(1, size):
+        com = create_comm_manager(backend, rank, size, router=router,
+                                  addresses=addresses, wire_codec=wire_codec)
+        clients.append(FedAvgClientManager(rank, size, com, dataset, module,
+                                           task, train_cfg))
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    server_thread = threading.Thread(target=server.run, daemon=True)
+    for t in threads:
+        t.start()
+    server_thread.start()
+    server.send_init_msg()
+    server_thread.join(timeout=600)
+    for t in threads:
+        t.join(timeout=60)
+    return server.global_model, history

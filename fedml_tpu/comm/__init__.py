@@ -1,0 +1,31 @@
+"""Cross-silo communication layer (reference L1: fedml_core/distributed/communication).
+
+Intra-slice federated rounds need no messages at all — they compile to XLA
+collectives over ICI (fedml_tpu/parallel/spmd.py). This package exists for the
+cases where collectives cannot reach: different trust domains (cross-silo FL),
+different hosts without a shared mesh, and on-device/mobile-style actors. It
+keeps the reference's contracts (Message / Observer /
+BaseCommunicationManager / ClientManager / ServerManager — SURVEY §1 L1/L2)
+so algorithm protocol code is backend-agnostic, and replaces the reference's
+three transports (mpi4py / gRPC-with-hardcoded-IPs / MQTT) with:
+
+- ``inproc``  — zero-copy in-process router (tests, standalone multi-actor)
+- ``tcp``     — length-prefixed framed sockets, cross-host
+- ``grpc``    — insecure-channel gRPC with addresses from config, wire-
+                compatible in spirit with the reference proto
+                (gRPC/proto/grpc_comm_manager.proto)
+
+Payloads are pytrees of arrays serialized with a zero-copy binary codec
+(fedml_tpu/comm/serialization.py) rather than pickled dicts (the reference's
+MPI path) or JSON-ified float lists (its MQTT path).
+"""
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.manager import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.registry import create_comm_manager
+
+__all__ = [
+    "BaseCommunicationManager", "Observer", "Message", "ClientManager",
+    "ServerManager", "create_comm_manager",
+]

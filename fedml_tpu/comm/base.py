@@ -1,0 +1,46 @@
+"""Backend and observer ABCs (reference base_com_manager.py:7-27,
+observer.py:4-7)."""
+
+from __future__ import annotations
+
+import abc
+
+from fedml_tpu.comm.message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    """A transport endpoint for one rank. Backends deliver inbound messages
+    by invoking every registered observer (the reference's notify pattern,
+    mpi com_manager.py:80-83)."""
+
+    def __init__(self) -> None:
+        self._observers = []
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Block, dispatching inbound messages to observers, until stopped."""
+        ...
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None:
+        ...

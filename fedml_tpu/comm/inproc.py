@@ -1,0 +1,66 @@
+"""In-process backend: a queue per rank inside one shared router.
+
+Replaces the reference's localhost-MPI testing setup (``hostname >
+mpi_host_file; mpirun -np N`` — run_fedavg_distributed_pytorch.sh:19-22) for
+simulation and tests: ranks are threads, delivery is a queue hand-off of the
+*same* Message object (no serialization), and there is no 0.3 s poll — the
+receive loop blocks on the queue (the reference polls at
+mpi/com_manager.py:78).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.message import Message
+
+_STOP = object()
+
+
+class InProcRouter:
+    """Shared mailbox fabric for one simulated federation."""
+
+    def __init__(self) -> None:
+        self._queues: Dict[int, "queue.Queue"] = {}
+        self._lock = threading.Lock()
+
+    def mailbox(self, rank: int) -> "queue.Queue":
+        with self._lock:
+            if rank not in self._queues:
+                self._queues[rank] = queue.Queue()
+            return self._queues[rank]
+
+
+class InProcCommManager(BaseCommunicationManager):
+    def __init__(self, router: InProcRouter, rank: int, size: int,
+                 wire_codec: bool = False):
+        """``wire_codec=True`` round-trips every message through the binary
+        codec (send = to_bytes, deliver = from_bytes) so protocol tests also
+        exercise serialization exactly as the socket backends do."""
+        super().__init__()
+        self.router = router
+        self.rank = rank
+        self.size = size
+        self.wire_codec = wire_codec
+        self._inbox = router.mailbox(rank)
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        payload = msg.to_bytes() if self.wire_codec else msg
+        self.router.mailbox(msg.get_receiver_id()).put(payload)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            item = self._inbox.get()
+            if item is _STOP:
+                break
+            msg = Message.from_bytes(item) if isinstance(item, bytes) else item
+            self._notify(msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._inbox.put(_STOP)

@@ -1,0 +1,36 @@
+"""Backend selection by string — reference ``--backend MPI|GRPC|MQTT``
+switch (client_manager.py:22-35) re-keyed to the TPU-era transports."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.inproc import InProcCommManager, InProcRouter
+
+
+def create_comm_manager(
+        backend: str, rank: int, size: int,
+        router: Optional[InProcRouter] = None,
+        addresses: Optional[Dict[int, Tuple[str, int]]] = None,
+        wire_codec: bool = False) -> BaseCommunicationManager:
+    """``backend``: "INPROC" (simulation/tests), "TCP" (framed sockets,
+    cross-host), "GRPC" (cross-silo RPC). The reference's "MPI" maps to
+    INPROC for single-host and TCP for multi-host; its "MQTT" mobile path is
+    served by GRPC/TCP (no broker dependency in this environment)."""
+    key = backend.upper()
+    if key in ("INPROC", "MPI"):
+        if router is None:
+            raise ValueError("INPROC backend needs a shared InProcRouter")
+        return InProcCommManager(router, rank, size, wire_codec=wire_codec)
+    if key == "TCP":
+        if addresses is None:
+            raise ValueError("TCP backend needs {rank: (host, port)}")
+        from fedml_tpu.comm.tcp import TcpCommManager
+        return TcpCommManager(rank, addresses)
+    if key in ("GRPC", "MQTT"):
+        if addresses is None:
+            raise ValueError("GRPC backend needs {rank: (host, port)}")
+        from fedml_tpu.comm.grpc_backend import GrpcCommManager
+        return GrpcCommManager(rank, addresses)
+    raise ValueError(f"unknown backend: {backend!r}")

@@ -30,3 +30,12 @@ jax.config.update("jax_platforms", "cpu")
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def small_dataset():
+    """A tiny blob federation shared across protocol tests."""
+    from fedml_tpu.data.synthetic import make_blob_federated
+
+    return make_blob_federated(client_num=4, dim=8, class_num=4,
+                               n_samples=160, seed=3)

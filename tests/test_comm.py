@@ -1,0 +1,131 @@
+"""Comm layer: codec round-trips, backends, cross-silo FedAvg protocol.
+
+Oracle strategy (SURVEY §4): the distributed protocol must produce the SAME
+global model as the standalone simulation under the same seeds — the
+reference's reproducibility-as-test-oracle hook, applied across execution
+paradigms instead of across implementations.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import Message, create_comm_manager
+from fedml_tpu.comm import serialization
+from fedml_tpu.comm.inproc import InProcRouter
+
+
+def tree_close(a, b, **kw):
+    import jax
+    flat_a, def_a = jax.tree.flatten(a)
+    flat_b, def_b = jax.tree.flatten(b)
+    assert def_a == def_b
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+class TestSerialization:
+    def test_roundtrip_nested(self):
+        tree = {
+            "params": {"dense": {"kernel": np.random.randn(4, 3),
+                                 "bias": np.zeros(3, np.float32)}},
+            "meta": {"round": 7, "name": "fedavg", "lr": 0.03,
+                     "flag": True, "none": None},
+            "list": [np.arange(5), (np.float64(2.5), "x")],
+        }
+        out = serialization.loads(serialization.dumps(tree))
+        np.testing.assert_array_equal(out["params"]["dense"]["kernel"],
+                                      tree["params"]["dense"]["kernel"])
+        assert out["meta"] == tree["meta"]
+        np.testing.assert_array_equal(out["list"][0], tree["list"][0])
+        assert out["list"][1] == (2.5, "x")
+
+    def test_dtype_preserved(self):
+        for dtype in (np.float32, np.float64, np.int32, np.int64, np.uint8,
+                      np.bool_):
+            arr = np.zeros((2, 2), dtype)
+            out = serialization.loads(serialization.dumps(arr))
+            assert out.dtype == dtype and out.shape == (2, 2)
+
+    def test_message_roundtrip(self):
+        msg = Message(4, sender_id=2, receiver_id=0)
+        msg.add("model_params", {"w": np.random.randn(8).astype(np.float32)})
+        msg.add("num_samples", 340.0)
+        out = Message.from_bytes(msg.to_bytes())
+        assert out.get_type() == 4
+        assert out.get_sender_id() == 2 and out.get_receiver_id() == 0
+        assert out.get("num_samples") == 340.0
+        np.testing.assert_array_equal(out.get("model_params")["w"],
+                                      msg.get("model_params")["w"])
+
+
+def _echo_pair(backend, **kw):
+    """rank 1 sends to rank 0; rank 0 records what it observes."""
+    received = []
+
+    class Recorder:
+        def receive_message(self, msg_type, msg):
+            received.append((msg_type, msg))
+
+    com0 = create_comm_manager(backend, 0, 2, **kw)
+    com1 = create_comm_manager(backend, 1, 2, **kw)
+    com0.add_observer(Recorder())
+    t = threading.Thread(target=com0.handle_receive_message, daemon=True)
+    t.start()
+    msg = Message(42, sender_id=1, receiver_id=0)
+    msg.add("payload", np.arange(6, dtype=np.float32))
+    com1.send_message(msg)
+    for _ in range(200):
+        if received:
+            break
+        threading.Event().wait(0.05)
+    com0.stop_receive_message()
+    com1.stop_receive_message()
+    t.join(timeout=5)
+    assert received, f"{backend}: nothing received"
+    msg_type, got = received[0]
+    assert msg_type == 42
+    np.testing.assert_array_equal(got.get("payload"),
+                                  np.arange(6, dtype=np.float32))
+
+
+class TestBackends:
+    def test_inproc(self):
+        _echo_pair("INPROC", router=InProcRouter(), wire_codec=True)
+
+    def test_tcp(self):
+        addrs = {0: ("127.0.0.1", 39401), 1: ("127.0.0.1", 39402)}
+        _echo_pair("TCP", addresses=addrs)
+
+    def test_grpc(self):
+        pytest.importorskip("grpc")
+        addrs = {0: ("127.0.0.1", 39411), 1: ("127.0.0.1", 39412)}
+        _echo_pair("GRPC", addresses=addrs)
+
+
+class TestCrossSiloFedAvg:
+    def test_matches_standalone_simulation(self, small_dataset):
+        """Distributed actor protocol == vmapped simulation, same seeds."""
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        ds = small_dataset
+        tcfg = TrainConfig(epochs=1, batch_size=4, lr=0.1)
+        n_workers = ds.client_num  # full participation
+
+        sim = FedAvgAPI(ds, LogisticRegression(num_classes=ds.class_num),
+                        config=FedAvgConfig(
+                            comm_round=2, client_num_per_round=n_workers,
+                            train=tcfg))
+        for r in range(2):
+            sim.run_round(r)
+
+        model, history = run_fedavg_cross_silo(
+            ds, LogisticRegression(num_classes=ds.class_num),
+            worker_num=n_workers, comm_round=2, train_cfg=tcfg)
+        tree_close(model, sim.variables, rtol=1e-5, atol=1e-6)
+        assert history and history[-1]["round"] == 1
