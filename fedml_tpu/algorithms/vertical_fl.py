@@ -1,0 +1,171 @@
+"""Classical vertical FL — one guest (holds labels) + N hosts (features only).
+
+Reference protocol (fedml_api/standalone/classical_vertical_fl/ and
+distributed/classical_vertical_fl/): every party computes a scalar logit
+component ``U_p = dense_p(local_p(x_p))`` on its own feature slice; the guest
+sums the components, computes BCE-with-logits loss against the labels it
+alone holds, and broadcasts ``dL/dU`` back (party_models.py:57-75 — the same
+gradient for every party, since ``U = Σ U_p``); each party then backprops
+through its own stack (host_trainer / guest ``_update_models``).
+
+TPU-first: a party's entire update — rematerialized forward through
+dense∘local + vjp against the received ``dL/dU`` + SGD — is ONE jitted
+program (``party_backward``); the guest's loss/gradient step is another.
+The only values crossing trust boundaries are ``U_p`` and ``dL/dU``
+([batch, 1] arrays), exactly the reference's wire content.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models.vfl import VFLDenseModel, VFLFeatureExtractor
+
+
+@dataclasses.dataclass(frozen=True)
+class VFLConfig:
+    epochs: int = 5
+    batch_size: int = 64
+    lr: float = 0.01
+    seed: int = 0
+
+
+class VFLParty:
+    """One party's stack: feature extractor + dense logit head, with both
+    half-steps jitted. Guest and hosts share this; the guest adds the loss
+    head (reference VFLGuestModel vs VFLHostModel differ only in bias and in
+    who computes the loss)."""
+
+    def __init__(self, input_dim: int, cfg: VFLConfig, with_bias: bool,
+                 key, hidden_dims=(32, 16)):
+        self.local = VFLFeatureExtractor(hidden_dims=hidden_dims)
+        self.dense = VFLDenseModel(use_bias=with_bias)
+        k1, k2 = jax.random.split(key)
+        x0 = jnp.zeros((1, input_dim), jnp.float32)
+        self.local_params = self.local.init(k1, x0)["params"]
+        z0 = self.local.apply({"params": self.local_params}, x0)
+        self.dense_params = self.dense.init(k2, z0)["params"]
+        self.tx = optax.sgd(cfg.lr)
+        self.opt_state = self.tx.init(
+            {"local": self.local_params, "dense": self.dense_params})
+
+        local, dense, tx = self.local, self.dense, self.tx
+
+        @jax.jit
+        def forward(params, x):
+            z = local.apply({"params": params["local"]}, x)
+            return dense.apply({"params": params["dense"]}, z)
+
+        @jax.jit
+        def backward(params, opt_state, x, grad_u):
+            _, vjp = jax.vjp(lambda p: forward(p, x), params)
+            (grads,) = vjp(grad_u)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._forward, self._backward = forward, backward
+
+    @property
+    def params(self):
+        return {"local": self.local_params, "dense": self.dense_params}
+
+    def send_components(self, x) -> jnp.ndarray:
+        """U_p for a batch of this party's features."""
+        return self._forward(self.params, jnp.asarray(x))
+
+    def receive_gradients(self, x, grad_u) -> None:
+        new, self.opt_state = self._backward(self.params, self.opt_state,
+                                             jnp.asarray(x), grad_u)
+        self.local_params, self.dense_params = new["local"], new["dense"]
+
+
+@jax.jit
+def _guest_loss_and_grad(u_total, y):
+    """BCE-with-logits over the summed components and its gradient dL/dU —
+    the guest's _compute_common_gradient_and_loss (party_models.py:57-69)."""
+
+    def loss_fn(u):
+        return jnp.mean(optax.sigmoid_binary_cross_entropy(
+            u.squeeze(-1), y.astype(jnp.float32)))
+
+    loss, grad = jax.value_and_grad(loss_fn)(u_total)
+    return loss, grad
+
+
+class VerticalMultiplePartyLogisticRegressionFederatedLearning:
+    """Batch-level orchestrator, parity with the reference class of the same
+    name (standalone/classical_vertical_fl/vfl.py:1-60)."""
+
+    def __init__(self, guest: VFLParty, hosts: List[VFLParty]):
+        self.guest = guest
+        self.hosts = hosts
+
+    def fit_batch(self, x_parts: List[np.ndarray], y: np.ndarray) -> float:
+        """``x_parts[0]`` is the guest's feature slice, the rest the hosts'."""
+        u = self.guest.send_components(x_parts[0])
+        host_us = [h.send_components(xp)
+                   for h, xp in zip(self.hosts, x_parts[1:])]
+        u_total = u + sum(host_us)
+        loss, grad = _guest_loss_and_grad(u_total, jnp.asarray(y))
+        self.guest.receive_gradients(x_parts[0], grad)
+        for h, xp in zip(self.hosts, x_parts[1:]):
+            h.receive_gradients(xp, grad)
+        return float(loss)
+
+    def predict(self, x_parts: List[np.ndarray]) -> np.ndarray:
+        u = self.guest.send_components(x_parts[0])
+        for h, xp in zip(self.hosts, x_parts[1:]):
+            u = u + h.send_components(xp)
+        return np.asarray(jax.nn.sigmoid(u.squeeze(-1)))
+
+
+class VFLFixture:
+    """Train/eval harness (reference vfl_fixture.py:27): epochs × batches of
+    aligned samples, AUC-free accuracy at 0.5 threshold."""
+
+    def __init__(self, federation, cfg: VFLConfig):
+        self.fl = federation
+        self.cfg = cfg
+        self.history: List[Dict] = []
+
+    def fit(self, x_train_parts: List[np.ndarray], y_train: np.ndarray,
+            x_test_parts: List[np.ndarray], y_test: np.ndarray) -> Dict:
+        n = len(y_train)
+        rng = np.random.RandomState(self.cfg.seed)
+        bsz = self.cfg.batch_size
+        for epoch in range(self.cfg.epochs):
+            idx = rng.permutation(n)
+            losses = []
+            for s in range(0, n - bsz + 1, bsz):
+                sel = idx[s:s + bsz]
+                losses.append(self.fl.fit_batch(
+                    [xp[sel] for xp in x_train_parts], y_train[sel]))
+            pred = self.fl.predict(x_test_parts)
+            acc = float(np.mean((pred > 0.5) == (y_test > 0.5)))
+            rec = {"epoch": epoch, "train_loss": float(np.mean(losses)),
+                   "test_acc": acc}
+            self.history.append(rec)
+        return self.history[-1]
+
+
+def build_vfl(party_feature_dims: List[int],
+              cfg: Optional[VFLConfig] = None,
+              hidden_dims=(32, 16)):
+    """Construct guest (index 0, with bias) + hosts federation."""
+    cfg = cfg or VFLConfig()
+    key = jax.random.key(cfg.seed)
+    keys = jax.random.split(key, len(party_feature_dims))
+    guest = VFLParty(party_feature_dims[0], cfg, with_bias=True, key=keys[0],
+                     hidden_dims=hidden_dims)
+    hosts = [VFLParty(d, cfg, with_bias=False, key=k,
+                      hidden_dims=hidden_dims)
+             for d, k in zip(party_feature_dims[1:], keys[1:])]
+    fl = VerticalMultiplePartyLogisticRegressionFederatedLearning(guest,
+                                                                  hosts)
+    return VFLFixture(fl, cfg)

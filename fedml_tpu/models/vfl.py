@@ -1,0 +1,38 @@
+"""Vertical-FL party networks (reference fedml_api/model/finance/).
+
+``VFLFeatureExtractor`` mirrors the 2-layer dense extractors of
+vfl_models_standalone.py / vfl_feature_extractor.py (LocalModel: linear →
+ReLU per layer); ``VFLDenseModel`` mirrors DenseModel (one linear unit that
+maps party features to a scalar logit component; guest has bias, hosts do
+not — party_models.py:21,90).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VFLFeatureExtractor(nn.Module):
+    hidden_dims: Sequence[int] = (32, 16)
+
+    @nn.compact
+    def __call__(self, x):
+        for d in self.hidden_dims:
+            x = nn.relu(nn.Dense(d)(x))
+        return x
+
+    @property
+    def output_dim(self) -> int:
+        return self.hidden_dims[-1]
+
+
+class VFLDenseModel(nn.Module):
+    output_dim: int = 1
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, z):
+        return nn.Dense(self.output_dim, use_bias=self.use_bias)(z)
