@@ -1,0 +1,127 @@
+"""Finite-field MPC kernel + TurboAggregate secure aggregation.
+
+Oracles: algebraic identities of the coding schemes (encode->decode is the
+identity for any T+1 / K+T share subset) and exactness of the secure sum
+against the plain weighted mean.
+"""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import mpc
+
+P = mpc.DEFAULT_PRIME
+
+
+class TestFieldPrimitives:
+    def test_modular_inv(self):
+        for a in (2, 7, 12345, P - 2):
+            assert a * mpc.modular_inv(a, P) % P == 1
+
+    def test_lagrange_partition_of_unity(self):
+        # sum_j l_j(x) == 1 for any x (interpolating the constant 1)
+        alpha = np.arange(5, 11)
+        beta = np.arange(1, 5)
+        U = mpc.gen_lagrange_coeffs(alpha, beta, P)
+        assert np.all(U.sum(axis=1) % P == 1)
+
+    def test_lagrange_interpolates_identity_at_nodes(self):
+        beta = np.arange(1, 6)
+        U = mpc.gen_lagrange_coeffs(beta, beta, P)
+        np.testing.assert_array_equal(U % P, np.eye(5, dtype=np.int64))
+
+
+class TestBGW:
+    @pytest.mark.parametrize("worker_subset", [[0, 1, 2], [1, 3, 4],
+                                               [0, 2, 4]])
+    def test_encode_decode_roundtrip(self, worker_subset):
+        rng = np.random.RandomState(0)
+        secret = rng.randint(0, P, size=(4, 6)).astype(np.int64)
+        shares = mpc.bgw_encoding(secret, N=5, T=2, p=P, rng=rng)
+        recon = mpc.bgw_decoding(shares[worker_subset], worker_subset, P)
+        np.testing.assert_array_equal(recon, secret)
+
+    def test_fewer_than_t_plus_1_shares_fail(self):
+        rng = np.random.RandomState(1)
+        secret = rng.randint(0, P, size=(2, 3)).astype(np.int64)
+        shares = mpc.bgw_encoding(secret, N=5, T=2, p=P, rng=rng)
+        recon = mpc.bgw_decoding(shares[[0, 1]], [0, 1], P)
+        assert not np.array_equal(recon, secret)
+
+
+class TestLCC:
+    @pytest.mark.parametrize("K,T", [(2, 0), (2, 1), (3, 2)])
+    def test_encode_decode_roundtrip(self, K, T):
+        rng = np.random.RandomState(2)
+        N = K + T + 2  # redundancy: 2 droppable workers
+        m, d = 2 * K * 3, 5
+        X = rng.randint(0, P, size=(m, d)).astype(np.int64)
+        coded = mpc.lcc_encoding(X, N, K, T, P, rng)
+        surviving = list(range(1, K + T + 1))  # worker 0 dropped
+        recon = mpc.lcc_decoding(coded[surviving], N, K, T, surviving, P)
+        np.testing.assert_array_equal(recon, X)
+
+    def test_coded_rows_with_noise_look_masked(self):
+        # with T>0 the coded evaluations must differ from raw shards
+        rng = np.random.RandomState(3)
+        X = rng.randint(0, P, size=(4, 3)).astype(np.int64)
+        coded = mpc.lcc_encoding(X, N=6, K=2, T=2, p=P, rng=rng)
+        assert not np.array_equal(coded[0], X[:2])
+
+
+class TestAdditiveSS:
+    def test_shares_sum_to_secret(self):
+        rng = np.random.RandomState(4)
+        x = rng.randint(0, P, size=17).astype(np.int64)
+        shares = mpc.gen_additive_ss(x, 5, P, rng)
+        np.testing.assert_array_equal(shares.sum(axis=0) % P, x)
+        # single shares are not the secret
+        assert not np.array_equal(shares[0] % P, x)
+
+
+class TestQuantization:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1000) * 10
+        q = mpc.quantize(x, frac_bits=16)
+        back = mpc.dequantize(q, frac_bits=16)
+        assert np.max(np.abs(back - x)) <= 2.0 ** -16
+
+    def test_negative_values(self):
+        x = np.array([-1.5, -0.001, 0.0, 2.25])
+        np.testing.assert_allclose(mpc.dequantize(mpc.quantize(x)), x,
+                                   atol=2.0 ** -16)
+
+
+class TestSecureAggregator:
+    def test_matches_plain_weighted_mean(self):
+        import jax.numpy as jnp
+
+        from fedml_tpu.algorithms.turboaggregate import SecureAggregator
+        from fedml_tpu.core import pytree as pt
+
+        rng = np.random.RandomState(6)
+        n = 4
+        trees = [{"w": jnp.asarray(rng.randn(3, 2), jnp.float32),
+                  "b": jnp.asarray(rng.randn(2), jnp.float32)}
+                 for _ in range(n)]
+        stacked = pt.tree_stack(trees)
+        weights = jnp.asarray([10.0, 20.0, 5.0, 15.0])
+        plain = pt.tree_weighted_mean(stacked, weights)
+        secure = SecureAggregator().aggregate(stacked, weights)
+        for a, b in zip(
+                __import__("jax").tree.leaves(plain),
+                __import__("jax").tree.leaves(secure)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-3)
+
+    def test_coded_exchange_survives_dropouts(self):
+        from fedml_tpu.algorithms.turboaggregate import coded_share_exchange
+
+        rng = np.random.RandomState(7)
+        block = rng.randint(0, P, size=(6, 4)).astype(np.int64)
+        coded, reconstruct = coded_share_exchange(block, K=2, T=1,
+                                                  n_workers=6, prime=P,
+                                                  rng=rng)
+        recon = reconstruct([0, 2, 5])  # 3 of 6 suffice (K+T=3)
+        np.testing.assert_array_equal(recon, block)
