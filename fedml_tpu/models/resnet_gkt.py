@@ -1,0 +1,67 @@
+"""GKT split ResNets — small client feature extractor + large server model.
+
+Reference: fedml_api/model/cv/resnet56_gkt/{resnet_client.py:230 resnet8_56,
+resnet_server.py:200 resnet56_server}. The client is the CIFAR stem + stage-1
+Bottleneck blocks with an auxiliary classifier head, returning
+``(logits, feature_maps)``; the server model is the remaining stages
+(resnet_server.py forward, :186-198 — stem commented out, consumes feature
+maps directly) ending in the usual pool + fc. Flax convs infer input
+channel counts, so the client/server channel seam needs no hand-wiring.
+NHWC layout; BatchNorm via the ``batch_stats`` collection.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.common import bn
+from fedml_tpu.models.resnet import BottleneckBlock
+
+
+class ResNetClientGKT(nn.Module):
+    """resnet8_56 role: stem + ``num_blocks`` stage-1 bottlenecks; returns
+    (logits from the aux head, extracted feature maps [B, H, W, 64])."""
+
+    num_blocks: int = 2
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray,
+                                                        jnp.ndarray]:
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(bn(train)(x))
+        for _ in range(self.num_blocks):
+            x = BottleneckBlock(16, stride=1)(x, train=train)
+        features = x
+        pooled = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(pooled)
+        return logits, features
+
+
+class ResNetServerGKT(nn.Module):
+    """resnet56_server role: stages over the received feature maps."""
+
+    stage_sizes: Sequence[int] = (6, 6, 6)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, features, train: bool = False) -> jnp.ndarray:
+        x = features
+        for stage, blocks in enumerate(self.stage_sizes):
+            planes = 16 * (2 ** stage)
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = BottleneckBlock(planes, stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet8_56(num_classes: int = 10) -> ResNetClientGKT:
+    return ResNetClientGKT(num_blocks=2, num_classes=num_classes)
+
+
+def resnet56_server(num_classes: int = 10) -> ResNetServerGKT:
+    return ResNetServerGKT(stage_sizes=(6, 6, 6), num_classes=num_classes)
