@@ -1,0 +1,194 @@
+"""FedNAS — federated architecture search over the DARTS space.
+
+Reference (fedml_api/distributed/fednas/): each round, every client
+alternates an architecture step (val-batch gradient on the alphas —
+``Architect.step``, model/cv/darts/architect.py:13) with a weight step
+(train-batch SGD — FedNASTrainer.search, FedNASTrainer.py:34-90); the server
+sample-weight-averages BOTH the weights and the alphas
+(FedNASAggregator.__aggregate_weight :71, __aggregate_alpha :95) and logs the
+derived genotype each round (record_model_global_architecture :173).
+
+TPU-first: alphas are plain arrays (not module params — models/darts.py), so
+the alternating bilevel step is two ``jax.grad`` calls inside one scanned,
+jitted per-client program; clients run under ``vmap``; aggregation is the
+shared weighted tree-mean. First-order DARTS (the reference's
+``--arch_unrolled False`` default path) — the val gradient is taken at the
+current weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.models.darts import (DartsNetwork, init_alphas,
+                                    parse_genotype)
+
+
+@dataclasses.dataclass(frozen=True)
+class FedNASConfig:
+    comm_round: int = 5
+    epochs: int = 1
+    batch_size: int = 16
+    lr: float = 0.025           # weight SGD (reference --learning_rate)
+    momentum: float = 0.9
+    wd: float = 3e-4
+    arch_lr: float = 3e-4       # alpha Adam (reference --arch_learning_rate)
+    arch_wd: float = 1e-3
+    seed: int = 0
+
+
+class FedNASAPI:
+    """Standalone simulation: vmapped client search + weight/alpha averaging."""
+
+    def __init__(self, dataset: FederatedDataset, model: DartsNetwork,
+                 config: Optional[FedNASConfig] = None):
+        self.ds = dataset
+        self.model = model
+        self.cfg = config or FedNASConfig()
+        cfg = self.cfg
+
+        rng = np.random.RandomState(cfg.seed)
+        an, ar = init_alphas(model.steps, rng)
+        self.alphas = {"normal": jnp.asarray(an), "reduce": jnp.asarray(ar)}
+
+        sample_x = jnp.asarray(dataset.train_data_global[0][:1])
+        w = jax.nn.softmax(self.alphas["normal"], axis=-1)
+        wr = jax.nn.softmax(self.alphas["reduce"], axis=-1)
+        self.variables = model.init(jax.random.key(cfg.seed), sample_x, w,
+                                    wr, train=False)
+
+        self._tx_w = optax.chain(optax.add_decayed_weights(cfg.wd),
+                                 optax.sgd(cfg.lr, momentum=cfg.momentum))
+        self._tx_a = optax.chain(optax.add_decayed_weights(cfg.arch_wd),
+                                 optax.adam(cfg.arch_lr, b1=0.5, b2=0.999))
+        self._n_pad = dataset.padded_len(cfg.batch_size)
+        self._round_fn = jax.jit(self._make_round())
+        self.history: List[Dict] = []
+
+    def _apply(self, variables, alphas, x, train, mutable=False):
+        w = jax.nn.softmax(alphas["normal"], axis=-1)
+        wr = jax.nn.softmax(alphas["reduce"], axis=-1)
+        if mutable:
+            m = [k for k in variables if k != "params"]
+            return self.model.apply(variables, x, w, wr, train=True,
+                                    mutable=m)
+        return self.model.apply(variables, x, w, wr, train=train)
+
+    def _make_round(self):
+        cfg = self.cfg
+        bsz = cfg.batch_size
+        n_pad = self._n_pad
+        nb = n_pad // bsz
+        tx_w, tx_a = self._tx_w, self._tx_a
+        apply = self._apply
+
+        def masked_ce(logits, y, m):
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+        def one_client(variables, alphas, x, y, mask, rng):
+            """Alternating search: for each train batch, (1) alpha step on
+            the *next* (val) batch, (2) weight step on the train batch —
+            the reference's per-batch architect/optimizer alternation."""
+            params = variables["params"]
+            colls = {k: v for k, v in variables.items() if k != "params"}
+            opt_w = tx_w.init(params)
+            opt_a = tx_a.init(alphas)
+
+            def step(carry, inp):
+                params, colls, alphas, opt_w, opt_a = carry
+                idx_train, idx_val = inp
+                xt, yt, mt = (jnp.take(x, idx_train, 0),
+                              jnp.take(y, idx_train, 0),
+                              jnp.take(mask, idx_train, 0))
+                xv, yv, mv = (jnp.take(x, idx_val, 0),
+                              jnp.take(y, idx_val, 0),
+                              jnp.take(mask, idx_val, 0))
+
+                # (1) architecture step: d val_loss / d alphas (1st order)
+                def val_loss(a):
+                    logits, _ = apply({"params": params, **colls}, a, xv,
+                                      True, mutable=True)
+                    return masked_ce(logits, yv, mv)
+
+                ga = jax.grad(val_loss)(alphas)
+                ua, opt_a = tx_a.update(ga, opt_a, alphas)
+                alphas = optax.apply_updates(alphas, ua)
+
+                # (2) weight step on the train batch
+                def train_loss(p):
+                    logits, updates = apply({"params": p, **colls}, alphas,
+                                            xt, True, mutable=True)
+                    return masked_ce(logits, yt, mt), updates
+
+                (loss, updates), gw = jax.value_and_grad(
+                    train_loss, has_aux=True)(params)
+                uw, opt_w = tx_w.update(gw, opt_w, params)
+                params = optax.apply_updates(params, uw)
+                colls = {k: updates[k] for k in colls}
+                return (params, colls, alphas, opt_w, opt_a), loss
+
+            def epoch(carry, key):
+                perm = jax.random.permutation(key, n_pad)
+                batches = perm[:nb * bsz].reshape(nb, bsz)
+                val_batches = jnp.roll(batches, 1, axis=0)  # next as val
+                carry, losses = jax.lax.scan(step, carry,
+                                             (batches, val_batches))
+                return carry, jnp.mean(losses)
+
+            keys = jax.random.split(rng, cfg.epochs)
+            (params, colls, alphas, _, _), losses = jax.lax.scan(
+                epoch, (params, colls, alphas, opt_w, opt_a), keys)
+            return {"params": params, **colls}, alphas, jnp.mean(losses)
+
+        def round_fn(variables, alphas, x, y, mask, weights, rngs):
+            stacked_vars, stacked_alphas, losses = jax.vmap(
+                one_client, in_axes=(None, None, 0, 0, 0, 0))(
+                variables, alphas, x, y, mask, rngs)
+            new_vars = pt.tree_weighted_mean(stacked_vars, weights)
+            new_alphas = pt.tree_weighted_mean(stacked_alphas, weights)
+            return new_vars, new_alphas, jnp.mean(losses)
+
+        return round_fn
+
+    def run_round(self, round_idx: int) -> Dict:
+        cfg = self.cfg
+        idxs = list(range(self.ds.client_num))
+        x, y, mask = self.ds.pack_clients(idxs, cfg.batch_size,
+                                          n_pad=self._n_pad)
+        weights = jnp.asarray(self.ds.client_weights(idxs))
+        rkey = jax.random.fold_in(jax.random.key(cfg.seed), round_idx)
+        rngs = jax.random.split(rkey, len(idxs))
+        self.variables, self.alphas, loss = self._round_fn(
+            self.variables, self.alphas, jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(mask), weights, rngs)
+        rec = {"round": round_idx, "search_loss": float(loss),
+               "genotype": self.genotype()}
+        self.history.append(rec)
+        return rec
+
+    def genotype(self):
+        """Current global architecture (reference
+        record_model_global_architecture, FedNASAggregator.py:173)."""
+        return parse_genotype(np.asarray(self.alphas["normal"]),
+                              np.asarray(self.alphas["reduce"]),
+                              steps=self.model.steps,
+                              multiplier=self.model.multiplier)
+
+    def evaluate(self) -> Dict:
+        xt, yt = self.ds.test_data_global
+        if not len(xt):
+            return {}
+        logits = self._apply(self.variables, self.alphas, jnp.asarray(xt),
+                             train=False)
+        acc = float(jnp.mean((jnp.argmax(logits, -1) ==
+                              jnp.asarray(yt)).astype(jnp.float32)))
+        return {"test_acc": acc}

@@ -1,0 +1,272 @@
+"""DARTS search space in flax — the FedNAS model family.
+
+Reference: fedml_api/model/cv/darts/{operations.py:4 OPS,
+model_search.py:10 MixedOp, :26 Cell, :172 Network, genotypes.py:5
+PRIMITIVES, model_search.py:262 genotype parsing}.
+
+TPU-first deltas:
+- The architecture parameters (alphas) are NOT module parameters; the
+  softmaxed mixing weights are explicit *inputs* to ``apply``. Bilevel
+  optimization then falls out of ``jax.grad`` argnums — no parameter-group
+  bookkeeping, no ``Architect`` object mutating ``.grad`` fields
+  (architect.py:13), and the alternating weight/arch steps jit into one
+  scanned program (algorithms/fednas.py).
+- A MixedOp evaluates all primitive branches and contracts them with the
+  mixing weights — on TPU the branches are independent convs XLA schedules
+  back-to-back on the MXU; the contraction fuses into the epilogue.
+- NHWC, BatchNorm in ``batch_stats`` (affine=False inside the search cells,
+  as in the reference ops).
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+Genotype = namedtuple("Genotype", "normal normal_concat reduce reduce_concat")
+
+PRIMITIVES = [
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+]
+
+
+def _bn(train: bool, affine: bool = False):
+    return nn.BatchNorm(use_running_average=not train, use_scale=affine,
+                        use_bias=affine, momentum=0.9, epsilon=1e-5)
+
+
+class ReLUConvBN(nn.Module):
+    C_out: int
+    kernel: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        x = nn.Conv(self.C_out, (self.kernel, self.kernel),
+                    strides=self.stride, use_bias=False)(x)
+        return _bn(train)(x)
+
+
+class FactorizedReduce(nn.Module):
+    C_out: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        a = nn.Conv(self.C_out // 2, (1, 1), strides=2, use_bias=False)(x)
+        b = nn.Conv(self.C_out - self.C_out // 2, (1, 1), strides=2,
+                    use_bias=False)(x[:, 1:, 1:, :])
+        return _bn(train)(jnp.concatenate([a, b], axis=-1))
+
+
+class SepConv(nn.Module):
+    C_out: int
+    kernel: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        C_in = x.shape[-1]
+        k = (self.kernel, self.kernel)
+        x = nn.relu(x)
+        x = nn.Conv(C_in, k, strides=self.stride, feature_group_count=C_in,
+                    use_bias=False)(x)
+        x = nn.Conv(C_in, (1, 1), use_bias=False)(x)
+        x = _bn(train)(x)
+        x = nn.relu(x)
+        x = nn.Conv(C_in, k, feature_group_count=C_in, use_bias=False)(x)
+        x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
+        return _bn(train)(x)
+
+
+class DilConv(nn.Module):
+    C_out: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        C_in = x.shape[-1]
+        x = nn.relu(x)
+        x = nn.Conv(C_in, (self.kernel, self.kernel), strides=self.stride,
+                    kernel_dilation=self.dilation, feature_group_count=C_in,
+                    use_bias=False)(x)
+        x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
+        return _bn(train)(x)
+
+
+def _pool(x, kind: str, stride: int):
+    window = (3, 3)
+    strides = (stride, stride)
+    if kind == "max":
+        return nn.max_pool(x, window, strides=strides, padding="SAME")
+    # count_include_pad=False semantics: normalize by the true window size
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    summed = nn.avg_pool(x, window, strides=strides, padding="SAME") * 9.0
+    counts = nn.avg_pool(ones, window, strides=strides, padding="SAME") * 9.0
+    return summed / counts
+
+
+class MixedOp(nn.Module):
+    """All primitives evaluated, contracted with the mixing weights w
+    (reference MixedOp.forward, model_search.py:21-23)."""
+
+    C: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, w, train: bool = False):
+        outs = []
+        for prim in PRIMITIVES:
+            if prim == "none":
+                if self.stride == 1:
+                    out = jnp.zeros_like(x)
+                else:
+                    out = jnp.zeros(
+                        (x.shape[0], x.shape[1] // self.stride,
+                         x.shape[2] // self.stride, self.C), x.dtype)
+            elif prim == "max_pool_3x3":
+                out = _bn(train)(_pool(x, "max", self.stride))
+            elif prim == "avg_pool_3x3":
+                out = _bn(train)(_pool(x, "avg", self.stride))
+            elif prim == "skip_connect":
+                out = (x if self.stride == 1
+                       else FactorizedReduce(self.C)(x, train=train))
+            elif prim == "sep_conv_3x3":
+                out = SepConv(self.C, 3, self.stride)(x, train=train)
+            elif prim == "sep_conv_5x5":
+                out = SepConv(self.C, 5, self.stride)(x, train=train)
+            elif prim == "dil_conv_3x3":
+                out = DilConv(self.C, 3, self.stride)(x, train=train)
+            elif prim == "dil_conv_5x5":
+                out = DilConv(self.C, 5, self.stride)(x, train=train)
+            outs.append(out)
+        stacked = jnp.stack(outs, axis=0)  # [ops, B, H, W, C]
+        return jnp.einsum("o,obhwc->bhwc", w, stacked)
+
+
+class Cell(nn.Module):
+    """steps intermediate nodes, each summing MixedOps from all predecessor
+    states; output concat of the last ``multiplier`` states (reference Cell,
+    model_search.py:26-60)."""
+
+    steps: int
+    multiplier: int
+    C: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train: bool = False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C)(s0, train=train)
+        else:
+            s0 = ReLUConvBN(self.C)(s0, train=train)
+        s1 = ReLUConvBN(self.C)(s1, train=train)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            s = None
+            for j, h in enumerate(states):
+                stride = 2 if self.reduction and j < 2 else 1
+                out = MixedOp(self.C, stride)(h, weights[offset + j],
+                                              train=train)
+                s = out if s is None else s + out
+            offset += len(states)
+            states.append(s)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+class DartsNetwork(nn.Module):
+    """Search network (reference Network, model_search.py:172-231): stem,
+    ``layers`` cells with reductions at 1/3 and 2/3 depth, pool + classifier.
+    ``weights_normal`` / ``weights_reduce`` are the softmaxed alphas
+    [k, num_ops] — inputs, not parameters."""
+
+    C: int = 16
+    num_classes: int = 10
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+
+    @staticmethod
+    def num_edges(steps: int) -> int:
+        return sum(2 + i for i in range(steps))
+
+    @nn.compact
+    def __call__(self, x, weights_normal, weights_reduce,
+                 train: bool = False):
+        C_curr = self.stem_multiplier * self.C
+        x = nn.Conv(C_curr, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        s0 = s1 = x
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            w = weights_reduce if reduction else weights_normal
+            s0, s1 = s1, Cell(self.steps, self.multiplier, C_curr, reduction,
+                              reduction_prev)(s0, s1, w, train=train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+def init_alphas(steps: int, rng: np.random.RandomState):
+    """1e-3 * randn [k, num_ops] for normal + reduce (reference
+    _initialize_alphas, model_search.py:232-241)."""
+    k = DartsNetwork.num_edges(steps)
+    return (np.asarray(1e-3 * rng.randn(k, len(PRIMITIVES)), np.float32),
+            np.asarray(1e-3 * rng.randn(k, len(PRIMITIVES)), np.float32))
+
+
+def parse_genotype(alphas_normal: np.ndarray,
+                   alphas_reduce: np.ndarray, steps: int = 4,
+                   multiplier: int = 4) -> Genotype:
+    """Discretize softmaxed alphas into the best-2-edges-per-node genotype
+    (reference Network.genotype, model_search.py:262-296)."""
+
+    def softmax(a):
+        e = np.exp(a - a.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    none_idx = PRIMITIVES.index("none")
+
+    def _parse(weights):
+        gene = []
+        start, n = 0, 2
+        for i in range(steps):
+            W = weights[start:start + n]
+            edges = sorted(
+                range(n),
+                key=lambda j: -max(W[j][k] for k in range(len(W[j]))
+                                   if k != none_idx))[:2]
+            for j in edges:
+                k_best = max((k for k in range(len(W[j])) if k != none_idx),
+                             key=lambda k: W[j][k])
+                gene.append((PRIMITIVES[k_best], j))
+            start += n
+            n += 1
+        return gene
+
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return Genotype(normal=_parse(softmax(alphas_normal)),
+                    normal_concat=concat,
+                    reduce=_parse(softmax(alphas_reduce)),
+                    reduce_concat=concat)
