@@ -1,0 +1,194 @@
+"""FedSeg — FedAvg for semantic segmentation, with the reference's toolkit.
+
+Re-expression of fedml_api/distributed/fedseg/utils.py as pure/jittable
+pieces:
+- ``segmentation_ce`` / ``segmentation_focal`` — per-pixel CE and focal
+  losses with ignore_index=255 masking (SegmentationLosses, utils.py:71-109)
+- ``make_lr_schedule`` — cos / poly(0.9) / step decay with linear warmup
+  (LR_Scheduler, utils.py:114-157) as an optax schedule (step -> lr), so it
+  lives inside the jitted update instead of mutating optimizer state from
+  the host
+- ``SegEvaluator`` — confusion-matrix pixel metrics: pixel acc, per-class
+  acc, mIoU, FWIoU (Evaluator, utils.py:246-288); the matrix accumulates
+  on-device via one-hot matmul (a [C, C] psum-able array, so federation-wide
+  metrics are a collective away)
+- ``EvaluationMetricsKeeper`` — the metrics record (utils.py:62-68)
+- ``FedSegAPI`` — FedAvg rounds over a segmentation model using the
+  segmentation task head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.trainer.functional import TrainConfig
+from fedml_tpu.trainer.tasks import TASK_HEADS, Stats
+
+IGNORE_INDEX = 255
+
+
+def _pixel_mask(targets: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Valid-pixel weights: example mask x (target != ignore_index)."""
+    valid = (targets != IGNORE_INDEX).astype(jnp.float32)
+    return valid * mask.reshape(mask.shape + (1,) * (targets.ndim - 1))
+
+
+def segmentation_ce(logits, targets, mask) -> Stats:
+    """Mean per-valid-pixel CE (SegmentationLosses.CrossEntropyLoss)."""
+    safe_targets = jnp.where(targets == IGNORE_INDEX, 0, targets)
+    per_px = optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                             safe_targets)
+    pm = _pixel_mask(targets, mask)
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    return {"loss_sum": jnp.sum(per_px * pm), "count": jnp.sum(pm),
+            "correct_sum": jnp.sum(correct * pm)}
+
+
+def segmentation_focal(logits, targets, mask, gamma: float = 2.0,
+                       alpha: float = 0.5) -> Stats:
+    """Focal loss: -alpha * (1-pt)^gamma * log pt per valid pixel
+    (SegmentationLosses.FocalLoss, utils.py:95-109)."""
+    safe_targets = jnp.where(targets == IGNORE_INDEX, 0, targets)
+    logpt = -optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                             safe_targets)
+    pt = jnp.exp(logpt)
+    per_px = -((1.0 - pt) ** gamma) * alpha * logpt
+    pm = _pixel_mask(targets, mask)
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    return {"loss_sum": jnp.sum(per_px * pm), "count": jnp.sum(pm),
+            "correct_sum": jnp.sum(correct * pm)}
+
+
+TASK_HEADS.setdefault("segmentation", segmentation_ce)
+TASK_HEADS.setdefault("segmentation_focal", segmentation_focal)
+
+
+def make_lr_schedule(mode: str, base_lr: float, num_epochs: int,
+                     iters_per_epoch: int, lr_step: int = 0,
+                     warmup_epochs: int = 0):
+    """optax schedule (global step -> lr) matching LR_Scheduler
+    (utils.py:114-157)."""
+    N = num_epochs * iters_per_epoch
+    warmup_iters = warmup_epochs * iters_per_epoch
+
+    def schedule(step):
+        T = jnp.asarray(step, jnp.float32)
+        if mode == "cos":
+            lr = 0.5 * base_lr * (1.0 + jnp.cos(T / N * jnp.pi))
+        elif mode == "poly":
+            lr = base_lr * (1.0 - T / N) ** 0.9
+        elif mode == "step":
+            assert lr_step, "step mode needs lr_step"
+            epoch = T // iters_per_epoch
+            lr = base_lr * (0.1 ** (epoch // lr_step))
+        else:
+            raise NotImplementedError(mode)
+        if warmup_iters > 0:
+            lr = jnp.where(T < warmup_iters, lr * T / warmup_iters, lr)
+        return lr
+
+    return schedule
+
+
+@dataclasses.dataclass
+class EvaluationMetricsKeeper:
+    """utils.py:62-68, verbatim field meaning."""
+
+    accuracy: float
+    accuracy_class: float
+    mIoU: float
+    FWIoU: float
+    loss: float
+
+
+class SegEvaluator:
+    """Confusion-matrix pixel metrics (reference Evaluator, utils.py:246-288).
+
+    ``add_batch`` is jitted: the [C, C] matrix update is a one-hot einsum on
+    device; the nan-mean metric reductions happen on host at read time.
+    """
+
+    def __init__(self, num_class: int):
+        self.num_class = num_class
+        self.confusion_matrix = np.zeros((num_class, num_class))
+        C = num_class
+
+        @jax.jit
+        def batch_matrix(gt, pred):
+            valid = (gt >= 0) & (gt < C)
+            g1 = jax.nn.one_hot(jnp.where(valid, gt, 0).reshape(-1), C)
+            p1 = jax.nn.one_hot(pred.reshape(-1), C)
+            w = valid.reshape(-1, 1).astype(jnp.float32)
+            return jnp.einsum("ng,np->gp", g1 * w, p1)
+
+        self._batch_matrix = batch_matrix
+
+    def add_batch(self, gt_image, pre_image) -> None:
+        assert gt_image.shape == pre_image.shape
+        self.confusion_matrix += np.asarray(
+            self._batch_matrix(jnp.asarray(gt_image), jnp.asarray(pre_image)))
+
+    def reset(self) -> None:
+        self.confusion_matrix = np.zeros((self.num_class, self.num_class))
+
+    def pixel_accuracy(self) -> float:
+        cm = self.confusion_matrix
+        return float(np.diag(cm).sum() / cm.sum())
+
+    def pixel_accuracy_class(self) -> float:
+        cm = self.confusion_matrix
+        with np.errstate(divide="ignore", invalid="ignore"):
+            acc = np.diag(cm) / cm.sum(axis=1)
+        return float(np.nanmean(acc))
+
+    def mean_iou(self) -> float:
+        cm = self.confusion_matrix
+        with np.errstate(divide="ignore", invalid="ignore"):
+            iu = np.diag(cm) / (cm.sum(axis=1) + cm.sum(axis=0) - np.diag(cm))
+        return float(np.nanmean(iu))
+
+    def frequency_weighted_iou(self) -> float:
+        cm = self.confusion_matrix
+        freq = cm.sum(axis=1) / cm.sum()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            iu = np.diag(cm) / (cm.sum(axis=1) + cm.sum(axis=0) - np.diag(cm))
+        return float((freq[freq > 0] * iu[freq > 0]).sum())
+
+
+class FedSegAPI(FedAvgAPI):
+    """FedAvg rounds over a segmentation model; evaluation reports the full
+    IoU metric family per round (reference FedSegAggregator +
+    add_client_test_result, FedSegAggregator.py:12-105)."""
+
+    def __init__(self, dataset: FederatedDataset, module,
+                 config: Optional[FedAvgConfig] = None,
+                 loss_mode: str = "ce"):
+        task = ("segmentation" if loss_mode == "ce"
+                else "segmentation_focal")
+        super().__init__(dataset, module, task=task, config=config)
+
+    def evaluate(self, round_idx: int) -> Dict:
+        rec = super().evaluate(round_idx)
+        xt, yt = self.dataset.test_data_global
+        if len(xt):
+            ev = SegEvaluator(self.dataset.class_num)
+            logits = self.module.apply(self.variables, jnp.asarray(xt),
+                                       train=False)
+            ev.add_batch(np.asarray(yt), np.asarray(jnp.argmax(logits, -1)))
+            keeper = EvaluationMetricsKeeper(
+                accuracy=ev.pixel_accuracy(),
+                accuracy_class=ev.pixel_accuracy_class(),
+                mIoU=ev.mean_iou(),
+                FWIoU=ev.frequency_weighted_iou(),
+                loss=rec.get("test_loss", float("nan")))
+            rec.update({"test_mIoU": keeper.mIoU, "test_FWIoU": keeper.FWIoU,
+                        "test_acc_class": keeper.accuracy_class})
+        return rec

@@ -1,0 +1,131 @@
+"""FedSeg: losses vs torch-style oracles, LR schedules, evaluator, e2e."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.algorithms.fedseg import (IGNORE_INDEX, FedSegAPI,
+                                         SegEvaluator, make_lr_schedule,
+                                         segmentation_ce, segmentation_focal)
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.models.segnet import SegNet
+from fedml_tpu.trainer.functional import TrainConfig
+
+
+def make_seg_federation(client_num=2, n_per=24, hw=16, classes=4, seed=0):
+    """Color-block images whose label map is recoverable from the pixels."""
+    rng = np.random.RandomState(seed)
+    palette = rng.randn(classes, 3).astype(np.float32) * 2.0
+    train, test = {}, {}
+
+    def gen(n):
+        y = rng.randint(0, classes, (n, hw, hw)).astype(np.int32)
+        # smooth labels into blocks for spatial coherence
+        y = np.repeat(np.repeat(y[:, ::4, ::4], 4, axis=1), 4, axis=2)
+        x = palette[y] + 0.3 * rng.randn(n, hw, hw, 3).astype(np.float32)
+        return x.astype(np.float32), y
+
+    for c in range(client_num):
+        train[c] = gen(n_per)
+        test[c] = gen(8)
+    return FederatedDataset.from_client_arrays(train, test, classes)
+
+
+class TestLosses:
+    def test_ce_ignores_ignore_index(self):
+        logits = jnp.zeros((1, 2, 2, 3))
+        targets = jnp.asarray([[[0, IGNORE_INDEX], [1, 2]]])
+        mask = jnp.ones((1,))
+        stats = segmentation_ce(logits, targets, mask)
+        assert float(stats["count"]) == 3.0  # 4 pixels - 1 ignored
+        np.testing.assert_allclose(float(stats["loss_sum"]) / 3.0,
+                                   np.log(3.0), rtol=1e-5)
+
+    def test_focal_reduces_easy_pixel_weight(self):
+        # confident-correct pixel should contribute much less than in CE
+        logits = jnp.asarray([[[[5.0, 0.0, 0.0]]]])
+        targets = jnp.asarray([[[0]]])
+        mask = jnp.ones((1,))
+        ce = segmentation_ce(logits, targets, mask)
+        focal = segmentation_focal(logits, targets, mask)
+        assert float(focal["loss_sum"]) < 0.5 * float(ce["loss_sum"])
+
+    def test_focal_formula(self):
+        logits = jnp.asarray([[[[1.0, -1.0]]]])
+        targets = jnp.asarray([[[0]]])
+        stats = segmentation_focal(logits, targets, jnp.ones((1,)),
+                                   gamma=2.0, alpha=0.5)
+        logpt = -(np.log(1 + np.exp(-2.0)))
+        pt = np.exp(logpt)
+        expected = -((1 - pt) ** 2) * 0.5 * logpt
+        np.testing.assert_allclose(float(stats["loss_sum"]), expected,
+                                   rtol=1e-5)
+
+
+class TestLRSchedule:
+    def test_poly(self):
+        sched = make_lr_schedule("poly", 0.01, 10, 100)
+        np.testing.assert_allclose(float(sched(0)), 0.01, rtol=1e-6)
+        np.testing.assert_allclose(float(sched(500)),
+                                   0.01 * 0.5 ** 0.9, rtol=1e-5)
+
+    def test_cos_endpoints(self):
+        sched = make_lr_schedule("cos", 0.1, 10, 10)
+        np.testing.assert_allclose(float(sched(0)), 0.1, rtol=1e-6)
+        assert float(sched(100)) < 1e-8
+
+    def test_step_decay(self):
+        sched = make_lr_schedule("step", 1.0, 30, 10, lr_step=10)
+        np.testing.assert_allclose(float(sched(0)), 1.0)
+        np.testing.assert_allclose(float(sched(105)), 0.1, rtol=1e-6)
+        np.testing.assert_allclose(float(sched(205)), 0.01, rtol=1e-6)
+
+    def test_warmup_ramps(self):
+        sched = make_lr_schedule("poly", 1.0, 10, 10, warmup_epochs=2)
+        assert float(sched(0)) == 0.0
+        assert float(sched(10)) < float(sched(19))
+
+
+class TestSegEvaluator:
+    def test_perfect_prediction(self):
+        ev = SegEvaluator(3)
+        gt = np.random.RandomState(0).randint(0, 3, (2, 8, 8))
+        ev.add_batch(gt, gt)
+        assert ev.pixel_accuracy() == 1.0
+        assert ev.mean_iou() == 1.0
+        assert ev.frequency_weighted_iou() == 1.0
+
+    def test_matches_reference_bincount_matrix(self):
+        rng = np.random.RandomState(1)
+        gt = rng.randint(0, 4, (3, 6, 6))
+        pred = rng.randint(0, 4, (3, 6, 6))
+        ev = SegEvaluator(4)
+        ev.add_batch(gt, pred)
+        # reference _generate_matrix oracle (utils.py:277-283)
+        mask = (gt >= 0) & (gt < 4)
+        label = 4 * gt[mask].astype(int) + pred[mask]
+        expected = np.bincount(label, minlength=16).reshape(4, 4)
+        np.testing.assert_array_equal(ev.confusion_matrix, expected)
+
+    def test_ignore_index_excluded(self):
+        ev = SegEvaluator(2)
+        gt = np.array([[0, 255], [1, 0]])
+        pred = np.array([[0, 1], [1, 0]])
+        ev.add_batch(gt, pred)
+        assert ev.confusion_matrix.sum() == 3.0
+
+
+class TestFedSegE2E:
+    def test_learns_color_blocks(self):
+        ds = make_seg_federation()
+        api = FedSegAPI(ds, SegNet(num_classes=ds.class_num, width=8),
+                        config=FedAvgConfig(
+                            comm_round=6, client_num_per_round=2,
+                            frequency_of_the_test=2,
+                            train=TrainConfig(epochs=4, batch_size=8,
+                                              lr=0.1)))
+        api.train()
+        last = api.history[-1]
+        assert last["test_acc"] > 0.5, api.history
+        assert 0.0 <= last["test_mIoU"] <= 1.0
+        assert last["test_mIoU"] > 0.2, api.history
