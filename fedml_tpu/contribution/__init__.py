@@ -1,0 +1,13 @@
+"""Client/feature contribution measurement (reference fedml_api/contribution/,
+the Starry-Hu fork's headline addition): leave-one-out influence for
+horizontal FL and kernel-SHAP (plain + federated-feature) for vertical FL."""
+
+from fedml_tpu.contribution.loo import LeaveOneOutMeasure
+from fedml_tpu.contribution.shap import (kernel_shap, kernel_shap_federated,
+                                         kernel_shap_federated_with_step,
+                                         shapley_kernel_weight)
+
+__all__ = [
+    "LeaveOneOutMeasure", "kernel_shap", "kernel_shap_federated",
+    "kernel_shap_federated_with_step", "shapley_kernel_weight",
+]

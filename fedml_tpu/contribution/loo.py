@@ -1,0 +1,71 @@
+"""Leave-one-out client influence for horizontal FL.
+
+Reference: fedml_api/contribution/horizontal/ — ``train_with_delete``
+(fedavg_api.py:250-295) retrains the federation with one client excluded
+from every round's sampling pool, and ``DeleteMeasure.compute_influence``
+(delete_measure.py:15-37) scores client k as the mean absolute prediction
+difference between the base model f and the retrained model f_{-k} on the
+test set.
+
+TPU-first: retraining reuses the compiled FedAvg round program — the
+``delete_client`` knob threads into the seeded sampler (core/sampling.py), so
+the base run and every LOO run share one jitted round and differ only in the
+sampled-index vector. The C+1 trainings are embarrassingly parallel across
+devices if desired; predictions diff on device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.data.base import FederatedDataset
+
+
+class LeaveOneOutMeasure:
+    def __init__(self, dataset: FederatedDataset, module_factory: Callable,
+                 config: Optional[FedAvgConfig] = None,
+                 task: str = "classification"):
+        """``module_factory()`` builds a fresh model instance (so each
+        retrain starts from the same seed-0 init, mirroring the reference's
+        fresh FedML model per measurement run)."""
+        self.ds = dataset
+        self.module_factory = module_factory
+        self.config = config or FedAvgConfig()
+        self.task = task
+        self.influence: List[Optional[float]] = [None] * dataset.client_num
+
+    def _train(self, delete_client: Optional[int]):
+        api = FedAvgAPI(self.ds, self.module_factory(), task=self.task,
+                        config=self.config, delete_client=delete_client)
+        for r in range(self.config.comm_round):
+            api.run_round(r)
+        return api
+
+    def _predict_probs(self, api: FedAvgAPI) -> jnp.ndarray:
+        xt, _ = self.ds.test_data_global
+        logits = api.module.apply(api.variables, jnp.asarray(xt),
+                                  train=False)
+        return jax.nn.softmax(logits, axis=-1)
+
+    def compute_influence(self) -> List[float]:
+        """Train base + one LOO run per client; influence_k = mean_i
+        |p_f(x_i) - p_{f_-k}(x_i)| summed over classes then averaged over
+        examples (reference DeleteMeasure.compute_influence semantics)."""
+        base = self._train(delete_client=None)
+        base_probs = self._predict_probs(base)
+        for k in range(self.ds.client_num):
+            loo = self._train(delete_client=k)
+            probs = self._predict_probs(loo)
+            self.influence[k] = float(
+                jnp.mean(jnp.sum(jnp.abs(base_probs - probs), axis=-1)))
+        return list(self.influence)
+
+    def ranked(self) -> List[int]:
+        """Client indices by descending influence."""
+        assert all(v is not None for v in self.influence), "run compute first"
+        return list(np.argsort(self.influence)[::-1])

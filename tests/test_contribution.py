@@ -1,0 +1,120 @@
+"""Contribution measurement: kernel SHAP exactness + LOO influence ranking."""
+
+import numpy as np
+
+from fedml_tpu.contribution import (kernel_shap, kernel_shap_federated,
+                                    kernel_shap_federated_with_step,
+                                    shapley_kernel_weight)
+
+
+class TestShapleyKernel:
+    def test_infinite_weight_endpoints(self):
+        assert shapley_kernel_weight(5, 0) == 10000.0
+        assert shapley_kernel_weight(5, 5) == 10000.0
+
+    def test_symmetric(self):
+        for s in range(1, 5):
+            assert np.isclose(shapley_kernel_weight(5, s),
+                              shapley_kernel_weight(5, 5 - s))
+
+
+class TestKernelShap:
+    def test_linear_model_exact(self):
+        """For f(x)=w.x+b with reference r: phi_i = w_i (x_i - r_i),
+        phi_0 = f(r) — kernel SHAP recovers this exactly."""
+        rng = np.random.RandomState(0)
+        M = 5
+        w = rng.randn(M)
+        b = 0.7
+        x = rng.randn(M)
+        r = rng.randn(M)
+
+        def f(V):
+            return V @ w + b
+
+        phi = kernel_shap(f, x, r, M)
+        np.testing.assert_allclose(phi[:M], w * (x - r), atol=1e-4)
+        np.testing.assert_allclose(phi[M], f(r[None])[0], atol=1e-4)
+
+    def test_efficiency_property(self):
+        """sum(phi) + base == f(x) for any model."""
+        rng = np.random.RandomState(1)
+        M = 4
+        x, r = rng.randn(M), np.zeros(M)
+
+        def f(V):
+            return np.sin(V).sum(axis=1) + (V ** 2).sum(axis=1)
+
+        phi = kernel_shap(f, x, r, M)
+        np.testing.assert_allclose(phi[:M].sum() + phi[M], f(x[None])[0],
+                                   atol=1e-3)
+
+
+class TestFederatedShap:
+    def test_block_gets_sum_of_member_values_linear(self):
+        """Linear model: the aggregated feature's value equals the sum of
+        its members' individual Shapley values."""
+        rng = np.random.RandomState(2)
+        M, fed_pos = 6, 3
+        w, x, r = rng.randn(M), rng.randn(M), np.zeros(M)
+
+        def f(V):
+            return V @ w
+
+        phi_full = kernel_shap(f, x, r, M)
+        phi_fed = kernel_shap_federated(f, x, r, M, fed_pos)
+        # visible features keep their values; block = sum of hidden ones
+        np.testing.assert_allclose(phi_fed[:fed_pos], phi_full[:fed_pos],
+                                   atol=1e-4)
+        np.testing.assert_allclose(phi_fed[fed_pos],
+                                   phi_full[fed_pos:M].sum(), atol=1e-4)
+
+    def test_interior_block_with_step(self):
+        rng = np.random.RandomState(3)
+        M, fed_pos, step = 6, 2, 2
+        w, x, r = rng.randn(M), rng.randn(M), np.zeros(M)
+
+        def f(V):
+            return V @ w
+
+        phi_full = kernel_shap(f, x, r, M)
+        phi = kernel_shap_federated_with_step(f, x, r, M, fed_pos, step)
+        # layout: features 0,1, block, 4, 5 -> columns sorted by index
+        np.testing.assert_allclose(phi[0], phi_full[0], atol=1e-4)
+        np.testing.assert_allclose(phi[1], phi_full[1], atol=1e-4)
+        np.testing.assert_allclose(phi[2], phi_full[2:4].sum(), atol=1e-4)
+        np.testing.assert_allclose(phi[3], phi_full[4], atol=1e-4)
+        np.testing.assert_allclose(phi[4], phi_full[5], atol=1e-4)
+
+
+class TestLeaveOneOut:
+    def test_unique_client_more_influential_than_duplicate(self):
+        from fedml_tpu.algorithms.fedavg import FedAvgConfig
+        from fedml_tpu.contribution import LeaveOneOutMeasure
+        from fedml_tpu.data.base import FederatedDataset
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        rng = np.random.RandomState(4)
+        centers = rng.randn(3, 8) * 3.0
+
+        def blob(cls, n):
+            y = np.full(n, cls, np.int32)
+            return ((centers[y] + 0.5 * rng.randn(n, 8)).astype(np.float32),
+                    y)
+
+        # clients 0 and 1: identical class-0 data; client 2: unique class 2
+        shared = blob(0, 40)
+        train = {0: shared, 1: shared, 2: blob(2, 40)}
+        test = {c: blob(c % 3, 12) for c in range(3)}
+        ds = FederatedDataset.from_client_arrays(train, test, 3)
+
+        loo = LeaveOneOutMeasure(
+            ds, lambda: LogisticRegression(num_classes=3),
+            FedAvgConfig(comm_round=4, client_num_per_round=3,
+                         frequency_of_the_test=100,
+                         train=TrainConfig(epochs=2, batch_size=8, lr=0.2)))
+        influence = loo.compute_influence()
+        assert all(v >= 0 for v in influence)
+        assert influence[2] > influence[0], influence
+        assert loo.ranked()[0] == 2
