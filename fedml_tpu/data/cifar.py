@@ -1,0 +1,128 @@
+"""CIFAR-10/100 and CINIC-10 with homo / hetero(LDA) / hetero-fix partition.
+
+Reference: fedml_api/data_preprocessing/cifar10/data_loader.py —
+``partition_data`` (:123, Dirichlet at :149), ``load_partition_data_cifar10``
+(:252); cifar100 and cinic10 mirror it. File format: the standard CIFAR
+python pickles (``data_batch_*`` / ``test_batch`` for 10,
+``train``/``test`` for 100); CINIC-10 additionally ships as an ImageFolder
+tree, which we support via a preconverted ``.npz``.
+
+Per-channel normalization constants match the reference's transforms
+(cifar10/data_loader.py:31-33). The LDA partition itself lives in
+core/partition.py (shared with every other dataset).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.core.partition import partition_data
+from fedml_tpu.data.base import FederatedDataset
+
+CIFAR10_MEAN = np.asarray([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.asarray([0.2470, 0.2435, 0.2616], np.float32)
+CIFAR100_MEAN = np.asarray([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.asarray([0.2673, 0.2564, 0.2762], np.float32)
+
+
+def _normalize(x: np.ndarray, mean, std) -> np.ndarray:
+    return ((x / 255.0) - mean) / std
+
+
+def _read_cifar10_dir(data_dir: str):
+    xs, ys = [], []
+    for fn in sorted(os.listdir(data_dir)):
+        if fn.startswith("data_batch"):
+            with open(os.path.join(data_dir, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+    with open(os.path.join(data_dir, "test_batch"), "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    x_train = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    x_test = np.asarray(d[b"data"]).reshape(-1, 3, 32, 32).transpose(
+        0, 2, 3, 1)
+    return (x_train.astype(np.float32), np.asarray(ys, np.int32),
+            x_test.astype(np.float32),
+            np.asarray(d[b"labels"], np.int32))
+
+
+def _read_cifar100_dir(data_dir: str):
+    def read(split):
+        with open(os.path.join(data_dir, split), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = np.asarray(d[b"data"]).reshape(-1, 3, 32, 32).transpose(
+            0, 2, 3, 1)
+        return x.astype(np.float32), np.asarray(d[b"fine_labels"], np.int32)
+
+    xt, yt = read("train")
+    xe, ye = read("test")
+    return xt, yt, xe, ye
+
+
+def _read_npz(path: str):
+    d = np.load(path)
+    return (d["x_train"].astype(np.float32), d["y_train"].astype(np.int32),
+            d["x_test"].astype(np.float32), d["y_test"].astype(np.int32))
+
+
+def load_partition_data_cifar(
+        dataset: str, data_dir: str, partition_method: str = "hetero",
+        partition_alpha: float = 0.5, client_number: int = 10,
+        seed: int = 0) -> FederatedDataset:
+    """dataset in {cifar10, cifar100, cinic10}; partition_method in
+    {homo, hetero, hetero-fix} (reference partition_data,
+    cifar10/data_loader.py:123-160). Test data stays global (the reference
+    gives every client the full test set; we store it once)."""
+    if dataset == "cifar10":
+        x_train, y_train, x_test, y_test = _read_cifar10_dir(data_dir)
+        mean, std, class_num = CIFAR10_MEAN, CIFAR10_STD, 10
+    elif dataset == "cifar100":
+        x_train, y_train, x_test, y_test = _read_cifar100_dir(data_dir)
+        mean, std, class_num = CIFAR100_MEAN, CIFAR100_STD, 100
+    elif dataset == "cinic10":
+        x_train, y_train, x_test, y_test = _read_npz(
+            os.path.join(data_dir, "cinic10.npz"))
+        mean, std, class_num = CIFAR10_MEAN, CIFAR10_STD, 10
+    else:
+        raise ValueError(f"unknown cifar-family dataset: {dataset!r}")
+
+    x_train = _normalize(x_train, mean, std)
+    x_test = _normalize(x_test, mean, std)
+
+    np.random.seed(seed)
+    mapping = partition_data(y_train, partition_method, client_number,
+                             alpha=partition_alpha, class_num=class_num)
+    train_local: Dict[int, Tuple] = {}
+    test_local: Dict[int, Optional[Tuple]] = {}
+    for c, idxs in mapping.items():
+        idxs = np.asarray(idxs)
+        train_local[c] = (x_train[idxs], y_train[idxs])
+        test_local[c] = None
+    ds = FederatedDataset.from_client_arrays(train_local, test_local,
+                                             class_num)
+    ds.test_data_num = len(x_test)
+    ds.test_data_global = (x_test, y_test)
+    return ds
+
+
+def augment_batch(x: np.ndarray, rng: np.random.RandomState,
+                  pad: int = 4) -> np.ndarray:
+    """Reference train-transform (random crop with padding + horizontal
+    flip, cifar10/data_loader.py:24-30) as a host-side numpy augment applied
+    when packing rounds."""
+    n, h, w, c = x.shape
+    padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                    mode="reflect")
+    out = np.empty_like(x)
+    offs = rng.randint(0, 2 * pad + 1, size=(n, 2))
+    flips = rng.rand(n) < 0.5
+    for i in range(n):
+        oy, ox = offs[i]
+        img = padded[i, oy:oy + h, ox:ox + w]
+        out[i] = img[:, ::-1] if flips[i] else img
+    return out

@@ -1,0 +1,90 @@
+"""Large image federations: ImageNet (ILSVRC2012) and Google Landmarks
+(gld23k / gld160k).
+
+Reference: fedml_api/data_preprocessing/ImageNet/{data_loader.py,
+datasets_hdf5.py} (per-client class splits over the ImageFolder tree or an
+hdf5 pack) and Landmarks/data_loader.py (csv mapping user_id -> image paths,
+233 clients for gld23k / 1262 for gld160k).
+
+Decoding JPEG trees is torchvision territory; for the TPU pipeline we read
+preconverted array packs (*.npz with x/y per split, or hdf5 with
+images/labels) — conversion is a one-time offline step — and do the
+federated split here: ImageNet's synthetic per-client class partition, and
+Landmarks' natural user split from its csv.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.core.partition import partition_data
+from fedml_tpu.data.base import FederatedDataset
+
+
+def _load_pack(path: str):
+    if path.endswith(".npz"):
+        d = np.load(path)
+        return (d["x_train"], d["y_train"].astype(np.int32),
+                d["x_test"], d["y_test"].astype(np.int32))
+    import h5py
+    with h5py.File(path, "r") as f:
+        return (np.asarray(f["x_train"]), np.asarray(f["y_train"], np.int32),
+                np.asarray(f["x_test"]), np.asarray(f["y_test"], np.int32))
+
+
+def load_partition_data_imagenet(
+        pack_path: str, client_number: int = 100,
+        partition_method: str = "hetero", partition_alpha: float = 0.5,
+        class_num: int = 1000, seed: int = 0) -> FederatedDataset:
+    """ImageNet from an array pack, LDA/homo partitioned (the reference's
+    per-client splits, ImageNet/data_loader.py:~300)."""
+    x_train, y_train, x_test, y_test = _load_pack(pack_path)
+    np.random.seed(seed)
+    mapping = partition_data(y_train, partition_method, client_number,
+                             alpha=partition_alpha, class_num=class_num)
+    train_local = {c: (x_train[np.asarray(i)].astype(np.float32),
+                       y_train[np.asarray(i)])
+                   for c, i in mapping.items()}
+    test_local: Dict[int, Optional[Tuple]] = {c: None for c in mapping}
+    ds = FederatedDataset.from_client_arrays(train_local, test_local,
+                                             class_num)
+    ds.test_data_global = (x_test.astype(np.float32), y_test)
+    ds.test_data_num = len(x_test)
+    return ds
+
+
+def read_landmarks_csv(csv_path: str):
+    """Landmarks federated split csv: rows of (user_id, image_id, class)
+    (reference Landmarks/data_loader.py mapping files)."""
+    users: Dict[str, list] = {}
+    with open(csv_path) as f:
+        reader = csv.DictReader(f)
+        for row in reader:
+            users.setdefault(row["user_id"], []).append(
+                (row["image_id"], int(row["class"])))
+    return users
+
+
+def load_partition_data_landmarks(
+        data_dir: str, split_csv: str, pack_name: str = "landmarks.npz",
+        class_num: int = 2028) -> FederatedDataset:
+    """Natural user split from the csv; image arrays from the pack keyed by
+    image_id order recorded in ``image_ids.txt``."""
+    users = read_landmarks_csv(os.path.join(data_dir, split_csv))
+    pack = np.load(os.path.join(data_dir, pack_name))
+    images = pack["images"]
+    with open(os.path.join(data_dir, "image_ids.txt")) as f:
+        id_to_row = {line.strip(): i for i, line in enumerate(f)}
+    train_local, test_local = {}, {}
+    for idx, (user, entries) in enumerate(sorted(users.items())):
+        rows = [id_to_row[i] for i, _ in entries if i in id_to_row]
+        labels = [c for i, c in entries if i in id_to_row]
+        train_local[idx] = (images[rows].astype(np.float32),
+                            np.asarray(labels, np.int32))
+        test_local[idx] = None
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               class_num)

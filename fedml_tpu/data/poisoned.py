@@ -1,0 +1,52 @@
+"""Backdoor / edge-case poisoning for robust-FL evaluation.
+
+Reference: fedml_api/data_preprocessing/edge_case_examples/data_loader.py:283
+``load_poisoned_dataset`` — ships pickled poisoned image sets (southwest
+airline planes -> "truck", ARDIS digits -> target label, etc.) that an
+attacker client trains on (FedAvgRobustTrainer.py:23-28). Those artifacts are
+download-time assets; the mechanism is (trigger or edge-case inputs) +
+(flipped target labels). This module implements the mechanism directly:
+- ``add_pixel_trigger`` — a bright patch trigger in a corner (BadNets-style)
+- ``poison_dataset`` — apply trigger to a fraction and flip to the target
+- ``make_backdoor_test_set`` — all-triggered inputs for attack-success-rate
+  measurement (the reference's ``test_target_accuracy``,
+  FedAvgRobustAggregator.py:270).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def add_pixel_trigger(x: np.ndarray, size: int = 3,
+                      value: Optional[float] = None) -> np.ndarray:
+    """Set a size x size bottom-right patch to the image max (trigger)."""
+    out = np.array(x, copy=True)
+    v = float(np.max(x)) if value is None else value
+    out[..., -size:, -size:, :] = v
+    return out
+
+
+def poison_dataset(x: np.ndarray, y: np.ndarray, target_label: int,
+                   poison_fraction: float = 0.5, trigger_size: int = 3,
+                   seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Trigger + label-flip a random fraction of (x, y)."""
+    rng = np.random.RandomState(seed)
+    n = len(x)
+    k = int(n * poison_fraction)
+    idx = rng.choice(n, k, replace=False)
+    xp = np.array(x, copy=True)
+    yp = np.array(y, copy=True)
+    xp[idx] = add_pixel_trigger(x[idx], size=trigger_size)
+    yp[idx] = target_label
+    return xp, yp
+
+
+def make_backdoor_test_set(x: np.ndarray, target_label: int,
+                           trigger_size: int = 3):
+    """All inputs triggered, all labels = target: accuracy on this set is
+    the attack success rate."""
+    return (add_pixel_trigger(x, size=trigger_size),
+            np.full(len(x), target_label, np.int32))

@@ -1,0 +1,111 @@
+"""Dataset dispatch by name — the experiment layer's ``load_data``.
+
+Mirrors the big if/elif in the reference experiment mains
+(fedml_experiments/distributed/fedavg/main_fedavg.py:120-227) as a registry:
+``load_data(dataset, data_dir, **opts) -> FederatedDataset``. Names match
+the reference's ``--dataset`` flag values.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from fedml_tpu.data.base import FederatedDataset
+
+
+def _mnist(data_dir, **kw):
+    from fedml_tpu.data.leaf import load_partition_data_mnist
+    return load_partition_data_mnist(data_dir)
+
+
+def _shakespeare(data_dir, **kw):
+    from fedml_tpu.data.leaf import load_partition_data_shakespeare
+    return load_partition_data_shakespeare(data_dir)
+
+
+def _synthetic_file(data_dir, **kw):
+    from fedml_tpu.data.leaf import load_partition_data_synthetic
+    return load_partition_data_synthetic(data_dir)
+
+
+def _femnist(data_dir, **kw):
+    from fedml_tpu.data.tff_h5 import load_partition_data_federated_emnist
+    return load_partition_data_federated_emnist(
+        data_dir, client_limit=kw.get("client_limit"))
+
+
+def _fed_cifar100(data_dir, **kw):
+    from fedml_tpu.data.tff_h5 import (
+        load_partition_data_federated_cifar100)
+    return load_partition_data_federated_cifar100(
+        data_dir, client_limit=kw.get("client_limit"))
+
+
+def _fed_shakespeare(data_dir, **kw):
+    from fedml_tpu.data.tff_h5 import (
+        load_partition_data_federated_shakespeare)
+    return load_partition_data_federated_shakespeare(
+        data_dir, client_limit=kw.get("client_limit"))
+
+
+def _cifar_family(name):
+    def load(data_dir, **kw):
+        from fedml_tpu.data.cifar import load_partition_data_cifar
+        return load_partition_data_cifar(
+            name, data_dir,
+            partition_method=kw.get("partition_method", "hetero"),
+            partition_alpha=kw.get("partition_alpha", 0.5),
+            client_number=kw.get("client_num_in_total", 10))
+    return load
+
+
+def _synthetic_generated(data_dir, **kw):
+    from fedml_tpu.data.synthetic import make_synthetic_federated
+    return make_synthetic_federated(
+        client_num=kw.get("client_num_in_total", 30))
+
+
+def _blob(data_dir, **kw):
+    from fedml_tpu.data.synthetic import make_blob_federated
+    return make_blob_federated(
+        client_num=kw.get("client_num_in_total", 10),
+        partition_method=kw.get("partition_method", "hetero"),
+        partition_alpha=kw.get("partition_alpha", 0.5))
+
+
+LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
+    "mnist": _mnist,
+    "shakespeare": _shakespeare,
+    "synthetic_1_1": _synthetic_file,
+    "femnist": _femnist,
+    "fed_cifar100": _fed_cifar100,
+    "fed_shakespeare": _fed_shakespeare,
+    "cifar10": _cifar_family("cifar10"),
+    "cifar100": _cifar_family("cifar100"),
+    "cinic10": _cifar_family("cinic10"),
+    "synthetic": _synthetic_generated,  # generated in-memory (no files)
+    "blob": _blob,                      # test/bench workhorse
+}
+
+# reference --dataset name -> (model factory name, task head)
+DEFAULT_MODEL_AND_TASK = {
+    "mnist": ("lr", "classification"),
+    "femnist": ("cnn", "classification"),
+    "fed_cifar100": ("resnet18_gn", "classification"),
+    "shakespeare": ("rnn", "nwp"),
+    "fed_shakespeare": ("rnn", "nwp"),
+    "stackoverflow_nwp": ("rnn_stackoverflow", "nwp"),
+    "stackoverflow_lr": ("lr", "tag_prediction"),
+    "cifar10": ("resnet56", "classification"),
+    "cifar100": ("resnet56", "classification"),
+    "cinic10": ("resnet56", "classification"),
+    "synthetic": ("lr", "classification"),
+    "blob": ("lr", "classification"),
+}
+
+
+def load_data(dataset: str, data_dir: str = "", **kw) -> FederatedDataset:
+    if dataset not in LOADERS:
+        raise ValueError(
+            f"unknown dataset {dataset!r}; known: {sorted(LOADERS)}")
+    return LOADERS[dataset](data_dir, **kw)

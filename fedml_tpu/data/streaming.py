@@ -1,0 +1,71 @@
+"""Streaming samples for decentralized online learning: UCI SUSY / Room
+Occupancy.
+
+Reference: fedml_api/data_preprocessing/UCI/data_loader_for_susy_and_ro.py —
+each worker consumes one (x_t, y_t) sample per iteration from its own stream;
+the regret metric compares cumulative loss against the best fixed model in
+hindsight (fedml_api/standalone/decentralized/decentralized_fl_api.py:11).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+
+def read_streaming_csv(path: str, label_first: bool = True,
+                       limit: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """SUSY layout: label, then features (label_first=True); RoomOccupancy:
+    features then trailing label (label_first=False). Labels mapped to
+    {-1, +1} for the online-learning losses."""
+    xs, ys = [], []
+    with open(path) as f:
+        for i, row in enumerate(csv.reader(f)):
+            if limit and i >= limit:
+                break
+            vals = [float(v) for v in row if v != ""]
+            if label_first:
+                y, feat = vals[0], vals[1:]
+            else:
+                y, feat = vals[-1], vals[:-1]
+            ys.append(1.0 if y > 0.5 else -1.0)
+            xs.append(feat)
+    return (np.asarray(xs, np.float32),
+            np.asarray(ys, np.float32))
+
+
+class StreamingFederation:
+    """Per-worker sample streams: worker w sees samples w, w+N, w+2N, ...
+    (round-robin split of the file, matching the reference's per-process
+    stream slicing)."""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, num_workers: int):
+        self.num_workers = num_workers
+        self.x, self.y = x, y
+        self.per_worker = len(x) // num_workers
+
+    def worker_stream(self, w: int) -> Iterator[Tuple[np.ndarray, float]]:
+        for t in range(self.per_worker):
+            i = t * self.num_workers + w
+            yield self.x[i], float(self.y[i])
+
+    def worker_arrays(self, w: int, iterations: int):
+        idx = np.arange(iterations) * self.num_workers + w
+        return self.x[idx], self.y[idx]
+
+
+def load_susy(data_dir: str, num_workers: int,
+              limit: int = 0) -> StreamingFederation:
+    x, y = read_streaming_csv(os.path.join(data_dir, "SUSY.csv"),
+                              label_first=True, limit=limit)
+    return StreamingFederation(x, y, num_workers)
+
+
+def load_room_occupancy(data_dir: str, num_workers: int,
+                        limit: int = 0) -> StreamingFederation:
+    x, y = read_streaming_csv(os.path.join(data_dir, "datatraining.txt"),
+                              label_first=False, limit=limit)
+    return StreamingFederation(x, y, num_workers)

@@ -1,0 +1,114 @@
+"""Vertical-FL tabular datasets: cervical cancer, Lending Club, NUS-WIDE.
+
+Reference loaders: fedml_api/data_preprocessing/{cervical_cancer/...,
+lending_club_loan/lending_club_dataset.py, NUS_WIDE/nus_wide_dataset.py} —
+each produces party-wise FEATURE SLICES of vertically aligned samples (same
+rows, disjoint columns) plus binary labels held by the guest. The generic
+core here is ``load_vertical_csv``: robust csv ingestion (NA handling,
+z-score normalization) and a column split into parties; the named wrappers
+pin each dataset's label column and default party split.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def read_csv_numeric(path: str, label_col: str,
+                     na_values: Sequence[str] = ("?", "", "NA", "na")):
+    """Parse a csv into (feature matrix, labels, feature names); non-numeric
+    or NA cells become column-mean (the reference's cervical-cancer cleanup
+    semantics)."""
+    with open(path) as f:
+        reader = csv.reader(f)
+        header = next(reader)
+        rows = list(reader)
+    li = header.index(label_col)
+    feat_names = [h for i, h in enumerate(header) if i != li]
+    X = np.full((len(rows), len(feat_names)), np.nan, np.float64)
+    y = np.zeros(len(rows), np.int32)
+    for r, row in enumerate(rows):
+        ci = 0
+        for i, cell in enumerate(row):
+            if i == li:
+                y[r] = int(float(cell))
+                continue
+            if cell not in na_values:
+                try:
+                    X[r, ci] = float(cell)
+                except ValueError:
+                    pass
+            ci += 1
+    col_mean = np.nanmean(X, axis=0)
+    col_mean = np.where(np.isnan(col_mean), 0.0, col_mean)
+    nan_mask = np.isnan(X)
+    X[nan_mask] = np.take(col_mean, np.where(nan_mask)[1])
+    return X.astype(np.float32), y, feat_names
+
+
+def zscore(X: np.ndarray) -> np.ndarray:
+    mu = X.mean(axis=0)
+    sd = X.std(axis=0)
+    sd[sd == 0] = 1.0
+    return (X - mu) / sd
+
+
+def split_parties(X: np.ndarray,
+                  party_feature_counts: Sequence[int]) -> List[np.ndarray]:
+    """Disjoint column slices per party; counts must sum to n_features."""
+    assert sum(party_feature_counts) == X.shape[1], (
+        f"party split {party_feature_counts} != {X.shape[1]} features")
+    parts, off = [], 0
+    for n in party_feature_counts:
+        parts.append(X[:, off:off + n])
+        off += n
+    return parts
+
+
+def load_vertical_csv(path: str, label_col: str,
+                      party_feature_counts: Optional[Sequence[int]] = None,
+                      test_fraction: float = 0.2, seed: int = 0):
+    """Returns (train_parts, y_train, test_parts, y_test): aligned vertical
+    slices, z-scored, shuffled once with a fixed seed."""
+    X, y, _ = read_csv_numeric(path, label_col)
+    X = zscore(X)
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(y))
+    X, y = X[order], y[order]
+    if party_feature_counts is None:
+        half = X.shape[1] // 2
+        party_feature_counts = [half, X.shape[1] - half]
+    n_test = int(len(y) * test_fraction)
+    parts = split_parties(X, party_feature_counts)
+    train_parts = [p[n_test:] for p in parts]
+    test_parts = [p[:n_test] for p in parts]
+    return train_parts, y[n_test:], test_parts, y[:n_test]
+
+
+def load_cervical_cancer(data_dir: str, **kw):
+    """kag_risk_factors_cervical_cancer.csv, label ``Biopsy`` (reference
+    cervical_cancer/ loader)."""
+    return load_vertical_csv(
+        os.path.join(data_dir, "kag_risk_factors_cervical_cancer.csv"),
+        label_col="Biopsy", **kw)
+
+
+def load_lending_club(data_dir: str, label_col: str = "loan_status", **kw):
+    """loan.csv numeric subset (reference
+    lending_club_loan/lending_club_dataset.py)."""
+    return load_vertical_csv(os.path.join(data_dir, "loan.csv"),
+                             label_col=label_col, **kw)
+
+
+def load_nus_wide(data_dir: str, target_label: str = "water",
+                  n_parties: int = 2, **kw):
+    """NUS-WIDE low-level features + tags (reference
+    NUS_WIDE/nus_wide_dataset.py two-party split): expects a preconverted
+    ``nus_wide_<label>.csv`` with a 0/1 ``label`` column."""
+    return load_vertical_csv(
+        os.path.join(data_dir, f"nus_wide_{target_label}.csv"),
+        label_col="label", **kw)

@@ -1,0 +1,269 @@
+"""TFF-style h5 federated datasets: FederatedEMNIST, fed_cifar100,
+fed_shakespeare, StackOverflow (next-word + tag-prediction).
+
+Reference readers (all under fedml_api/data_preprocessing/):
+- FederatedEMNIST/data_loader.py:26 — h5 ``examples/<client>/pixels|label``,
+  3400 natural clients
+- fed_cifar100/data_loader.py — ``examples/<client>/image|label``, 500
+  clients
+- fed_shakespeare/{data_loader.py:45, utils.py} — ``examples/<client>/
+  snippets``; char vocab + <pad>=0/<bos>/<eos>, sequence length 80
+- stackoverflow_nwp/{data_loader.py, utils.py:56 tokenizer} — ``examples/
+  <client>/tokens`` sentences to id sequences (vocab 10k + oov/bos/eos/pad)
+- stackoverflow_lr/{data_loader.py, utils.py:65-95} — bag-of-words inputs
+  (vocab 10k) + multi-hot tag targets (500 tags)
+
+h5py is imported lazily so environments without it still load the package.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fedml_tpu.data.base import FederatedDataset
+
+_EXAMPLE = "examples"
+
+# fed_shakespeare vocab (fed_shakespeare/utils.py:15-30)
+SEQUENCE_LENGTH = 80
+CHAR_VOCAB = list(
+    "dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#'/37;?bfjnrvzBFJNRVZ\"&*.26:"
+    "\naeimquyAEIMQUY]!%)-159\r"
+)
+PAD, BOS, EOS = 0, len(CHAR_VOCAB) + 1, len(CHAR_VOCAB) + 2
+SHAKESPEARE_VOCAB_LEN = len(CHAR_VOCAB) + 3  # pad + chars + bos + eos
+_CHAR_TO_ID = {c: i + 1 for i, c in enumerate(CHAR_VOCAB)}
+
+
+def _h5():
+    import h5py
+    return h5py
+
+
+def _decode(v) -> str:
+    return v.decode() if isinstance(v, bytes) else str(v)
+
+
+def _client_ids(h5file) -> List[str]:
+    return list(h5file[_EXAMPLE].keys())
+
+
+def _build(train_local, test_local, class_num) -> FederatedDataset:
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               class_num)
+
+
+# -- FederatedEMNIST --------------------------------------------------------
+
+def load_partition_data_federated_emnist(
+        data_dir: str, train_file: str = "fed_emnist_train.h5",
+        test_file: str = "fed_emnist_test.h5",
+        client_limit: Optional[int] = None) -> FederatedDataset:
+    """28x28 grayscale, 62 classes, natural client split
+    (FederatedEMNIST/data_loader.py:26-66, :103-150)."""
+    h5py = _h5()
+    with h5py.File(os.path.join(data_dir, train_file), "r") as tr, \
+            h5py.File(os.path.join(data_dir, test_file), "r") as te:
+        ids = _client_ids(tr)[:client_limit]
+        test_ids = set(_client_ids(te))
+        train_local, test_local = {}, {}
+        for idx, cid in enumerate(ids):
+            g = tr[_EXAMPLE][cid]
+            x = np.asarray(g["pixels"][()], np.float32)[..., None]
+            y = np.asarray(g["label"][()], np.int32).reshape(-1)
+            train_local[idx] = (x, y)
+            if cid in test_ids:
+                gt = te[_EXAMPLE][cid]
+                test_local[idx] = (
+                    np.asarray(gt["pixels"][()], np.float32)[..., None],
+                    np.asarray(gt["label"][()], np.int32).reshape(-1))
+            else:
+                test_local[idx] = None
+    return _build(train_local, test_local, 62)
+
+
+# -- fed_cifar100 -----------------------------------------------------------
+
+def load_partition_data_federated_cifar100(
+        data_dir: str, train_file: str = "fed_cifar100_train.h5",
+        test_file: str = "fed_cifar100_test.h5",
+        client_limit: Optional[int] = None) -> FederatedDataset:
+    """32x32x3, 100 classes, 500 Pachinko clients
+    (fed_cifar100/data_loader.py)."""
+    h5py = _h5()
+    with h5py.File(os.path.join(data_dir, train_file), "r") as tr, \
+            h5py.File(os.path.join(data_dir, test_file), "r") as te:
+        ids = _client_ids(tr)[:client_limit]
+        test_ids = set(_client_ids(te))
+        train_local, test_local = {}, {}
+        for idx, cid in enumerate(ids):
+            g = tr[_EXAMPLE][cid]
+            x = np.asarray(g["image"][()], np.float32) / 255.0
+            y = np.asarray(g["label"][()], np.int32).reshape(-1)
+            train_local[idx] = (x, y)
+            if cid in test_ids:
+                gt = te[_EXAMPLE][cid]
+                test_local[idx] = (
+                    np.asarray(gt["image"][()], np.float32) / 255.0,
+                    np.asarray(gt["label"][()], np.int32).reshape(-1))
+            else:
+                test_local[idx] = None
+    return _build(train_local, test_local, 100)
+
+
+# -- fed_shakespeare --------------------------------------------------------
+
+def shakespeare_snippet_to_ids(snippet: str) -> List[np.ndarray]:
+    """<bos> + char ids + <eos>, split into SEQUENCE_LENGTH+1 windows,
+    0-padded (fed_shakespeare/utils.py preprocess/to_ids semantics); each
+    window yields (x = w[:-1], y = w[1:])."""
+    ids = [BOS] + [_CHAR_TO_ID.get(c, 0) for c in snippet] + [EOS]
+    out = []
+    for s in range(0, len(ids), SEQUENCE_LENGTH):
+        w = ids[s:s + SEQUENCE_LENGTH + 1]
+        if len(w) < 2:
+            continue
+        if len(w) < SEQUENCE_LENGTH + 1:
+            w = w + [PAD] * (SEQUENCE_LENGTH + 1 - len(w))
+        out.append(np.asarray(w, np.int32))
+    return out
+
+
+def load_partition_data_federated_shakespeare(
+        data_dir: str, train_file: str = "shakespeare_train.h5",
+        test_file: str = "shakespeare_test.h5",
+        client_limit: Optional[int] = None) -> FederatedDataset:
+    """(fed_shakespeare/data_loader.py:40-60) — x/y are the 80-token shifted
+    window pair."""
+    h5py = _h5()
+
+    def client_arrays(g) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        windows = []
+        for snippet in g["snippets"][()]:
+            windows.extend(shakespeare_snippet_to_ids(_decode(snippet)))
+        if not windows:
+            return None
+        w = np.stack(windows)
+        return w[:, :-1], w[:, 1:]
+
+    with h5py.File(os.path.join(data_dir, train_file), "r") as tr, \
+            h5py.File(os.path.join(data_dir, test_file), "r") as te:
+        ids = _client_ids(tr)[:client_limit]
+        test_ids = set(_client_ids(te))
+        train_local, test_local = {}, {}
+        idx = 0
+        for cid in ids:
+            arrs = client_arrays(tr[_EXAMPLE][cid])
+            if arrs is None:
+                continue
+            train_local[idx] = arrs
+            test_local[idx] = (client_arrays(te[_EXAMPLE][cid])
+                               if cid in test_ids else None)
+            idx += 1
+    return _build(train_local, test_local, SHAKESPEARE_VOCAB_LEN)
+
+
+# -- StackOverflow ----------------------------------------------------------
+
+def so_tokenizer(sentence: str, vocab: Dict[str, int], max_seq_len: int = 20,
+                 num_oov_buckets: int = 1) -> np.ndarray:
+    """Sentence -> [1+max_seq_len+1] ids: bos + word ids (+oov) + eos, padded
+    (stackoverflow_nwp/utils.py:56-82). Layout: pad=0, words=1..V,
+    oov=V+1..V+oov, bos=V+oov+1, eos=V+oov+2."""
+    V = len(vocab)
+    oov0, bos, eos = V + 1, V + num_oov_buckets + 1, V + num_oov_buckets + 2
+    words = sentence.split(" ")[:max_seq_len]
+    ids = [bos] + [vocab.get(w, oov0 + (hash(w) % num_oov_buckets))
+                   for w in words] + [eos]
+    ids += [0] * (max_seq_len + 2 - len(ids))
+    return np.asarray(ids, np.int32)
+
+
+def load_partition_data_federated_stackoverflow_nwp(
+        data_dir: str, vocab_words: Sequence[str],
+        train_file: str = "stackoverflow_train.h5",
+        test_file: str = "stackoverflow_test.h5",
+        client_limit: Optional[int] = None,
+        max_seq_len: int = 20) -> FederatedDataset:
+    """Next-word prediction over ``examples/<client>/tokens``
+    (stackoverflow_nwp/data_loader.py); ``vocab_words`` is the frequency-
+    ranked word list (reference reads the top-10k vocab file,
+    utils.py:24-31)."""
+    h5py = _h5()
+    vocab = {w: i + 1 for i, w in enumerate(vocab_words)}
+
+    def client_arrays(g):
+        seqs = [so_tokenizer(_decode(s), vocab, max_seq_len)
+                for s in g["tokens"][()]]
+        if not seqs:
+            return None
+        w = np.stack(seqs)
+        return w[:, :-1], w[:, 1:]
+
+    with h5py.File(os.path.join(data_dir, train_file), "r") as tr, \
+            h5py.File(os.path.join(data_dir, test_file), "r") as te:
+        ids = _client_ids(tr)[:client_limit]
+        test_ids = set(_client_ids(te))
+        train_local, test_local = {}, {}
+        idx = 0
+        for cid in ids:
+            arrs = client_arrays(tr[_EXAMPLE][cid])
+            if arrs is None:
+                continue
+            train_local[idx] = arrs
+            test_local[idx] = (client_arrays(te[_EXAMPLE][cid])
+                               if cid in test_ids else None)
+            idx += 1
+    vocab_len = len(vocab_words) + 1 + 1 + 2  # pad + words + oov + bos/eos
+    return _build(train_local, test_local, vocab_len)
+
+
+def load_partition_data_federated_stackoverflow_lr(
+        data_dir: str, vocab_words: Sequence[str], tag_words: Sequence[str],
+        train_file: str = "stackoverflow_train.h5",
+        test_file: str = "stackoverflow_test.h5",
+        client_limit: Optional[int] = None) -> FederatedDataset:
+    """Tag prediction: x = normalized bag-of-words over the token vocab,
+    y = multi-hot over the tag vocab (stackoverflow_lr/utils.py:65-95)."""
+    h5py = _h5()
+    vocab = {w: i for i, w in enumerate(vocab_words)}
+    tags = {t: i for i, t in enumerate(tag_words)}
+    V, T = len(vocab), len(tags)
+
+    def client_arrays(g):
+        xs, ys = [], []
+        for sent, tag_str in zip(g["tokens"][()], g["tags"][()]):
+            bow = np.zeros(V, np.float32)
+            toks = [vocab[w] for w in _decode(sent).split(" ") if w in vocab]
+            for t in toks:
+                bow[t] += 1.0
+            if toks:
+                bow /= len(toks)
+            mh = np.zeros(T, np.float32)
+            for t in _decode(tag_str).split("|"):
+                if t in tags:
+                    mh[tags[t]] = 1.0
+            xs.append(bow)
+            ys.append(mh)
+        if not xs:
+            return None
+        return np.stack(xs), np.stack(ys)
+
+    with h5py.File(os.path.join(data_dir, train_file), "r") as tr, \
+            h5py.File(os.path.join(data_dir, test_file), "r") as te:
+        ids = _client_ids(tr)[:client_limit]
+        test_ids = set(_client_ids(te))
+        train_local, test_local = {}, {}
+        idx = 0
+        for cid in ids:
+            arrs = client_arrays(tr[_EXAMPLE][cid])
+            if arrs is None:
+                continue
+            train_local[idx] = arrs
+            test_local[idx] = (client_arrays(te[_EXAMPLE][cid])
+                               if cid in test_ids else None)
+            idx += 1
+    return _build(train_local, test_local, T)

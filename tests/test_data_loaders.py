@@ -1,0 +1,252 @@
+"""Data layer: real-format readers against generated fixture files.
+
+Each test writes a tiny file in the dataset's actual on-disk format (LEAF
+json, TFF h5, CIFAR pickle, csv) and checks the FederatedDataset 9-tuple
+contract plus format-specific invariants (vocab mapping, shifted LM targets,
+partition coverage, poisoning).
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data.base import FederatedDataset
+
+
+def check_contract(ds: FederatedDataset):
+    (client_num, train_num, test_num, train_g, test_g, num_dict, train_d,
+     test_d, class_num) = ds.as_tuple()
+    assert client_num == len(train_d) == len(num_dict)
+    assert train_num == sum(num_dict.values()) == len(train_g[0])
+    assert len(train_g[0]) == len(train_g[1])
+    for c, (x, y) in train_d.items():
+        assert num_dict[c] == len(x) == len(y)
+    assert class_num > 0
+
+
+class TestLeaf:
+    def _write_leaf(self, d, users):
+        os.makedirs(os.path.join(d, "train"))
+        os.makedirs(os.path.join(d, "test"))
+        rng = np.random.RandomState(0)
+
+        def blob(n):
+            return {"x": rng.rand(n, 784).tolist(),
+                    "y": rng.randint(0, 10, n).tolist()}
+
+        train = {"users": users, "num_samples": [5] * len(users),
+                 "user_data": {u: blob(5 + i) for i, u in enumerate(users)}}
+        test = {"users": users, "num_samples": [3] * len(users),
+                "user_data": {u: blob(3) for u in users}}
+        with open(os.path.join(d, "train", "all_data.json"), "w") as f:
+            json.dump(train, f)
+        with open(os.path.join(d, "test", "all_data.json"), "w") as f:
+            json.dump(test, f)
+
+    def test_mnist(self, tmp_path):
+        from fedml_tpu.data.leaf import load_partition_data_mnist
+        d = str(tmp_path / "mnist")
+        self._write_leaf(d, ["f_0001", "f_0002", "f_0003"])
+        ds = load_partition_data_mnist(d)
+        check_contract(ds)
+        assert ds.client_num == 3 and ds.class_num == 10
+        # power-law sizes preserved per client
+        assert ds.train_data_local_num_dict[2] == 7
+
+    def test_shakespeare_shifted_targets(self, tmp_path):
+        from fedml_tpu.data.leaf import (ALL_LETTERS,
+                                         load_partition_data_shakespeare)
+        d = str(tmp_path / "shake")
+        os.makedirs(os.path.join(d, "train"))
+        os.makedirs(os.path.join(d, "test"))
+        ctx = "the quick brown fox jumps over the lazy dog " * 2
+        blob = {"users": ["romeo"], "num_samples": [2],
+                "user_data": {"romeo": {"x": [ctx[:80], ctx[1:81]],
+                                        "y": [ctx[80], ctx[81]]}}}
+        for split in ("train", "test"):
+            with open(os.path.join(d, split, "data.json"), "w") as f:
+                json.dump(blob, f)
+        ds = load_partition_data_shakespeare(d)
+        check_contract(ds)
+        x, y = ds.train_data_local_dict[0]
+        assert x.shape == (2, 80) and y.shape == (2, 80)
+        # y is x shifted left by one with the next char appended
+        np.testing.assert_array_equal(x[0, 1:], y[0, :-1])
+        assert y[0, -1] == ALL_LETTERS.find(ctx[80])
+
+
+class TestTffH5:
+    def _write_h5(self, path, clients):
+        import h5py
+        with h5py.File(path, "w") as f:
+            for cid, arrays in clients.items():
+                g = f.create_group(f"examples/{cid}")
+                for k, v in arrays.items():
+                    g.create_dataset(k, data=v)
+
+    def test_femnist(self, tmp_path):
+        from fedml_tpu.data.tff_h5 import (
+            load_partition_data_federated_emnist)
+        rng = np.random.RandomState(1)
+        clients = {f"f{i}": {"pixels": rng.rand(6, 28, 28),
+                             "label": rng.randint(0, 62, (6, 1))}
+                   for i in range(3)}
+        self._write_h5(str(tmp_path / "fed_emnist_train.h5"), clients)
+        self._write_h5(str(tmp_path / "fed_emnist_test.h5"), clients)
+        ds = load_partition_data_federated_emnist(str(tmp_path))
+        check_contract(ds)
+        assert ds.class_num == 62
+        assert ds.train_data_local_dict[0][0].shape == (6, 28, 28, 1)
+
+    def test_fed_shakespeare_windows(self, tmp_path):
+        from fedml_tpu.data.tff_h5 import (
+            BOS, EOS, SHAKESPEARE_VOCAB_LEN,
+            load_partition_data_federated_shakespeare)
+        text = "to be or not to be that is the question " * 5
+        clients = {"bard": {"snippets": np.array(
+            [text.encode(), b"short"], dtype="S300")}}
+        self._write_h5(str(tmp_path / "shakespeare_train.h5"), clients)
+        self._write_h5(str(tmp_path / "shakespeare_test.h5"), clients)
+        ds = load_partition_data_federated_shakespeare(str(tmp_path))
+        check_contract(ds)
+        assert ds.class_num == SHAKESPEARE_VOCAB_LEN
+        x, y = ds.train_data_local_dict[0]
+        assert x.shape[1] == 80 and y.shape[1] == 80
+        assert x[0, 0] == BOS
+        np.testing.assert_array_equal(x[0, 1:], y[0, :-1])
+        # the short snippet's window ends with EOS then padding
+        row = np.concatenate([x[-1], y[-1][-1:]])
+        assert EOS in row and 0 in row
+
+    def test_stackoverflow_nwp_vocab(self, tmp_path):
+        from fedml_tpu.data.tff_h5 import (
+            load_partition_data_federated_stackoverflow_nwp, so_tokenizer)
+        vocab_words = ["how", "to", "use", "jax"]
+        ids = so_tokenizer("how to use torch", dict(
+            (w, i + 1) for i, w in enumerate(vocab_words)), max_seq_len=6)
+        # bos=V+oov+1=6, how=1, to=2, use=3, torch=oov=5, eos=7, pads
+        np.testing.assert_array_equal(ids, [6, 1, 2, 3, 5, 7, 0, 0])
+        clients = {"dev": {"tokens": np.array(
+            [b"how to use jax", b"to jax"], dtype="S50")}}
+        self._write_h5(str(tmp_path / "stackoverflow_train.h5"), clients)
+        self._write_h5(str(tmp_path / "stackoverflow_test.h5"), clients)
+        ds = load_partition_data_federated_stackoverflow_nwp(
+            str(tmp_path), vocab_words)
+        check_contract(ds)
+        assert ds.class_num == len(vocab_words) + 4
+
+    def test_stackoverflow_lr_multihot(self, tmp_path):
+        from fedml_tpu.data.tff_h5 import (
+            load_partition_data_federated_stackoverflow_lr)
+        clients = {"dev": {
+            "tokens": np.array([b"python jax python"], dtype="S50"),
+            "tags": np.array([b"ml|compilers"], dtype="S50")}}
+        self._write_h5(str(tmp_path / "stackoverflow_train.h5"), clients)
+        self._write_h5(str(tmp_path / "stackoverflow_test.h5"), clients)
+        ds = load_partition_data_federated_stackoverflow_lr(
+            str(tmp_path), ["python", "jax", "numpy"],
+            ["ml", "systems", "compilers"])
+        check_contract(ds)
+        x, y = ds.train_data_local_dict[0]
+        np.testing.assert_allclose(x[0], [2 / 3, 1 / 3, 0])
+        np.testing.assert_array_equal(y[0], [1, 0, 1])
+
+
+class TestCifar:
+    def test_cifar10_partition(self, tmp_path):
+        from fedml_tpu.data.cifar import load_partition_data_cifar
+        rng = np.random.RandomState(2)
+        d = str(tmp_path)
+        for b in range(1, 3):
+            with open(os.path.join(d, f"data_batch_{b}"), "wb") as f:
+                pickle.dump({b"data": rng.randint(
+                    0, 255, (40, 3072), np.uint8),
+                    b"labels": rng.randint(0, 10, 40).tolist()}, f)
+        with open(os.path.join(d, "test_batch"), "wb") as f:
+            pickle.dump({b"data": rng.randint(0, 255, (20, 3072), np.uint8),
+                         b"labels": rng.randint(0, 10, 20).tolist()}, f)
+        ds = load_partition_data_cifar("cifar10", d, "hetero", 0.5, 4)
+        check_contract(ds)
+        assert ds.train_data_num == 80
+        assert ds.test_data_num == 20
+        assert ds.train_data_local_dict[0][0].shape[1:] == (32, 32, 3)
+        # every training example assigned to exactly one client
+        assert sum(ds.train_data_local_num_dict.values()) == 80
+
+    def test_augment_shapes_and_flip(self):
+        from fedml_tpu.data.cifar import augment_batch
+        rng = np.random.RandomState(3)
+        x = rng.rand(10, 32, 32, 3).astype(np.float32)
+        out = augment_batch(x, rng)
+        assert out.shape == x.shape
+        assert not np.allclose(out, x)
+
+
+class TestVerticalTabular:
+    def test_csv_parties(self, tmp_path):
+        from fedml_tpu.data.tabular import load_vertical_csv
+        p = str(tmp_path / "data.csv")
+        rng = np.random.RandomState(4)
+        with open(p, "w") as f:
+            f.write("a,b,c,d,label\n")
+            for _ in range(50):
+                vals = rng.randn(4)
+                f.write(",".join(f"{v:.3f}" for v in vals) +
+                        f",{int(vals.sum() > 0)}\n")
+        tr, ytr, te, yte = load_vertical_csv(p, "label", [2, 2],
+                                             test_fraction=0.2)
+        assert len(tr) == 2 and tr[0].shape[1] == 2
+        assert len(ytr) == 40 and len(yte) == 10
+        # z-scored
+        assert abs(np.concatenate([tr[0], te[0]]).mean()) < 0.2
+
+    def test_na_handling(self, tmp_path):
+        from fedml_tpu.data.tabular import read_csv_numeric
+        p = str(tmp_path / "na.csv")
+        with open(p, "w") as f:
+            f.write("x,y,label\n1.0,?,0\n3.0,4.0,1\n")
+        X, y, names = read_csv_numeric(p, "label")
+        assert names == ["x", "y"]
+        np.testing.assert_allclose(X, [[1.0, 4.0], [3.0, 4.0]])
+
+
+class TestStreaming:
+    def test_round_robin_streams(self, tmp_path):
+        from fedml_tpu.data.streaming import load_susy
+        p = str(tmp_path / "SUSY.csv")
+        with open(p, "w") as f:
+            for i in range(12):
+                f.write(f"{i % 2},{i}.0,{i + 1}.0\n")
+        fed = load_susy(str(tmp_path), num_workers=3)
+        x0, y0 = fed.worker_arrays(0, 4)
+        assert x0[0, 0] == 0.0 and x0[1, 0] == 3.0  # samples 0, 3, 6, 9
+        assert set(np.unique(y0)) <= {-1.0, 1.0}
+
+
+class TestPoisoned:
+    def test_trigger_and_flip(self):
+        from fedml_tpu.data.poisoned import (make_backdoor_test_set,
+                                             poison_dataset)
+        rng = np.random.RandomState(5)
+        x = rng.rand(20, 8, 8, 3).astype(np.float32)
+        y = rng.randint(0, 10, 20).astype(np.int32)
+        xp, yp = poison_dataset(x, y, target_label=7, poison_fraction=0.5)
+        flipped = yp == 7
+        assert 5 <= flipped.sum() <= 15
+        # triggered images have the max-value patch
+        changed = ~np.isclose(xp, x).all(axis=(1, 2, 3))
+        assert (xp[changed][:, -3:, -3:, :] == xp[changed].max()).all()
+        xt, yt = make_backdoor_test_set(x, 7)
+        assert (yt == 7).all() and xt.shape == x.shape
+
+
+class TestRegistry:
+    def test_dispatch_and_unknown(self):
+        from fedml_tpu.data.registry import load_data
+        ds = load_data("blob", client_num_in_total=4)
+        check_contract(ds)
+        with pytest.raises(ValueError, match="unknown dataset"):
+            load_data("imagenet22k")
