@@ -40,4 +40,19 @@ def create_model(model_name: str, output_dim: int = 10, **kw):
     if model_name in ("vgg11", "vgg13", "vgg16", "vgg19"):
         from fedml_tpu.models.vgg import VGG
         return VGG(arch=model_name, num_classes=output_dim, **kw)
+    if model_name.startswith("efficientnet"):
+        from fedml_tpu.models.efficientnet import efficientnet
+        return efficientnet(model_name, num_classes=output_dim)
+    if model_name == "resnet8_gkt":
+        from fedml_tpu.models.resnet_gkt import resnet8_56
+        return resnet8_56(num_classes=output_dim)
+    if model_name == "resnet56_gkt_server":
+        from fedml_tpu.models.resnet_gkt import resnet56_server
+        return resnet56_server(num_classes=output_dim)
+    if model_name == "segnet":
+        from fedml_tpu.models.segnet import SegNet
+        return SegNet(num_classes=output_dim, **kw)
+    if model_name == "darts":
+        from fedml_tpu.models.darts import DartsNetwork
+        return DartsNetwork(num_classes=output_dim, **kw)
     raise ValueError(f"unknown model: {model_name!r}")

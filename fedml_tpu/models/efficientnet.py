@@ -1,0 +1,135 @@
+"""EfficientNet B0-B7 in flax/NHWC.
+
+Reference: fedml_api/model/cv/efficientnet.py:138 (EfficientNet with MBConv
+blocks, squeeze-excitation, swish, width/depth compound scaling per
+efficientnet_utils.py's coefficient table). Implemented from the documented
+architecture (Tan & Le 2019): stem conv, 7 MBConv stages, head conv, pool,
+classifier. Drop-connect is implemented as per-example stochastic depth
+under the ``dropout`` rng.
+
+TPU notes: depthwise convs map to MXU poorly relative to dense convs, but
+XLA fuses the SE and swish elementwise chains into the surrounding convs;
+everything static-shaped. BatchNorm via ``batch_stats``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# (width_mult, depth_mult, resolution, dropout) per variant
+PARAMS = {
+    "efficientnet-b0": (1.0, 1.0, 224, 0.2),
+    "efficientnet-b1": (1.0, 1.1, 240, 0.2),
+    "efficientnet-b2": (1.1, 1.2, 260, 0.3),
+    "efficientnet-b3": (1.2, 1.4, 300, 0.3),
+    "efficientnet-b4": (1.4, 1.8, 380, 0.4),
+    "efficientnet-b5": (1.6, 2.2, 456, 0.4),
+    "efficientnet-b6": (1.8, 2.6, 528, 0.5),
+    "efficientnet-b7": (2.0, 3.1, 600, 0.5),
+}
+
+# (expand, channels, repeats, stride, kernel) — B0 baseline stages
+BASE_STAGES: Sequence[Tuple[int, int, int, int, int]] = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+
+def round_filters(filters: int, width_mult: float, divisor: int = 8) -> int:
+    filters *= width_mult
+    new = max(divisor, int(filters + divisor / 2) // divisor * divisor)
+    if new < 0.9 * filters:
+        new += divisor
+    return int(new)
+
+
+def round_repeats(repeats: int, depth_mult: float) -> int:
+    return int(math.ceil(depth_mult * repeats))
+
+
+def _bn(train: bool):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.99,
+                        epsilon=1e-3)
+
+
+class MBConv(nn.Module):
+    C_out: int
+    expand: int
+    kernel: int
+    stride: int
+    se_ratio: float = 0.25
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        C_in = x.shape[-1]
+        h = x
+        if self.expand != 1:
+            h = nn.Conv(C_in * self.expand, (1, 1), use_bias=False)(h)
+            h = nn.swish(_bn(train)(h))
+        C_mid = h.shape[-1]
+        h = nn.Conv(C_mid, (self.kernel, self.kernel), strides=self.stride,
+                    feature_group_count=C_mid, use_bias=False)(h)
+        h = nn.swish(_bn(train)(h))
+        # squeeze-excitation
+        se_ch = max(1, int(C_in * self.se_ratio))
+        s = jnp.mean(h, axis=(1, 2), keepdims=True)
+        s = nn.swish(nn.Conv(se_ch, (1, 1))(s))
+        s = jax.nn.sigmoid(nn.Conv(C_mid, (1, 1))(s))
+        h = h * s
+        h = nn.Conv(self.C_out, (1, 1), use_bias=False)(h)
+        h = _bn(train)(h)
+        if self.stride == 1 and C_in == self.C_out:
+            if train and self.drop_rate > 0:
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(
+                    rng, keep, (h.shape[0], 1, 1, 1)).astype(h.dtype)
+                h = h / keep * mask
+            h = h + x
+        return h
+
+
+class EfficientNet(nn.Module):
+    variant: str = "efficientnet-b0"
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        width, depth, _, dropout = PARAMS[self.variant]
+        h = nn.Conv(round_filters(32, width), (3, 3), strides=2,
+                    use_bias=False)(x)
+        h = nn.swish(_bn(train)(h))
+        total_blocks = sum(round_repeats(r, depth)
+                           for _, _, r, _, _ in BASE_STAGES)
+        block_idx = 0
+        for expand, channels, repeats, stride, kernel in BASE_STAGES:
+            C_out = round_filters(channels, width)
+            for r in range(round_repeats(repeats, depth)):
+                drop = 0.2 * block_idx / total_blocks  # linearly scaled
+                h = MBConv(C_out, expand, kernel,
+                           stride if r == 0 else 1,
+                           drop_rate=drop)(h, train=train)
+                block_idx += 1
+        h = nn.Conv(round_filters(1280, width), (1, 1), use_bias=False)(h)
+        h = nn.swish(_bn(train)(h))
+        h = jnp.mean(h, axis=(1, 2))
+        if train and dropout > 0:
+            h = nn.Dropout(rate=dropout)(h, deterministic=False)
+        return nn.Dense(self.num_classes)(h)
+
+
+def efficientnet(variant: str = "efficientnet-b0",
+                 num_classes: int = 1000) -> EfficientNet:
+    assert variant in PARAMS, f"unknown variant {variant}"
+    return EfficientNet(variant=variant, num_classes=num_classes)

@@ -1,0 +1,56 @@
+"""EfficientNet: scaling math, forward pass, stochastic depth gating."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.models.efficientnet import (PARAMS, efficientnet,
+                                           round_filters, round_repeats)
+
+
+class TestScaling:
+    def test_round_filters_b0_identity(self):
+        assert round_filters(32, 1.0) == 32
+        assert round_filters(320, 1.0) == 320
+
+    def test_round_filters_divisible_by_8(self):
+        for w in (1.1, 1.2, 1.4, 2.0):
+            assert round_filters(32, w) % 8 == 0
+
+    def test_round_repeats_ceil(self):
+        assert round_repeats(2, 1.0) == 2
+        assert round_repeats(2, 1.1) == 3
+        assert round_repeats(4, 3.1) == 13
+
+
+class TestForward:
+    def test_b0_forward_and_param_count(self):
+        net = efficientnet("efficientnet-b0", num_classes=10)
+        x = jnp.zeros((1, 32, 32, 3))
+        variables = net.init(jax.random.key(0), x, train=False)
+        logits = net.apply(variables, x, train=False)
+        assert logits.shape == (1, 10)
+        n_params = sum(p.size for p in jax.tree.leaves(variables["params"]))
+        # B0 is ~5.3M params at 1000 classes; ~4M at 10 classes
+        assert 3_000_000 < n_params < 6_000_000, n_params
+
+    def test_train_mode_mutates_batch_stats(self):
+        net = efficientnet("efficientnet-b0", num_classes=4)
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3),
+                        jnp.float32)
+        variables = net.init(jax.random.key(0), x, train=False)
+        _, updates = net.apply(variables, x, train=True,
+                               rngs={"dropout": jax.random.key(1)},
+                               mutable=["batch_stats"])
+        before = jax.tree.leaves(variables["batch_stats"])
+        after = jax.tree.leaves(updates["batch_stats"])
+        assert any(not np.allclose(a, b) for a, b in zip(before, after))
+
+    def test_variants_grow(self):
+        def count(variant):
+            net = efficientnet(variant, num_classes=10)
+            v = net.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                         train=False)
+            return sum(p.size for p in jax.tree.leaves(v["params"]))
+
+        assert count("efficientnet-b1") > count("efficientnet-b0")
