@@ -1,0 +1,1 @@
+"""Runtime utilities: checkpointing, metrics sinks, tracing."""

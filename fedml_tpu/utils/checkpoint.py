@@ -1,0 +1,95 @@
+"""Round-level checkpoint/resume — the subsystem the reference lacks.
+
+The reference has only ad-hoc artifacts (FedSeg's Saver,
+fedseg/utils.py:169-210; FedNAS genotype dumps, FedNASAggregator.py:173) and
+no way to resume a federated run (SURVEY §5.4). Here the checkpoint unit is
+the full round state tuple: ``(round_idx, global variables, server optimizer
+state, RNG key)`` — everything needed to restart bit-identically, since
+client sampling is derived from (seed, round) and data is re-packed from the
+dataset each round.
+
+Format: flax msgpack serialization (``flax.serialization``) of the pytree +
+a small json sidecar with the round index and user metadata; atomic writes
+(tmp + rename); ``keep_last_n`` garbage collection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import flax.serialization
+import jax
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep_last_n: int = 3):
+        self.directory = directory
+        self.keep_last_n = keep_last_n
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, round_idx: int) -> str:
+        return os.path.join(self.directory, f"round_{round_idx:08d}")
+
+    def save(self, round_idx: int, state: Any,
+             metadata: Optional[Dict] = None) -> str:
+        """``state`` is any pytree (e.g. {'variables': ..., 'server_opt':
+        ..., 'rng': key_data}); returns the checkpoint path."""
+        path = self._path(round_idx)
+        blob = flax.serialization.to_bytes(state)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        meta = {"round_idx": round_idx, **(metadata or {})}
+        with open(path + ".json", "w") as f:
+            json.dump(meta, f)
+        self._gc()
+        return path
+
+    def _rounds(self):
+        out = []
+        for fn in os.listdir(self.directory):
+            if fn.startswith("round_") and not fn.endswith((".json", ".tmp")):
+                out.append(int(fn.split("_")[1]))
+        return sorted(out)
+
+    def _gc(self) -> None:
+        rounds = self._rounds()
+        for r in rounds[:-self.keep_last_n]:
+            for suffix in ("", ".json"):
+                try:
+                    os.remove(self._path(r) + suffix)
+                except FileNotFoundError:
+                    pass
+
+    def latest_round(self) -> Optional[int]:
+        rounds = self._rounds()
+        return rounds[-1] if rounds else None
+
+    def restore(self, round_idx: int,
+                target: Any) -> Tuple[Any, Dict]:
+        """``target`` is a pytree template with the right structure/shapes
+        (e.g. a freshly initialized state); returns (state, metadata)."""
+        path = self._path(round_idx)
+        with open(path, "rb") as f:
+            state = flax.serialization.from_bytes(target, f.read())
+        with open(path + ".json") as f:
+            meta = json.load(f)
+        return state, meta
+
+    def restore_latest(self, target: Any) -> Optional[Tuple[Any, Dict]]:
+        r = self.latest_round()
+        if r is None:
+            return None
+        return self.restore(r, target)
+
+
+def rng_to_state(key) -> Any:
+    """PRNG key -> serializable uint32 array."""
+    return jax.random.key_data(key)
+
+
+def rng_from_state(data) -> Any:
+    return jax.random.wrap_key_data(data)

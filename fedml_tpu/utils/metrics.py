@@ -1,0 +1,81 @@
+"""Metrics sink — wandb-compatible logging with a JSON-lines fallback.
+
+In the reference, Weights&Biases is load-bearing: per-round metrics
+(FedAVGAggregator.py:136-162), run config (main_fedavg.py:296-303), and CI
+scrapes the wandb summary json as its oracle (CI-script-fedavg.sh:45). Here
+the sink always writes a local JSONL stream + a ``summary.json`` with the
+latest value per key (the exact artifact the CI equivalence check scrapes),
+and mirrors to wandb when available/enabled — so runs are observable with or
+without the service.
+
+TPU discipline: callers should log every k rounds, not every round; a log
+call forces device->host transfers of its values (SURVEY §7 throughput
+notes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _to_plain(v: Any) -> Any:
+    if isinstance(v, (np.generic,)):
+        return v.item()
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        return float(v.item())
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+class MetricsSink:
+    def __init__(self, run_dir: str, config: Optional[Dict] = None,
+                 use_wandb: bool = False, project: str = "fedml_tpu"):
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        self._log_path = os.path.join(run_dir, "metrics.jsonl")
+        self._summary_path = os.path.join(run_dir, "wandb-summary.json")
+        self.summary: Dict[str, Any] = {}
+        self._t0 = time.time()
+        self._wandb = None
+        if config:
+            with open(os.path.join(run_dir, "config.json"), "w") as f:
+                json.dump({k: _to_plain(v) for k, v in config.items()}, f,
+                          indent=2)
+        if use_wandb:
+            try:
+                import wandb
+                self._wandb = wandb.init(project=project, config=config,
+                                         dir=run_dir)
+            except Exception:  # offline / not installed / not logged in
+                self._wandb = None
+
+    def log(self, metrics: Dict[str, Any],
+            step: Optional[int] = None) -> None:
+        rec = {k: _to_plain(v) for k, v in metrics.items()}
+        if step is not None:
+            rec["step"] = step
+        rec["_wall_s"] = round(time.time() - self._t0, 3)
+        with open(self._log_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self.summary.update(rec)
+        with open(self._summary_path, "w") as f:
+            json.dump(self.summary, f)
+        if self._wandb is not None:
+            self._wandb.log(rec, step=step)
+
+    def finish(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+def read_summary(run_dir: str) -> Dict[str, Any]:
+    """The CI oracle read (reference CI-script-fedavg.sh:45 scrapes
+    wandb/latest-run/files/wandb-summary.json)."""
+    with open(os.path.join(run_dir, "wandb-summary.json")) as f:
+        return json.load(f)

@@ -1,0 +1,53 @@
+"""Tracing/profiling: per-round wall-clock accounting + jax.profiler hooks.
+
+The reference's only tracing is wall-clock log lines
+(``aggregate time cost``, FedAVGAggregator.py:85-86). Here:
+- ``RoundTimer`` — cheap named phase timing with running aggregates
+  (host-side; call ``block_until_ready`` on outputs before stopping a phase
+  to charge async device work to the right bucket)
+- ``profile`` — context manager around ``jax.profiler.trace`` emitting a
+  TensorBoard-loadable trace directory when enabled, a no-op otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+
+class RoundTimer:
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def means(self) -> Dict[str, float]:
+        return {k: self.totals[k] / max(1, self.counts[k])
+                for k in self.totals}
+
+    def report(self) -> str:
+        return " | ".join(f"{k}: {v * 1e3:.1f}ms"
+                          for k, v in sorted(self.means().items()))
+
+
+@contextlib.contextmanager
+def profile(log_dir: Optional[str] = None) -> Iterator[None]:
+    """``with profile('/tmp/trace'):`` wraps jax.profiler.trace; with None
+    it is a no-op (so call sites need no conditionals)."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
