@@ -1,0 +1,4 @@
+"""Experiment entry points (reference fedml_experiments/): argparse mains
+with flag parity to the reference's per-algorithm scripts, dispatched through
+one launcher (``python -m fedml_tpu.experiments.fed_launch --algo fedavg``)
+mirroring fed_launch's generic multi-algo main."""

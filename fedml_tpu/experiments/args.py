@@ -1,0 +1,65 @@
+"""Shared argparse flags — parity with the reference's experiment mains.
+
+Reference flag set: fedml_experiments/distributed/fedavg/main_fedavg.py:48-117
+(model/dataset/data_dir/partition_method/partition_alpha/client_num_in_total/
+client_num_per_round/batch_size/client_optimizer/backend/lr/wd/epochs/
+comm_round/frequency_of_the_test/ci...), plus per-algorithm extras added by
+each main (fedopt's server_optimizer/server_lr main_fedopt.py:54-60, robust's
+defense flags main_fedavg_robust.py:56-63). ``--backend`` values are the
+TPU-era execution paths instead of MPI/GRPC/MQTT transports.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_federated_args(parser: argparse.ArgumentParser):
+    parser.add_argument("--model", type=str, default=None,
+                        help="model name (default: dataset's reference pick)")
+    parser.add_argument("--dataset", type=str, default="blob")
+    parser.add_argument("--data_dir", type=str, default="")
+    parser.add_argument("--partition_method", type=str, default="hetero",
+                        choices=["homo", "hetero", "hetero-fix"])
+    parser.add_argument("--partition_alpha", type=float, default=0.5)
+    parser.add_argument("--client_num_in_total", type=int, default=10)
+    parser.add_argument("--client_num_per_round", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--client_optimizer", type=str, default="sgd")
+    parser.add_argument("--backend", type=str, default="simulation",
+                        choices=["simulation", "spmd", "inproc", "tcp",
+                                 "grpc"],
+                        help="simulation: vmapped single-program; spmd: "
+                             "device-mesh round; inproc/tcp/grpc: "
+                             "cross-silo actor protocol")
+    parser.add_argument("--lr", type=float, default=0.03)
+    parser.add_argument("--wd", type=float, default=0.0)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--comm_round", type=int, default=10)
+    parser.add_argument("--frequency_of_the_test", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--run_dir", type=str, default="./runs/latest")
+    parser.add_argument("--use_wandb", action="store_true")
+    parser.add_argument("--checkpoint_dir", type=str, default=None)
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--ci", type=int, default=0,
+                        help="1 = tiny smoke-run truncation (reference --ci)")
+    return parser
+
+
+def build_dataset_and_model(args):
+    """Registry-driven load_data + create_model (the reference's per-main
+    load_data/create_model pair, main_fedavg.py:120-266)."""
+    from fedml_tpu.data.registry import (DEFAULT_MODEL_AND_TASK, load_data)
+    from fedml_tpu.models import create_model
+
+    ds = load_data(args.dataset, args.data_dir,
+                   partition_method=args.partition_method,
+                   partition_alpha=args.partition_alpha,
+                   client_num_in_total=args.client_num_in_total)
+    model_name, task = DEFAULT_MODEL_AND_TASK.get(
+        args.dataset, ("lr", "classification"))
+    if args.model:
+        model_name = args.model
+    model = create_model(model_name, output_dim=ds.class_num)
+    return ds, model, task

@@ -1,0 +1,136 @@
+"""Generic multi-algorithm launcher (reference fed_launch: a single main
+that dispatches any algorithm — fedml_experiments/distributed/fed_launch/).
+
+``python -m fedml_tpu.experiments.fed_launch --algo fedopt --dataset blob``
+
+Each algorithm adds its own flags on top of the shared federated set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from fedml_tpu.experiments.args import (add_federated_args,
+                                        build_dataset_and_model)
+from fedml_tpu.experiments.main_fedavg import make_train_config
+from fedml_tpu.utils.metrics import MetricsSink
+
+ALGOS = ["fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
+         "decentralized", "centralized", "fednas", "fedgkt", "fedseg",
+         "split_nn", "vertical_fl", "turboaggregate"]
+
+
+def add_algo_args(parser: argparse.ArgumentParser):
+    # fedopt (main_fedopt.py:54-60)
+    parser.add_argument("--server_optimizer", type=str, default="adam")
+    parser.add_argument("--server_lr", type=float, default=1e-3)
+    parser.add_argument("--server_momentum", type=float, default=0.0)
+    # fednova
+    parser.add_argument("--gmf", type=float, default=0.0)
+    parser.add_argument("--prox_mu", type=float, default=0.0)
+    # robust (main_fedavg_robust.py:56-63)
+    parser.add_argument("--defense_type", type=str,
+                        default="norm_diff_clipping")
+    parser.add_argument("--norm_bound", type=float, default=5.0)
+    parser.add_argument("--stddev", type=float, default=0.025)
+    # hierarchical (group_num = edge servers)
+    parser.add_argument("--group_num", type=int, default=2)
+    parser.add_argument("--group_comm_round", type=int, default=2)
+    # fedgkt (main_fedgkt.py)
+    parser.add_argument("--epochs_client", type=int, default=1)
+    parser.add_argument("--epochs_server", type=int, default=1)
+    parser.add_argument("--alpha", type=float, default=1.0)
+    parser.add_argument("--temperature", type=float, default=1.0)
+
+
+def run_algo(args):
+    ds, model, task = build_dataset_and_model(args)
+    sink = MetricsSink(args.run_dir, config=vars(args),
+                       use_wandb=args.use_wandb)
+    tcfg = make_train_config(args)
+    common = dict(comm_round=args.comm_round,
+                  client_num_per_round=args.client_num_per_round,
+                  frequency_of_the_test=args.frequency_of_the_test,
+                  seed=args.seed, train=tcfg)
+
+    if args.algo == "fedavg":
+        from fedml_tpu.experiments.main_fedavg import BACKEND_RUNNERS
+        final = BACKEND_RUNNERS[args.backend](args, ds, model, task, sink)
+        sink.finish()
+        return final
+    if args.checkpoint_dir:
+        logging.warning("--checkpoint_dir is only wired for --algo fedavg "
+                        "--backend simulation; ignoring for %r", args.algo)
+    if args.algo == "fedopt":
+        from fedml_tpu.algorithms.fedopt import FedOptAPI, FedOptConfig
+        api = FedOptAPI(ds, model, task=task, config=FedOptConfig(
+            server_optimizer=args.server_optimizer,
+            server_lr=args.server_lr,
+            server_momentum=args.server_momentum, **common))
+    elif args.algo == "fednova":
+        from fedml_tpu.algorithms.fednova import FedNovaAPI, FedNovaConfig
+        api = FedNovaAPI(ds, model, config=FedNovaConfig(
+            gmf=args.gmf, mu=args.prox_mu, **common))
+    elif args.algo == "fedavg_robust":
+        from fedml_tpu.algorithms.fedavg_robust import (FedAvgRobustAPI,
+                                                        FedAvgRobustConfig)
+        api = FedAvgRobustAPI(ds, model, task=task,
+                              config=FedAvgRobustConfig(
+                                  defense_type=args.defense_type,
+                                  norm_bound=args.norm_bound,
+                                  stddev=args.stddev, **common))
+    elif args.algo == "centralized":
+        from fedml_tpu.algorithms.centralized import CentralizedTrainer
+        trainer = CentralizedTrainer(ds, model, task=task, cfg=tcfg)
+        for _ in range(args.comm_round):
+            trainer.train()
+        rec = trainer.evaluate()
+        sink.log(rec)
+        sink.finish()
+        return rec
+    elif args.algo == "fedgkt":
+        from fedml_tpu.algorithms.fedgkt import FedGKTAPI, FedGKTConfig
+        from fedml_tpu.models.resnet_gkt import resnet8_56, resnet56_server
+        if ds.train_data_global[0].ndim != 4:
+            raise SystemExit(
+                "fedgkt requires an NHWC image dataset (e.g. --dataset "
+                f"cifar10); {args.dataset!r} samples have shape "
+                f"{ds.train_data_global[0].shape[1:]}")
+        api = FedGKTAPI(ds, resnet8_56(ds.class_num),
+                        resnet56_server(ds.class_num),
+                        FedGKTConfig(comm_round=args.comm_round,
+                                     epochs_client=args.epochs_client,
+                                     epochs_server=args.epochs_server,
+                                     batch_size=args.batch_size,
+                                     alpha=args.alpha,
+                                     temperature=args.temperature,
+                                     seed=args.seed))
+    else:
+        raise SystemExit(
+            f"--algo {args.algo}: use the dedicated main module "
+            f"(fedml_tpu.experiments / algorithms package); launcher wires "
+            f"{['fedavg', 'fedopt', 'fednova', 'fedavg_robust', 'centralized', 'fedgkt']}")
+
+    final = api.train()
+    for rec in getattr(api, "history", []):
+        sink.log(rec, step=rec.get("round"))
+    sink.finish()
+    logging.info("final: %s", final)
+    return final
+
+
+def main(argv=None):
+    from fedml_tpu.experiments.main_fedavg import apply_ci_truncation
+
+    parser = argparse.ArgumentParser("fedml_tpu fed_launch")
+    parser.add_argument("--algo", type=str, default="fedavg", choices=ALGOS)
+    add_federated_args(parser)
+    add_algo_args(parser)
+    args = apply_ci_truncation(parser.parse_args(argv))
+    logging.basicConfig(level=logging.INFO)
+    return run_algo(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,16 +42,24 @@ class CheckpointManager:
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, path)
+        # sidecar last, atomically: _rounds() requires BOTH files, so a
+        # crash at any point leaves either a complete checkpoint or one
+        # that restore_latest() skips — never a torn resume
         meta = {"round_idx": round_idx, **(metadata or {})}
-        with open(path + ".json", "w") as f:
+        mtmp = path + ".json.tmp"
+        with open(mtmp, "w") as f:
             json.dump(meta, f)
+        os.replace(mtmp, path + ".json")
         self._gc()
         return path
 
     def _rounds(self):
+        names = set(os.listdir(self.directory))
         out = []
-        for fn in os.listdir(self.directory):
-            if fn.startswith("round_") and not fn.endswith((".json", ".tmp")):
+        for fn in names:
+            if (fn.startswith("round_") and
+                    not fn.endswith((".json", ".tmp")) and
+                    fn + ".json" in names):
                 out.append(int(fn.split("_")[1]))
         return sorted(out)
 
