@@ -99,9 +99,18 @@ class FedAvgAPI:
 
         self._local_train = make_local_train(module, task, cfg)
         self._vmapped_body = make_vmapped_body(self._local_train)
-        hook = aggregate_hook or (
-            lambda variables, stacked, weights, key:
-            pt.tree_weighted_mean(stacked, weights))
+        if aggregate_hook is not None:
+            hook = aggregate_hook
+        elif jax.default_backend() == "tpu":
+            # fused single-pass kernel over the whole [clients, params] stack
+            # instead of one reduction per leaf (fedml_tpu/ops/aggregate.py)
+            from fedml_tpu.ops import tree_weighted_mean_pallas
+
+            def hook(variables, stacked, weights, key):
+                return tree_weighted_mean_pallas(stacked, weights)
+        else:
+            hook = (lambda variables, stacked, weights, key:
+                    pt.tree_weighted_mean(stacked, weights))
         body = self._vmapped_body
 
         def round_fn(variables, x, y, mask, keys, weights, agg_key):

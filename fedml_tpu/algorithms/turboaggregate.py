@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.core import mpc
 from fedml_tpu.core import pytree as pt
 
@@ -93,3 +94,27 @@ def coded_share_exchange(share_matrix: np.ndarray, K: int, T: int,
                                 K, T, surviving_idx, prime)
 
     return coded, reconstruct
+
+
+class SecureFedAvgAPI(FedAvgAPI):
+    """FedAvg whose server step is the secure-sum protocol.
+
+    Same round semantics as :class:`fedml_tpu.algorithms.fedavg.FedAvgAPI`
+    (seeded sampling, vmapped local SGD), but aggregation runs the host-side
+    share exchange instead of an on-device reduction — the cross-silo trust
+    model where the server may never see a raw client update (reference:
+    fedml_api/distributed/turboaggregate/TA_Aggregator.py).
+    """
+
+    def __init__(self, dataset, module, task: str = "classification",
+                 config=None,
+                 secure_config: Optional[TurboAggregateConfig] = None):
+        super().__init__(dataset, module, task=task, config=config)
+        self._secure = SecureAggregator(secure_config)
+        self._body_fn = jax.jit(self._vmapped_body)
+
+    def run_round(self, round_idx: int):
+        idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
+        stacked, stats = self._body_fn(self.variables, x, y, mask, keys)
+        self.variables = self._secure.aggregate(stacked, np.asarray(weights))
+        return idxs, stats

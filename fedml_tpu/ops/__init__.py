@@ -1,0 +1,34 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference's server hot path is a host-side Python loop over ``state_dict``
+keys (reference: fedml_api/distributed/fedavg/FedAVGAggregator.py:58-87) and
+its comm payloads are full-precision pickled tensors (reference:
+fedml_core/distributed/communication/mpi/mpi_send_thread.py:27). Here the two
+corresponding device-side primitives are hand-tiled Pallas kernels:
+
+- :mod:`fedml_tpu.ops.aggregate` — fused sample-weighted client aggregation
+  (the FedAvg server rule) over a ``[clients, params]`` stack, tiled so the
+  weighted reduction rides the MXU.
+- :mod:`fedml_tpu.ops.quantize` — int8 block-scaled quantization with
+  stochastic rounding for cross-silo model-delta compression.
+
+Every kernel has an ``interpret=True`` path so the math is testable on the
+CPU mesh, and a pure-jnp reference used both as the CPU fallback and as the
+test oracle.
+"""
+
+from fedml_tpu.ops.aggregate import (tree_weighted_mean_pallas,
+                                     weighted_mean_flat,
+                                     weighted_mean_flat_reference)
+from fedml_tpu.ops.quantize import (dequantize_int8, dequantize_tree,
+                                    quantize_int8, quantize_tree)
+
+__all__ = [
+    "weighted_mean_flat",
+    "weighted_mean_flat_reference",
+    "tree_weighted_mean_pallas",
+    "quantize_int8",
+    "dequantize_int8",
+    "quantize_tree",
+    "dequantize_tree",
+]

@@ -65,10 +65,22 @@ class CheckpointManager:
 
     def _gc(self) -> None:
         rounds = self._rounds()
-        for r in rounds[:-self.keep_last_n]:
-            for suffix in ("", ".json"):
+        keep = set(rounds[-self.keep_last_n:])
+        # sweep every round_* artifact: stale .tmp files and sidecar-less
+        # blobs from a crash mid-save are orphans _rounds() never reports,
+        # so deleting only _rounds()[:-n] would leak them forever
+        for fn in os.listdir(self.directory):
+            if not fn.startswith("round_"):
+                continue
+            stem = fn.split(".")[0]
+            try:
+                r = int(stem.split("_")[1])
+            except (IndexError, ValueError):
+                continue
+            complete = not fn.endswith(".tmp") and r in keep
+            if not complete:
                 try:
-                    os.remove(self._path(r) + suffix)
+                    os.remove(os.path.join(self.directory, fn))
                 except FileNotFoundError:
                     pass
 

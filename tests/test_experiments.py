@@ -35,6 +35,26 @@ class TestFedAvgMain:
         assert any(f.startswith("round_")
                    for f in os.listdir(tmp_path / "ckpt"))
 
+    def test_resume_matches_uninterrupted_run(self, tmp_path):
+        """Crash-at-round-2-then-resume must equal a straight 4-round run
+        bit-for-bit (sampling is (seed, round)-derived, so restoring
+        (variables, round) is the whole state)."""
+        common = ["--dataset", "blob", "--client_num_in_total", "4",
+                  "--client_num_per_round", "2", "--batch_size", "8",
+                  "--lr", "0.1", "--frequency_of_the_test", "1"]
+        straight = main_fedavg.main(
+            common + ["--comm_round", "4",
+                      "--run_dir", str(tmp_path / "straight")])
+        main_fedavg.main(
+            common + ["--comm_round", "2", "--run_dir", str(tmp_path / "a"),
+                      "--checkpoint_dir", str(tmp_path / "ckpt")])
+        resumed = main_fedavg.main(
+            common + ["--comm_round", "4", "--run_dir", str(tmp_path / "b"),
+                      "--checkpoint_dir", str(tmp_path / "ckpt"),
+                      "--resume"])
+        assert resumed["test_acc"] == straight["test_acc"]
+        assert resumed["test_loss"] == straight["test_loss"]
+
 
 class TestFedLaunch:
     def _common(self, tmp_path, algo):
@@ -66,3 +86,26 @@ class TestFedLaunch:
     def test_fedavg_via_launcher(self, tmp_path):
         final = fed_launch.main(self._common(tmp_path, "fedavg"))
         assert "test_acc" in final
+
+    def test_hierarchical(self, tmp_path):
+        final = fed_launch.main(self._common(tmp_path, "hierarchical") +
+                                ["--group_num", "2",
+                                 "--group_comm_round", "2"])
+        assert "test_acc" in final
+
+    def test_turboaggregate_matches_fedavg(self, tmp_path):
+        secure = fed_launch.main(self._common(tmp_path, "turboaggregate"))
+        plain = fed_launch.main(self._common(tmp_path, "fedavg"))
+        # secure-sum == weighted mean up to fixed-point round-off
+        assert abs(secure["test_loss"] - plain["test_loss"]) < 1e-3
+
+    def test_decentralized(self, tmp_path):
+        final = fed_launch.main(self._common(tmp_path, "decentralized") +
+                                ["--comm_round", "20",
+                                 "--topology_neighbors_num_undirected", "2"])
+        assert final["regret"] > 0
+
+    def test_unwired_algo_rejected_before_load(self, tmp_path):
+        import pytest
+        with pytest.raises(SystemExit, match="split_nn"):
+            fed_launch.main(self._common(tmp_path, "split_nn"))

@@ -1,0 +1,107 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh) vs jnp oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.ops import (dequantize_int8, dequantize_tree, quantize_int8,
+                           quantize_tree, tree_weighted_mean_pallas,
+                           weighted_mean_flat, weighted_mean_flat_reference)
+
+
+class TestWeightedMean:
+    def test_matches_reference_flat(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(7, 5000).astype(np.float32)
+        w = rng.uniform(1, 100, size=7).astype(np.float32)
+        got = weighted_mean_flat(jnp.asarray(x), jnp.asarray(w),
+                                 interpret=True)
+        want = weighted_mean_flat_reference(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unpadded_tile_boundary(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 4096).astype(np.float32)  # exact multiple of tile
+        w = np.array([1.0, 2.0, 3.0], np.float32)
+        got = weighted_mean_flat(jnp.asarray(x), jnp.asarray(w),
+                                 interpret=True)
+        want = weighted_mean_flat_reference(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_tree_frontend_matches_pytree_rule(self):
+        rng = np.random.RandomState(2)
+        tree = {
+            "dense": {"kernel": jnp.asarray(rng.randn(4, 17, 33), jnp.float32),
+                      "bias": jnp.asarray(rng.randn(4, 33), jnp.float32)},
+            "scalar": jnp.asarray(rng.randn(4), jnp.float32),
+        }
+        w = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+        got = tree_weighted_mean_pallas(tree, w, interpret=True)
+        want = tree_weighted_mean(tree, w)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            got, want)
+
+
+class TestQuantize:
+    def test_round_trip_error_bound(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(10_000).astype(np.float32))
+        vals, scales = quantize_int8(x, jax.random.key(0), interpret=True)
+        assert vals.dtype == jnp.int8
+        back = dequantize_int8(vals, scales, x.size, interpret=True)
+        # per-block error bounded by one quantization step = absmax/127
+        err = np.abs(np.asarray(back) - np.asarray(x)).max()
+        step = np.abs(np.asarray(x)).max() / 127.0
+        assert err <= step + 1e-6
+
+    def test_stochastic_rounding_unbiased(self):
+        # constant vector between two int levels: mean of dequantized values
+        # must approach the true value, not the nearest level
+        x = jnp.full((4096,), 0.6 * (1.27 / 127.0) * 100, jnp.float32)
+        # place absmax so scale is known: append the max
+        x = x.at[0].set(1.27)
+        means = []
+        for s in range(5):
+            vals, scales = quantize_int8(x, jax.random.key(s), interpret=True)
+            back = dequantize_int8(vals, scales, x.size, interpret=True)
+            means.append(float(jnp.mean(back[1:])))
+        assert abs(np.mean(means) - float(x[1])) < 2e-4
+
+    def test_zero_vector(self):
+        x = jnp.zeros((700,), jnp.float32)
+        vals, scales = quantize_int8(x, jax.random.key(0), interpret=True)
+        back = dequantize_int8(vals, scales, 700, interpret=True)
+        assert float(jnp.abs(back).max()) == 0.0
+
+    def test_tree_round_trip(self):
+        rng = np.random.RandomState(4)
+        tree = {"w": jnp.asarray(rng.randn(37, 11), jnp.float32),
+                "b": jnp.asarray(rng.randn(11), jnp.float32)}
+        vals, scales, spec = quantize_tree(tree, jax.random.key(1),
+                                           interpret=True)
+        back = dequantize_tree(vals, scales, spec, interpret=True)
+        assert jax.tree.structure(back) == jax.tree.structure(tree)
+        for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(tree)):
+            assert a.shape == b.shape and a.dtype == b.dtype
+            step = float(jnp.abs(jax.tree.leaves(tree)[0]).max()) / 127.0
+        err = max(float(jnp.abs(a - b).max()) for a, b in
+                  zip(jax.tree.leaves(back), jax.tree.leaves(tree)))
+        # global blocks: bound by the largest block absmax step
+        gmax = max(float(jnp.abs(l).max()) for l in jax.tree.leaves(tree))
+        assert err <= gmax / 127.0 + 1e-6
+
+
+@pytest.mark.parametrize("d", [100, 512, 513, 16384])
+def test_quantize_sizes(d):
+    rng = np.random.RandomState(d)
+    x = jnp.asarray(rng.randn(d).astype(np.float32))
+    vals, scales = quantize_int8(x, jax.random.key(0), interpret=True)
+    back = dequantize_int8(vals, scales, d, interpret=True)
+    assert back.shape == (d,)
+    assert float(jnp.abs(back - x).max()) <= float(jnp.abs(x).max()) / 127 + 1e-6
