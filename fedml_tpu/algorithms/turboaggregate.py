@@ -58,14 +58,19 @@ class SecureAggregator:
         q = mpc.quantize(flat_weighted, self.cfg.prime, self.cfg.frac_bits)
         return mpc.gen_additive_ss(q, n_peers, self.cfg.prime, rng)
 
-    def aggregate(self, stacked, weights) -> object:
+    def aggregate(self, stacked, weights, round_idx: int = 0) -> object:
         """Run the full protocol over a stacked pytree of client models.
 
         Returns the weighted mean pytree, numerically equal to
-        ``tree_weighted_mean`` up to fixed-point round-off."""
+        ``tree_weighted_mean`` up to fixed-point round-off. ``round_idx``
+        is folded into the mask RNG: reusing additive-SS masks across
+        rounds would let a peer difference its shares between rounds and
+        recover a client's update delta."""
         weights = np.asarray(weights, np.float64)
         n = len(weights)
-        rng = np.random.RandomState(self.cfg.seed)
+        rng = np.random.RandomState(
+            np.random.SeedSequence([self.cfg.seed, round_idx]
+                                   ).generate_state(1)[0])
         template = pt.tree_index(stacked, 0)
         flats = [np.asarray(pt.tree_ravel(pt.tree_index(stacked, i)),
                             np.float64) * weights[i] for i in range(n)]
@@ -116,5 +121,6 @@ class SecureFedAvgAPI(FedAvgAPI):
     def run_round(self, round_idx: int):
         idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
         stacked, stats = self._body_fn(self.variables, x, y, mask, keys)
-        self.variables = self._secure.aggregate(stacked, np.asarray(weights))
+        self.variables = self._secure.aggregate(stacked, np.asarray(weights),
+                                                round_idx=round_idx)
         return idxs, stats

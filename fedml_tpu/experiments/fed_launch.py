@@ -216,9 +216,9 @@ def main(argv=None):
         why = {"fedseg": "needs a segmentation dataset + model",
                "split_nn": "needs a model-split (bottom/top) spec",
                "vertical_fl": "needs a per-party feature-split spec"}
+        reason = why.get(args.algo, "not dispatchable from generic flags")
         raise SystemExit(
-            f"--algo {args.algo}: {why.get(args.algo, 'not dispatchable from '
-            'generic flags')}; use its API "
+            f"--algo {args.algo}: {reason}; use its API "
             f"(fedml_tpu.algorithms.{args.algo}). Launcher wires: "
             f"{WIRED_ALGOS}")
     logging.basicConfig(level=logging.INFO)

@@ -1,0 +1,63 @@
+"""base_framework + decentralized_framework templates (SURVEY §2.2)."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.base_framework import (
+    run_base_framework_distributed, run_decentralized_framework_demo)
+
+
+def test_base_framework_scalar_sum():
+    # reference demo semantics (central_worker.py:28): server sums the
+    # client informations; default client info is its index+1
+    res = run_base_framework_distributed(client_num=4, max_round=3)
+    assert len(res.global_history) == 3
+    for g in res.global_history:
+        assert g == pytest.approx(1 + 2 + 3 + 4)
+
+
+def test_base_framework_custom_local_fn_and_pytree():
+    # clone-the-template path: pytree information + custom aggregate
+    def local_fn(global_info, round_idx):
+        return {"a": np.ones(3) * (round_idx + 1), "b": 2.0}
+
+    res = run_base_framework_distributed(client_num=3, max_round=2,
+                                         local_fn=local_fn,
+                                         init_info={"a": np.zeros(3),
+                                                    "b": 0.0})
+    assert len(res.global_history) == 2
+    # round 0: all clients see round_idx=0 → a = 3 * ones
+    np.testing.assert_allclose(res.global_history[0]["a"], 3 * np.ones(3))
+    assert res.global_history[0]["b"] == pytest.approx(6.0)
+    np.testing.assert_allclose(res.global_history[1]["a"], 6 * np.ones(3))
+
+
+def test_base_framework_zero_rounds():
+    res = run_base_framework_distributed(client_num=3, max_round=0)
+    assert res.global_history == []
+
+
+def test_base_framework_handler_exception_is_raised():
+    def bad_local_fn(global_info, round_idx):
+        raise ValueError("client blew up")
+
+    with pytest.raises(ValueError, match="client blew up"):
+        run_base_framework_distributed(client_num=2, max_round=2,
+                                       local_fn=bad_local_fn)
+
+
+def test_decentralized_singleton_terminates():
+    workers = run_decentralized_framework_demo(worker_num=1, max_round=4)
+    assert workers[0].done.is_set()
+    assert len(workers[0].history) == 4
+
+
+def test_decentralized_framework_gossip_converges_to_consensus():
+    workers = run_decentralized_framework_demo(worker_num=6, max_round=25)
+    assert all(w.done.is_set() for w in workers)
+    finals = [w.value for w in workers]
+    # equal-weight neighborhood averaging preserves no exact mean, but all
+    # workers must contract to a consensus value within the initial range
+    assert np.std(finals) < 0.05
+    assert min(finals) >= 1.0 - 1e-6 and max(finals) <= 6.0 + 1e-6
+    assert all(len(w.history) == 25 for w in workers)

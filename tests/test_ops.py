@@ -89,7 +89,6 @@ class TestQuantize:
         assert jax.tree.structure(back) == jax.tree.structure(tree)
         for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(tree)):
             assert a.shape == b.shape and a.dtype == b.dtype
-            step = float(jnp.abs(jax.tree.leaves(tree)[0]).max()) / 127.0
         err = max(float(jnp.abs(a - b).max()) for a, b in
                   zip(jax.tree.leaves(back), jax.tree.leaves(tree)))
         # global blocks: bound by the largest block absmax step
