@@ -25,6 +25,7 @@ clone that ships model params works unchanged.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -191,8 +192,9 @@ def _run_rank_threads(managers: List[Any], timeout: float = 60.0) -> None:
                for m in managers]
     for t in threads:
         t.start()
+    deadline = time.monotonic() + timeout  # shared: N joins, one budget
     for t in threads:
-        t.join(timeout=timeout)
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
     if errors:
         raise errors[0]
     if any(t.is_alive() for t in threads):

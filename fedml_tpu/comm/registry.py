@@ -15,10 +15,17 @@ def create_comm_manager(
         addresses: Optional[Dict[int, Tuple[str, int]]] = None,
         wire_codec: bool = False) -> BaseCommunicationManager:
     """``backend``: "INPROC" (simulation/tests), "TCP" (framed sockets,
-    cross-host), "GRPC" (cross-silo RPC). The reference's "MPI" maps to
-    INPROC for single-host and TCP for multi-host; its "MQTT" mobile path is
-    served by GRPC/TCP (no broker dependency in this environment)."""
+    cross-host), "GRPC" (cross-silo RPC), "ROUTED" (dial-out frames through
+    the native C++ broker, native/router.cpp — the NAT-friendly star
+    topology of the reference's MQTT path). The reference's "MPI" maps to
+    INPROC for single-host and TCP for multi-host."""
     key = backend.upper()
+    if key in ("ROUTED", "BROKER"):
+        if addresses is None or "router" not in addresses:
+            raise ValueError(
+                'ROUTED backend needs addresses={"router": (host, port)}')
+        from fedml_tpu.comm.routed import RoutedCommManager
+        return RoutedCommManager(rank, addresses["router"])
     if key in ("INPROC", "MPI"):
         if router is None:
             raise ValueError("INPROC backend needs a shared InProcRouter")

@@ -1,0 +1,85 @@
+"""Broker-routed backend: silos dial out to the native C++ router.
+
+Complements the peer-to-peer TCP backend (tcp.py) for deployments where
+silos cannot accept inbound connections (NAT/firewalled cross-silo — the
+scenario the reference serves with an MQTT broker,
+fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py): every rank
+keeps ONE outbound connection to the router (native/router.cpp) and frames
+are addressed by rank. Same Message/Observer contract as every other
+backend, so managers and algorithm protocols are transport-agnostic.
+
+Wire protocol (little-endian), mirroring the router:
+  HELLO:           u32 magic 'FMLR'  u32 rank
+  DATA (send):     u32 dest_rank     u64 len   payload
+  DATA (receive):  u32 src_rank      u64 len   payload
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.tcp import _recv_exact
+
+_MAGIC = 0x464D4C52  # 'FMLR'
+_HELLO = struct.Struct("<II")
+_HDR = struct.Struct("<IQ")
+_STOP = object()
+
+
+class RoutedCommManager(BaseCommunicationManager):
+    """One rank's connection to the message router."""
+
+    def __init__(self, rank: int, router_address: Tuple[str, int],
+                 connect_timeout: float = 30.0):
+        super().__init__()
+        self.rank = rank
+        self._sock = socket.create_connection(router_address,
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.sendall(_HELLO.pack(_MAGIC, rank))
+        self._send_lock = threading.Lock()
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._running = False
+        self._reader: Optional[threading.Thread] = None
+
+    def send_message(self, msg: Message) -> None:
+        frame = msg.to_bytes()
+        with self._send_lock:
+            self._sock.sendall(_HDR.pack(msg.get_receiver_id(), len(frame)))
+            self._sock.sendall(frame)
+
+    def _read_loop(self) -> None:
+        try:
+            while self._running:
+                hdr = _recv_exact(self._sock, _HDR.size)
+                _src, length = _HDR.unpack(hdr)
+                self._inbox.put(_recv_exact(self._sock, length))
+        except (ConnectionError, OSError):
+            self._inbox.put(_STOP)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        while self._running:
+            item = self._inbox.get()
+            if item is _STOP:
+                break
+            msg = Message.from_bytes(item)
+            self._notify(msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._inbox.put(_STOP)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()

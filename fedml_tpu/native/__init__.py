@@ -1,0 +1,133 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA; the native layer covers the runtime role the
+reference delegates to mpi4py's C library (rendezvous + cross-host tensor
+transport, fedml_core/distributed/communication/mpi/) and its prototype gRPC
+service (gRPC/grpc_comm_manager.py): a standalone star-topology message
+broker (native/router.cpp) that silos dial out to, with frames addressed by
+rank. Python talks to it through :class:`NativeRouter` and the
+``RoutedCommManager`` backend in fedml_tpu/comm/routed.py.
+
+The shared library is built lazily with g++ on first use and cached in
+``fedml_tpu/native/_build`` keyed by source mtime; environments without a
+toolchain raise :class:`NativeUnavailable` and the pure-Python TCP backend
+remains the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SRC = _REPO_ROOT / "native" / "router.cpp"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+_LIB = _BUILD_DIR / "libfedml_router.so"
+_build_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    """The native library could not be built or loaded."""
+
+
+def build_lib(force: bool = False) -> Path:
+    """Compile native/router.cpp into a shared library (cached by mtime)."""
+    with _build_lock:
+        if not _SRC.exists():
+            if _LIB.exists():  # prebuilt library shipped without sources
+                return _LIB
+            raise NativeUnavailable(f"native source missing: {_SRC}")
+        if (not force and _LIB.exists()
+                and _LIB.stat().st_mtime >= _SRC.stat().st_mtime):
+            return _LIB
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+               "-shared", "-o", str(_LIB), str(_SRC)]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=300)
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            raise NativeUnavailable(f"g++ unavailable: {exc}") from exc
+        if proc.returncode != 0:
+            raise NativeUnavailable(
+                f"native build failed:\n{proc.stderr[-4000:]}")
+        return _LIB
+
+
+_lib_handle: Optional[ctypes.CDLL] = None
+
+
+def load_lib() -> ctypes.CDLL:
+    global _lib_handle
+    if _lib_handle is not None:
+        return _lib_handle
+    path = build_lib()
+    lib = ctypes.CDLL(str(path))
+    lib.fedml_router_start.restype = ctypes.c_void_p
+    lib.fedml_router_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_int)]
+    lib.fedml_router_stop.argtypes = [ctypes.c_void_p]
+    lib.fedml_router_port.restype = ctypes.c_int
+    lib.fedml_router_port.argtypes = [ctypes.c_void_p]
+    lib.fedml_router_frames_routed.restype = ctypes.c_ulonglong
+    lib.fedml_router_frames_routed.argtypes = [ctypes.c_void_p]
+    lib.fedml_router_bytes_routed.restype = ctypes.c_ulonglong
+    lib.fedml_router_bytes_routed.argtypes = [ctypes.c_void_p]
+    lib.fedml_router_connected_ranks.restype = ctypes.c_int
+    lib.fedml_router_connected_ranks.argtypes = [ctypes.c_void_p]
+    _lib_handle = lib
+    return lib
+
+
+class NativeRouter:
+    """Owns one broker instance inside this process.
+
+    In production the broker runs wherever the federation coordinator lives
+    (it is silo-agnostic — payloads are opaque bytes); in tests and
+    single-host simulation it lives in-process.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        lib = load_lib()
+        out_port = ctypes.c_int(-1)
+        self._handle = lib.fedml_router_start(host.encode(), port,
+                                              ctypes.byref(out_port))
+        if not self._handle:
+            raise NativeUnavailable(
+                f"router failed to bind {host}:{port}")
+        self._lib = lib
+        self.host = host
+        self.port = out_port.value
+
+    @property
+    def frames_routed(self) -> int:
+        return int(self._lib.fedml_router_frames_routed(self._handle))
+
+    @property
+    def bytes_routed(self) -> int:
+        return int(self._lib.fedml_router_bytes_routed(self._handle))
+
+    @property
+    def connected_ranks(self) -> int:
+        return int(self._lib.fedml_router_connected_ranks(self._handle))
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.fedml_router_stop(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def __del__(self) -> None:
+        try:
+            self.stop()
+        except Exception:
+            pass

@@ -1,0 +1,171 @@
+"""Native C++ message router (native/router.cpp) + ROUTED backend.
+
+The native component replaces the transport role of the reference's
+mpi4py/MQTT stack; these tests build the shared library with g++ (baked into
+the environment) and exercise it end-to-end.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+pytest.importorskip("ctypes")
+
+from fedml_tpu.native import NativeRouter, NativeUnavailable, build_lib
+
+try:
+    build_lib()
+    _HAVE_NATIVE = True
+except NativeUnavailable as exc:  # pragma: no cover - toolchain is baked in
+    _HAVE_NATIVE = False
+    _REASON = str(exc)
+
+pytestmark = pytest.mark.skipif(not _HAVE_NATIVE,
+                                reason="native toolchain unavailable")
+
+_HELLO = struct.Struct("<II")
+_HDR = struct.Struct("<IQ")
+_MAGIC = 0x464D4C52
+
+
+def _dial(port: int, rank: int) -> socket.socket:
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(_HELLO.pack(_MAGIC, rank))
+    return s
+
+
+def _send(s: socket.socket, dest: int, payload: bytes):
+    s.sendall(_HDR.pack(dest, len(payload)) + payload)
+
+
+def _recv(s: socket.socket):
+    hdr = b""
+    while len(hdr) < _HDR.size:
+        chunk = s.recv(_HDR.size - len(hdr))
+        assert chunk, "router closed"
+        hdr += chunk
+    src, length = _HDR.unpack(hdr)
+    buf = b""
+    while len(buf) < length:
+        chunk = s.recv(min(1 << 20, length - len(buf)))
+        assert chunk, "router closed mid-frame"
+        buf += chunk
+    return src, buf
+
+
+class TestRouterCore:
+    def test_route_between_ranks(self):
+        with NativeRouter() as r:
+            a, b = _dial(r.port, 1), _dial(r.port, 2)
+            _send(a, 2, b"hello-from-1")
+            src, payload = _recv(b)
+            assert (src, payload) == (1, b"hello-from-1")
+            _send(b, 1, b"reply")
+            assert _recv(a) == (2, b"reply")
+            assert r.frames_routed == 2
+            assert r.bytes_routed == len(b"hello-from-1") + len(b"reply")
+            a.close(), b.close()
+
+    def test_buffering_before_destination_connects(self):
+        with NativeRouter() as r:
+            a = _dial(r.port, 1)
+            _send(a, 5, b"early-frame")
+            _send(a, 5, b"second")
+            b = _dial(r.port, 5)  # flushes backlog in order
+            assert _recv(b) == (1, b"early-frame")
+            assert _recv(b) == (1, b"second")
+            a.close(), b.close()
+
+    def test_duplicate_rank_refused(self):
+        with NativeRouter() as r:
+            a = _dial(r.port, 7)
+            _send(a, 7, b"loop")  # self-addressed, proves a is functional
+            assert _recv(a) == (7, b"loop")
+            dup = _dial(r.port, 7)
+            # the router closes the duplicate: the next read returns EOF
+            dup.settimeout(10)
+            assert dup.recv(1) == b""
+            a.close(), dup.close()
+
+    def test_large_frame(self):
+        with NativeRouter() as r:
+            a, b = _dial(r.port, 0), _dial(r.port, 1)
+            blob = np.random.default_rng(0).integers(
+                0, 256, 8 << 20, dtype=np.uint8).tobytes()  # 8 MiB
+            _send(a, 1, blob)
+            src, payload = _recv(b)
+            assert src == 0 and payload == blob
+            a.close(), b.close()
+
+    def test_stop_unblocks_clients(self):
+        r = NativeRouter()
+        a = _dial(r.port, 3)
+        done = threading.Event()
+
+        def reader():
+            try:
+                _recv(a)
+            except AssertionError:
+                pass
+            done.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        r.stop()
+        assert done.wait(timeout=10), "client blocked after router stop"
+        a.close()
+
+
+class TestRoutedBackend:
+    def test_message_round_trip(self):
+        from fedml_tpu.comm.message import Message
+        from fedml_tpu.comm.routed import RoutedCommManager
+
+        with NativeRouter() as r:
+            addr = ("127.0.0.1", r.port)
+            m1 = RoutedCommManager(1, addr)
+            m2 = RoutedCommManager(2, addr)
+            got = []
+
+            class Sink:
+                def receive_message(self, msg_type, msg):
+                    got.append((msg_type, msg))
+                    m2.stop_receive_message()
+
+            m2.add_observer(Sink())
+            msg = Message(42, 1, 2)
+            msg.add("weights", np.arange(1000, dtype=np.float32))
+            m1.send_message(msg)
+            m2.handle_receive_message()  # blocks until sink stops it
+            assert got and got[0][0] == 42
+            np.testing.assert_array_equal(
+                got[0][1].get("weights"), np.arange(1000, dtype=np.float32))
+            m1.stop_receive_message()
+
+    def test_fedavg_federation_over_native_broker(self):
+        """Full cross-silo FedAvg protocol with every rank dialing the C++
+        broker — the reference's MQTT scenario, end to end."""
+        import jax
+
+        from fedml_tpu.algorithms.fedavg_cross_silo import \
+            run_fedavg_cross_silo
+        from fedml_tpu.data.synthetic import make_blob_federated
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        ds = make_blob_federated(client_num=3, dim=8, class_num=3,
+                                 n_samples=120, seed=0)
+        model = LogisticRegression(num_classes=3)
+        with NativeRouter() as r:
+            final, history = run_fedavg_cross_silo(
+                ds, model, worker_num=3, comm_round=3,
+                train_cfg=TrainConfig(epochs=1, batch_size=10, lr=0.5),
+                backend="ROUTED",
+                addresses={"router": ("127.0.0.1", r.port)})
+            assert r.frames_routed > 0
+        assert len(history) == 3
+        assert history[-1]["test_acc"] >= history[0]["test_acc"] - 0.05
+        jax.block_until_ready(final)
