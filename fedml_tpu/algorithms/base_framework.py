@@ -34,6 +34,7 @@ from fedml_tpu.comm.manager import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.core import pytree as ptu
 from fedml_tpu.core.topology import SymmetricTopologyManager
+from fedml_tpu.utils.context import FederationErrors, federation_guard
 
 # message schema (base_framework/message_define.py)
 MSG_TYPE_S2C_INIT = 1
@@ -178,25 +179,20 @@ def _run_rank_threads(managers: List[Any], timeout: float = 60.0) -> None:
     """Run every manager's event loop on its own thread; re-raise the first
     handler exception on the caller (a dead rank otherwise deadlocks the
     federation and the launcher would silently return partial results)."""
-    errors: List[BaseException] = []
+    errors = FederationErrors()
 
-    def runner(m):
-        try:
+    def runner(rank, m):
+        with federation_guard(errors, managers, rank=rank):
             m.run()
-        except BaseException as exc:  # noqa: BLE001 — surfaced to caller
-            errors.append(exc)
-            for other in managers:
-                other.finish()
 
-    threads = [threading.Thread(target=runner, args=(m,), daemon=True)
-               for m in managers]
+    threads = [threading.Thread(target=runner, args=(i, m), daemon=True)
+               for i, m in enumerate(managers)]
     for t in threads:
         t.start()
     deadline = time.monotonic() + timeout  # shared: N joins, one budget
     for t in threads:
         t.join(timeout=max(0.0, deadline - time.monotonic()))
-    if errors:
-        raise errors[0]
+    errors.reraise()
     if any(t.is_alive() for t in threads):
         raise TimeoutError(
             f"federation did not terminate within {timeout:.0f}s "

@@ -61,8 +61,15 @@ class RoutedCommManager(BaseCommunicationManager):
                 hdr = _recv_exact(self._sock, _HDR.size)
                 _src, length = _HDR.unpack(hdr)
                 self._inbox.put(_recv_exact(self._sock, length))
-        except (ConnectionError, OSError):
-            self._inbox.put(_STOP)
+        except (ConnectionError, OSError) as exc:
+            if self._running:
+                # broker died mid-protocol: this must surface as an error,
+                # not look like a clean stop (the manager would otherwise
+                # "finish" with a partial round and no exception)
+                self._inbox.put(ConnectionError(
+                    f"rank {self.rank}: router connection lost: {exc}"))
+            else:
+                self._inbox.put(_STOP)
 
     def handle_receive_message(self) -> None:
         self._running = True
@@ -72,6 +79,8 @@ class RoutedCommManager(BaseCommunicationManager):
             item = self._inbox.get()
             if item is _STOP:
                 break
+            if isinstance(item, ConnectionError):
+                raise item
             msg = Message.from_bytes(item)
             self._notify(msg)
 

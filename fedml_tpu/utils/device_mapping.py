@@ -1,0 +1,91 @@
+"""Worker → device placement (the reference's gpu_mapping subsystem).
+
+The reference packs MPI processes onto GPUs from a yaml file
+``{host: [procs_per_gpu, ...]}`` (fedml_api/distributed/utils/gpu_mapping.py:8
+``mapping_processes_to_gpu_device_from_yaml_file``; format documented in
+fedml_experiments/distributed/fed_launch/README.md). On TPU the analogue is
+two-level:
+
+* **intra-host**: assign simulation workers to local ``jax.Device``s
+  round-robin or from an explicit per-host count list;
+* **inter-host**: build the global ``jax.sharding.Mesh`` over all hosts'
+  devices with named axes — placement then lives in shardings, not in a
+  side-channel yaml.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def mapping_workers_to_devices(
+        worker_num: int,
+        devices: Optional[Sequence] = None,
+        procs_per_device: Optional[List[int]] = None) -> List:
+    """Return ``worker_num`` device assignments.
+
+    ``procs_per_device[i]`` = how many workers share device *i* (the
+    reference's per-GPU packing list, gpu_mapping.yaml:11-13); default is
+    round-robin over all local devices.
+    """
+    devices = list(devices if devices is not None else jax.local_devices())
+    if procs_per_device is not None:
+        if len(procs_per_device) != len(devices):
+            raise ValueError(
+                f"procs_per_device has {len(procs_per_device)} entries for "
+                f"{len(devices)} devices")
+        slots = [d for d, k in zip(devices, procs_per_device)
+                 for _ in range(k)]
+        if len(slots) < worker_num:
+            raise ValueError(
+                f"mapping provides {len(slots)} slots < {worker_num} workers")
+        return slots[:worker_num]
+    return [devices[i % len(devices)] for i in range(worker_num)]
+
+
+def mapping_from_spec(spec: Dict[str, List[int]],
+                      host: Optional[str] = None,
+                      rank: int = 0):
+    """Reference-compatible entry: ``spec`` is the parsed yaml mapping
+    ``{hostname: [procs_per_device, ...]}``; returns the device for this
+    ``rank`` counted across the host's packing list (the same walk as
+    gpu_mapping.py:14-33)."""
+    host = host or next(iter(spec))
+    if host not in spec:
+        raise KeyError(f"host {host!r} not in mapping {list(spec)}")
+    counts = spec[host]
+    devices = jax.local_devices()
+    if len(counts) > len(devices):
+        raise ValueError(
+            f"mapping for {host!r} packs {len(counts)} devices but only "
+            f"{len(devices)} are local — placement would be wrong")
+    flat: List[int] = [i for i, k in enumerate(counts) for _ in range(k)]
+    if rank >= len(flat):
+        raise ValueError(f"rank {rank} exceeds {len(flat)} mapped slots")
+    return devices[flat[rank]]
+
+
+def build_client_mesh(n_clients: int,
+                      devices: Optional[Sequence] = None,
+                      group_num: Optional[int] = None) -> "jax.sharding.Mesh":
+    """The TPU-native placement object: a mesh with a ``clients`` axis (and
+    an optional leading ``group`` axis for hierarchical FL). This — not a
+    yaml file — is what distributed rounds consume."""
+    avail = list(devices if devices is not None else jax.devices())
+    if len(avail) < n_clients:
+        raise ValueError(
+            f"need {n_clients} devices for a {n_clients}-client mesh, have "
+            f"{len(avail)}; virtualize clients per core instead (the SPMD "
+            "round packs multiple sampled clients per shard)")
+    devices = np.asarray(avail[:n_clients])
+    if group_num is not None:
+        if n_clients % group_num:
+            raise ValueError(f"{n_clients} clients not divisible into "
+                             f"{group_num} groups")
+        return jax.sharding.Mesh(
+            devices.reshape(group_num, n_clients // group_num),
+            ("group", "clients"))
+    return jax.sharding.Mesh(devices, ("clients",))

@@ -186,6 +186,7 @@ class Router {
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &no_timeout,
                  sizeof(no_timeout));
     std::shared_ptr<Client> self;
+    std::deque<Frame> undelivered;
     {
       // registration and backlog flush happen with write_mu held, so a
       // frame routed concurrently by a sender's reader (which sees
@@ -210,7 +211,25 @@ class Router {
         pending_.erase(it);
       }
       lk.unlock();
-      for (auto& f : backlog) DeliverLocked(*self, f.src, f.payload);
+      while (!backlog.empty()) {
+        if (!DeliverLocked(*self, backlog.front().src,
+                           backlog.front().payload)) {
+          undelivered.swap(backlog);  // connection died during the flush
+          break;
+        }
+        backlog.pop_front();
+      }
+    }
+    if (!undelivered.empty()) {
+      // put what the dead connection never received back at the head of
+      // the queue for the next reconnect (write_mu released: mu_ must
+      // never be acquired while holding a write_mu)
+      std::lock_guard<std::mutex> lk(mu_);
+      auto& q = pending_[rank];
+      for (auto it = undelivered.rbegin(); it != undelivered.rend(); ++it) {
+        q.bytes += it->payload.size();
+        q.frames.push_front(std::move(*it));
+      }
     }
 
     // read loop: route every inbound frame
@@ -241,38 +260,46 @@ class Router {
   // Returns false when the frame had to be dropped (pending overflow) —
   // the caller then drops the sender's connection so the failure is
   // visible instead of the federation hanging on a silently lost message.
+  // A frame whose destination disconnects mid-delivery is requeued into
+  // pending_ (the destination's inbound stream restarts fresh on
+  // reconnect, so redelivering the whole frame is safe).
   bool Route(uint32_t src, uint32_t dest, std::vector<char> payload) {
-    std::shared_ptr<Client> target;
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      auto it = clients_.find(dest);
-      if (it != clients_.end() && it->second->open.load()) {
-        target = it->second;
-      } else {
-        auto& q = pending_[dest];
-        if (q.bytes + payload.size() > kMaxPendingBytes) return false;
-        q.bytes += payload.size();
-        q.frames.push_back(Frame{src, std::move(payload)});
-        return true;
+    for (;;) {
+      std::shared_ptr<Client> target;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = clients_.find(dest);
+        if (it != clients_.end() && it->second->open.load()) {
+          target = it->second;
+        } else {
+          auto& q = pending_[dest];
+          if (q.bytes + payload.size() > kMaxPendingBytes) return false;
+          q.bytes += payload.size();
+          q.frames.push_back(Frame{src, std::move(payload)});
+          return true;
+        }
       }
+      std::lock_guard<std::mutex> lk(target->write_mu);
+      if (DeliverLocked(*target, src, payload)) return true;
+      // destination died mid-flight: loop — it is now closed (requeue into
+      // pending_) or already reconnected (retry delivery)
     }
-    std::lock_guard<std::mutex> lk(target->write_mu);
-    DeliverLocked(*target, src, payload);
-    return true;
   }
 
-  // Caller must hold c.write_mu.
-  void DeliverLocked(Client& c, uint32_t src,
+  // Caller must hold c.write_mu. Returns false if the frame was NOT
+  // delivered (connection closed or write failed).
+  bool DeliverLocked(Client& c, uint32_t src,
                      const std::vector<char>& payload) {
     uint64_t len = payload.size();
-    if (!c.open.load()) return;
+    if (!c.open.load()) return false;
     if (!write_exact(c.fd, &src, 4) || !write_exact(c.fd, &len, 8) ||
         (len > 0 && !write_exact(c.fd, payload.data(), len))) {
       if (c.open.exchange(false)) ::shutdown(c.fd, SHUT_RDWR);
-      return;
+      return false;
     }
     frames_routed_.fetch_add(1);
     bytes_routed_.fetch_add(len);
+    return true;
   }
 
   struct PendingQueue {

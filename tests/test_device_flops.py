@@ -1,0 +1,71 @@
+"""Device-mapping + FLOPs utilities (gpu_mapping.py / test_cnn.py parity)."""
+
+import jax
+import pytest
+
+from fedml_tpu.utils.device_mapping import (build_client_mesh,
+                                            mapping_from_spec,
+                                            mapping_workers_to_devices)
+from fedml_tpu.utils.flops import count_params, model_complexity
+
+
+class TestDeviceMapping:
+    def test_round_robin_default(self):
+        devs = jax.devices()
+        got = mapping_workers_to_devices(len(devs) * 2 + 1)
+        assert got[0] == devs[0]
+        assert got[len(devs)] == devs[0]  # wraps
+
+    def test_explicit_packing(self):
+        devs = jax.devices()
+        counts = [2] + [0] * (len(devs) - 1)
+        got = mapping_workers_to_devices(2, procs_per_device=counts)
+        assert got == [devs[0], devs[0]]
+        with pytest.raises(ValueError):
+            mapping_workers_to_devices(3, procs_per_device=counts)
+
+    def test_spec_walk(self):
+        n = len(jax.local_devices())
+        spec = {"hostA": [1] * n}
+        assert mapping_from_spec(spec, "hostA", rank=n - 1) == \
+            jax.local_devices()[n - 1]
+        with pytest.raises(KeyError):
+            mapping_from_spec(spec, "hostB")
+        with pytest.raises(ValueError):
+            mapping_from_spec(spec, "hostA", rank=n)
+
+    def test_client_mesh_insufficient_devices(self):
+        with pytest.raises(ValueError, match="virtualize"):
+            build_client_mesh(len(jax.devices()) + 1)
+
+    def test_client_mesh_axes(self):
+        n = len(jax.devices())
+        mesh = build_client_mesh(n)
+        assert mesh.axis_names == ("clients",)
+        if n >= 4 and n % 2 == 0:
+            hmesh = build_client_mesh(n, group_num=2)
+            assert hmesh.axis_names == ("group", "clients")
+            assert hmesh.devices.shape == (2, n // 2)
+
+
+class TestFlops:
+    def test_cnn_complexity(self):
+        from fedml_tpu.models import create_model
+
+        model = create_model("cnn", output_dim=62)
+        info = model_complexity(model, (1, 28, 28, 1))
+        # CNN_DropOut is ~1.2M params (SURVEY §2.5 / cv/cnn.py:75 arch)
+        assert 1.1e6 < info["params"] < 1.4e6
+        # conv2 dominates: 24*24 positions x 3*3*32 MACs x 64 ch x 2
+        # ≈ 21 MFLOP, ~31 MFLOP total for the compiled forward; NaN means
+        # the backend reported no cost model — tolerated
+        assert info["flops"] > 2e7 or info["flops"] != info["flops"]
+
+    def test_count_params_matches_manual(self):
+        import jax.numpy as jnp
+
+        from fedml_tpu.models.lr import LogisticRegression
+
+        m = LogisticRegression(num_classes=10)
+        v = m.init(jax.random.key(0), jnp.zeros((1, 784)), train=False)
+        assert count_params(v) == 784 * 10 + 10

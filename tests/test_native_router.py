@@ -100,6 +100,29 @@ class TestRouterCore:
             assert src == 0 and payload == blob
             a.close(), b.close()
 
+    def test_routed_backend_raises_on_broker_death(self):
+        from fedml_tpu.comm.routed import RoutedCommManager
+
+        r = NativeRouter()
+        m = RoutedCommManager(1, ("127.0.0.1", r.port))
+        result = {}
+
+        def runner():
+            try:
+                m.handle_receive_message()
+                result["outcome"] = "clean-return"
+            except ConnectionError as exc:
+                result["outcome"] = f"raised: {exc}"
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        import time
+        time.sleep(0.3)  # let the loop start
+        r.stop()  # broker dies mid-protocol
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert result["outcome"].startswith("raised"), result
+
     def test_stop_unblocks_clients(self):
         r = NativeRouter()
         a = _dial(r.port, 3)

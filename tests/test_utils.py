@@ -117,3 +117,39 @@ class TestTracing:
         with profile(str(tmp_path / "trace")):
             (jnp.ones(4) * 2).block_until_ready()
         assert os.path.isdir(tmp_path / "trace")
+
+
+class TestFederationGuard:
+    """utils/context.py — the raise_MPI_error analogue."""
+
+    def test_records_and_stops_managers(self):
+        from fedml_tpu.utils.context import (FederationErrors,
+                                             federation_guard)
+
+        class FakeManager:
+            stopped = False
+
+            def finish(self):
+                self.stopped = True
+
+        errors = FederationErrors()
+        managers = [FakeManager(), FakeManager()]
+        with federation_guard(errors, managers, rank=3):
+            raise RuntimeError("rank died")
+        assert all(m.stopped for m in managers)
+        try:
+            errors.reraise()
+        except RuntimeError as exc:
+            assert "rank died" in str(exc)
+        else:
+            raise AssertionError("expected reraise")
+
+    def test_clean_path_is_silent(self):
+        from fedml_tpu.utils.context import (FederationErrors,
+                                             federation_guard)
+
+        errors = FederationErrors()
+        with federation_guard(errors, []):
+            pass
+        assert errors.first is None
+        errors.reraise()  # no-op
