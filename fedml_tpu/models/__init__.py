@@ -37,6 +37,9 @@ def create_model(model_name: str, output_dim: int = 10, **kw):
     if model_name == "rnn_stackoverflow":
         from fedml_tpu.models.rnn import RNN_StackOverflow
         return RNN_StackOverflow(**kw)
+    if model_name == "transformer":
+        from fedml_tpu.models.transformer import TransformerLM
+        return TransformerLM(vocab_size=output_dim, **kw)
     if model_name in ("vgg11", "vgg13", "vgg16", "vgg19"):
         from fedml_tpu.models.vgg import VGG
         return VGG(arch=model_name, num_classes=output_dim, **kw)

@@ -1,0 +1,95 @@
+"""Decoder-only transformer LM — the long-context extension of the zoo.
+
+The reference's NLP models are LSTMs (fedml_api/model/nlp/rnn.py:5,41); this
+model serves the same federated next-word-prediction task contract (logits
+for every position, [B, T, V], like ``RNN_StackOverflow``) but scales to
+long sequences: its attention is an injectable callable over [B, S, H, D],
+so the same module runs
+
+* single-device: the plain softmax oracle (default), or
+* sequence-parallel: ``ring_attention``/``ulysses_attention`` from
+  fedml_tpu/parallel/sequence.py, with the whole ``apply`` wrapped in
+  ``shard_map`` over a ('seq',) — or ('clients', 'seq') — mesh.
+
+TPU notes: widths default to MXU-friendly multiples of 128; everything is
+static-shaped; the causal mask lives inside the attention callable so the
+sequence axis can be sharded without materializing [S, S] anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+AttnFn = Callable[..., jnp.ndarray]  # (q, k, v, causal=...) -> out
+
+
+def _default_attention(q, k, v, causal: bool = True):
+    from fedml_tpu.parallel.sequence import reference_attention
+    return reference_attention(q, k, v, causal=causal)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, width = x.shape
+        head_dim = width // self.num_heads
+        attn = self.attn_fn or _default_attention
+
+        h = nn.LayerNorm()(x)
+        qkv = nn.Dense(3 * width, use_bias=False)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape4 = (b, s, self.num_heads, head_dim)
+        out = attn(q.reshape(shape4), k.reshape(shape4), v.reshape(shape4),
+                   causal=True)
+        out = nn.Dense(width, use_bias=False)(out.reshape(b, s, width))
+        out = nn.Dropout(self.dropout, deterministic=not train)(out)
+        x = x + out
+
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.mlp_ratio * width)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(width)(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM scoring every position (same output contract as
+    RNN_StackOverflow: [B, T, vocab])."""
+
+    vocab_size: int = 10004
+    width: int = 256
+    depth: int = 4
+    num_heads: int = 4
+    max_len: int = 2048
+    dropout: float = 0.0
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False, pos_offset=0):
+        # pos_offset: global position of this shard's first token — pass
+        # axis_index('seq') * s_local when the sequence axis is sharded so
+        # every shard reads its own slice of the learned position table
+        # (the GLOBAL sequence must still fit in max_len; the static check
+        # below can only see this shard's length)
+        b, s = input_seq.shape
+        if s > self.max_len:
+            raise ValueError(f"sequence length {s} > max_len {self.max_len}; "
+                             "nn.Embed would silently clamp positions")
+        x = nn.Embed(self.vocab_size, self.width)(input_seq)
+        pos = nn.Embed(self.max_len, self.width,
+                       name="pos_embed")(jnp.arange(s) + pos_offset)
+        x = x + pos[None]
+        for _ in range(self.depth):
+            x = TransformerBlock(self.num_heads, dropout=self.dropout,
+                                 attn_fn=self.attn_fn)(x, train=train)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.vocab_size)(x)

@@ -1,0 +1,86 @@
+"""Transformer LM: single-device vs sequence-parallel equality + federated
+NWP training round (the long-context path end to end)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.models import create_model
+from fedml_tpu.models.transformer import TransformerLM
+from fedml_tpu.parallel.sequence import ring_attention
+from fedml_tpu.parallel.spmd import build_mesh
+
+
+def test_forward_shape_and_factory():
+    model = create_model("transformer", output_dim=100, width=64, depth=2,
+                         num_heads=2, max_len=64)
+    x = jnp.zeros((2, 16), jnp.int32)
+    v = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(v, x, train=False)
+    assert out.shape == (2, 16, 100)
+
+
+def test_sequence_parallel_apply_matches_single_device():
+    """Whole-model apply inside shard_map over a seq mesh == local apply."""
+    n = min(8, len(jax.devices()))
+    mesh = build_mesh({"seq": n})
+    s = 8 * n
+    local = TransformerLM(vocab_size=50, width=32, depth=2, num_heads=2,
+                          max_len=s)
+    sp = TransformerLM(vocab_size=50, width=32, depth=2, num_heads=2,
+                       max_len=s,
+                       attn_fn=functools.partial(ring_attention,
+                                                 axis_name="seq"))
+    x = jnp.asarray(np.random.RandomState(0).randint(0, 50, (2, s)),
+                    jnp.int32)
+    variables = local.init(jax.random.key(0), x, train=False)
+    ref = local.apply(variables, x, train=False)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), P(None, "seq")),
+                       out_specs=P(None, "seq", None))
+    def fwd_sharded(v, x_shard):
+        offset = jax.lax.axis_index("seq") * x_shard.shape[1]
+        return sp.apply(v, x_shard, train=False, pos_offset=offset)
+
+    out = jax.jit(fwd_sharded)(variables, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_federated_nwp_training_with_transformer():
+    """FedAvg over a tiny transformer on synthetic next-word data: loss
+    falls — the federated long-context LM path end to end."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.data.base import FederatedDataset
+    from fedml_tpu.trainer.functional import TrainConfig
+
+    rng = np.random.RandomState(0)
+    vocab, seq = 20, 16
+    # learnable structure: next token = (token + 1) % vocab
+    def client_data(n):
+        starts = rng.randint(0, vocab, n)
+        xs = (starts[:, None] + np.arange(seq)) % vocab
+        ys = (xs + 1) % vocab
+        return xs.astype(np.int32), ys.astype(np.int32)
+
+    train = {c: client_data(12) for c in range(4)}
+    ds = FederatedDataset.from_client_arrays(train, {c: None for c in train},
+                                             vocab)
+    model = create_model("transformer", output_dim=vocab, width=32, depth=1,
+                         num_heads=2, max_len=seq)
+    api = FedAvgAPI(ds, model, task="nwp",
+                    config=FedAvgConfig(comm_round=8, client_num_per_round=4,
+                                        frequency_of_the_test=10 ** 9,
+                                        train=TrainConfig(epochs=1,
+                                                          batch_size=4,
+                                                          lr=0.05)))
+    losses = []
+    for r in range(8):
+        _, stats = api.run_round(r)
+        losses.append(float(stats["loss_sum"]) / float(stats["count"]))
+    assert losses[-1] < losses[0] * 0.8, losses
