@@ -1,0 +1,161 @@
+"""Multi-host execution: rendezvous, global meshes, per-host data feeding.
+
+The reference scales out with ``mpirun -np N -hostfile mpi_host_file``
+(run_fedavg_distributed_pytorch.sh:19-22) and mpi4py point-to-point sends.
+The TPU-native equivalent has no application-level messaging at all:
+
+1. every host calls :func:`initialize` (``jax.distributed.initialize`` —
+   coordinator rendezvous over DCN, the role of the MPI hostfile);
+2. :func:`global_client_mesh` builds one mesh over ALL hosts' devices —
+   XLA then routes ``psum`` over ICI within a slice and DCN across slices,
+   replacing rank-0 aggregation entirely;
+3. each host feeds only the shards it owns (:func:`local_client_slice` /
+   :func:`host_local_to_global`), the multi-host analogue of the
+   reference's per-rank dataset virtualization (FedAVGTrainer.update_dataset).
+
+Single-host runs need none of this — every helper degrades gracefully to
+process_count == 1 (which is also how unit tests cover the logic).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+_initialized = False
+
+# env vars whose presence means jax.distributed.initialize() can auto-detect
+# the cluster (TPU pod metadata / Slurm / explicit JAX coordinator)
+_CLUSTER_ENV_VARS = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                     "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID",
+                     "TPU_WORKER_HOSTNAMES")
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               **kw) -> Tuple[int, int]:
+    """Join the multi-host job (idempotent). Returns (process_id, count).
+
+    MUST run before any other JAX call (touching jax.devices() or
+    jax.process_count() first initializes the local backend, after which
+    rendezvous is impossible — jax.distributed.initialize raises). With no
+    arguments, attempts environment auto-detection when a cluster env var
+    is present; otherwise single-host, returning (0, 1).
+    """
+    global _initialized
+    import os
+
+    explicit = coordinator_address is not None
+    if not _initialized and (explicit or any(v in os.environ
+                                             for v in _CLUSTER_ENV_VARS)):
+        try:
+            if explicit:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id, **kw)
+            else:
+                jax.distributed.initialize(**kw)  # env auto-detection
+            _initialized = True
+        except (RuntimeError, ValueError) as exc:
+            if explicit:
+                # the caller asked for multi-host; degrading silently would
+                # leave every host training in isolation
+                raise RuntimeError(
+                    "multi-host rendezvous failed — initialize() must be "
+                    "the first JAX call in the process: " + str(exc)
+                ) from exc
+            logging.warning("distributed auto-init failed, running "
+                            "single-host: %s", exc)
+    return jax.process_index(), jax.process_count()
+
+
+def global_client_mesh(clients_per_host: Optional[int] = None,
+                       group_axis_from_hosts: bool = False) -> Mesh:
+    """One mesh over every device of every host.
+
+    ``group_axis_from_hosts=True`` maps hierarchical FL onto the physical
+    topology: hosts become the ``group`` axis (edge servers), each host's
+    devices the ``clients`` axis — so group aggregation's psum rides ICI
+    and only the cloud step crosses DCN.
+    """
+    devs = jax.devices()
+    if group_axis_from_hosts:
+        n_hosts = jax.process_count()
+        per_host = len(devs) // n_hosts
+        rows = [[d for d in devs if d.process_index == h][:per_host]
+                for h in range(n_hosts)]
+        return Mesh(np.asarray(rows, dtype=object), ("group", "clients"))
+    if clients_per_host:
+        # take k devices from EVERY host (jax.devices() orders by process,
+        # so a flat [:k*hosts] slice would use only the first hosts)
+        picked = [d for h in range(jax.process_count())
+                  for d in [x for x in devs if x.process_index == h]
+                  [:clients_per_host]]
+        return Mesh(np.asarray(picked), ("clients",))
+    return Mesh(np.asarray(devs), ("clients",))
+
+
+def local_client_slice(mesh: Mesh, n_items: int,
+                       axis: str = "clients") -> Tuple[int, int]:
+    """[start, stop) of the global client-batch rows THIS host must feed.
+
+    The multi-host data contract: every host materializes only its slice of
+    the stacked per-client arrays (the reference instead sent each rank its
+    sampled client's data by re-pointing the loader, fedavg_api.py:65-70).
+    """
+    if mesh.devices.ndim != 1:
+        raise ValueError(
+            "local_client_slice addresses a 1-D client mesh; for a "
+            "('group', 'clients') mesh the stacked arrays are sharded over "
+            "both axes — build the global array directly with "
+            "host_local_to_global/make_array_from_process_local_data")
+    axis_size = mesh.shape[axis]
+    if n_items % axis_size:
+        raise ValueError(f"{n_items} rows not divisible by {axis} axis "
+                         f"({axis_size})")
+    per_shard = n_items // axis_size
+    # which shard indices live on this process
+    my = [i for i, d in enumerate(mesh.devices)
+          if d.process_index == jax.process_index()]
+    if not my:
+        return 0, 0
+    if my != list(range(my[0], my[-1] + 1)):
+        raise ValueError(
+            f"this host's shard indices {my} are not contiguous on the "
+            f"{axis!r} axis; reorder the mesh devices by process so each "
+            "host feeds one contiguous row block")
+    return my[0] * per_shard, (my[-1] + 1) * per_shard
+
+
+def host_local_to_global(mesh: Mesh, local_arrays, n_global: int,
+                         axis: str = "clients"):
+    """Assemble a global device array from each host's local rows
+    (``jax.make_array_from_process_local_data``); single-process: identity
+    device_put with the mesh sharding."""
+    spec = P(axis)
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda a: jax.device_put(a, sharding),
+                            local_arrays)
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(sharding, a),
+        local_arrays)
+
+
+def all_hosts_agree(value: int) -> bool:
+    """Cheap cross-host desync detector (round index, sampled-client hash):
+    allgather the value and check every host reported the same. Single
+    host: trivially True."""
+    if jax.process_count() == 1:
+        return True
+    from jax.experimental import multihost_utils  # pragma: no cover
+
+    gathered = multihost_utils.process_allgather(np.asarray([value]))
+    return bool(np.all(gathered == value))
