@@ -30,45 +30,12 @@ import optax
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import TrainConfig
-from fedml_tpu.trainer.tasks import TASK_HEADS, Stats
-
-IGNORE_INDEX = 255
-
-
-def _pixel_mask(targets: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    """Valid-pixel weights: example mask x (target != ignore_index)."""
-    valid = (targets != IGNORE_INDEX).astype(jnp.float32)
-    return valid * mask.reshape(mask.shape + (1,) * (targets.ndim - 1))
-
-
-def segmentation_ce(logits, targets, mask) -> Stats:
-    """Mean per-valid-pixel CE (SegmentationLosses.CrossEntropyLoss)."""
-    safe_targets = jnp.where(targets == IGNORE_INDEX, 0, targets)
-    per_px = optax.softmax_cross_entropy_with_integer_labels(logits,
-                                                             safe_targets)
-    pm = _pixel_mask(targets, mask)
-    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
-    return {"loss_sum": jnp.sum(per_px * pm), "count": jnp.sum(pm),
-            "correct_sum": jnp.sum(correct * pm)}
-
-
-def segmentation_focal(logits, targets, mask, gamma: float = 2.0,
-                       alpha: float = 0.5) -> Stats:
-    """Focal loss: -alpha * (1-pt)^gamma * log pt per valid pixel
-    (SegmentationLosses.FocalLoss, utils.py:95-109)."""
-    safe_targets = jnp.where(targets == IGNORE_INDEX, 0, targets)
-    logpt = -optax.softmax_cross_entropy_with_integer_labels(logits,
-                                                             safe_targets)
-    pt = jnp.exp(logpt)
-    per_px = -((1.0 - pt) ** gamma) * alpha * logpt
-    pm = _pixel_mask(targets, mask)
-    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
-    return {"loss_sum": jnp.sum(per_px * pm), "count": jnp.sum(pm),
-            "correct_sum": jnp.sum(correct * pm)}
-
-
-TASK_HEADS.setdefault("segmentation", segmentation_ce)
-TASK_HEADS.setdefault("segmentation_focal", segmentation_focal)
+# the per-pixel loss heads live with the other task heads so every
+# algorithm (not just FedSegAPI) can train on segmentation datasets
+from fedml_tpu.trainer.tasks import (IGNORE_INDEX, Stats,
+                                     segmentation_focal_head as
+                                     segmentation_focal,
+                                     segmentation_head as segmentation_ce)
 
 
 def make_lr_schedule(mode: str, base_lr: float, num_epochs: int,

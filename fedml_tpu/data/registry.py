@@ -73,6 +73,12 @@ def _blob(data_dir, **kw):
         partition_alpha=kw.get("partition_alpha", 0.5))
 
 
+def _seg_shapes(data_dir, **kw):
+    from fedml_tpu.data.synthetic import make_shapes_segmentation
+    return make_shapes_segmentation(
+        client_num=kw.get("client_num_in_total", 4))
+
+
 LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "mnist": _mnist,
     "shakespeare": _shakespeare,
@@ -85,6 +91,7 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "cinic10": _cifar_family("cinic10"),
     "synthetic": _synthetic_generated,  # generated in-memory (no files)
     "blob": _blob,                      # test/bench workhorse
+    "seg_shapes": _seg_shapes,          # synthetic segmentation (fedseg)
 }
 
 # reference --dataset name -> (model factory name, task head)
@@ -101,6 +108,7 @@ DEFAULT_MODEL_AND_TASK = {
     "cinic10": ("resnet56", "classification"),
     "synthetic": ("lr", "classification"),
     "blob": ("lr", "classification"),
+    "seg_shapes": ("segnet", "segmentation"),
 }
 
 

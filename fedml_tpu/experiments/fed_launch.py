@@ -22,8 +22,8 @@ from fedml_tpu.utils.metrics import MetricsSink
 # algorithms/vertical_fl.py)
 WIRED_ALGOS = ["fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
                "decentralized", "centralized", "fednas", "fedgkt",
-               "turboaggregate"]
-ALGOS = WIRED_ALGOS + ["fedseg", "split_nn", "vertical_fl"]
+               "turboaggregate", "fedseg"]
+ALGOS = WIRED_ALGOS + ["split_nn", "vertical_fl"]
 
 
 def add_algo_args(parser: argparse.ArgumentParser):
@@ -56,6 +56,9 @@ def add_algo_args(parser: argparse.ArgumentParser):
     parser.add_argument("--arch_lr", type=float, default=3e-4)
     # turboaggregate
     parser.add_argument("--frac_bits", type=int, default=16)
+    # fedseg (reference SegmentationLosses / LR_Scheduler knobs)
+    parser.add_argument("--seg_loss", type=str, default="ce",
+                        choices=["ce", "focal"])
 
 
 def _log_history(api, sink):
@@ -180,6 +183,16 @@ def run_algo(args):
         sink.log(rec)
         sink.finish()
         return rec
+    elif args.algo == "fedseg":
+        from fedml_tpu.algorithms.fedavg import FedAvgConfig
+        from fedml_tpu.algorithms.fedseg import FedSegAPI
+        if ds.train_data_global[1].ndim != 3:
+            raise SystemExit(
+                "fedseg needs per-pixel labels [N, H, W] (e.g. --dataset "
+                f"seg_shapes); {args.dataset!r} labels have shape "
+                f"{ds.train_data_global[1].shape[1:]}")
+        api = FedSegAPI(ds, model, config=FedAvgConfig(**common),
+                        loss_mode=args.seg_loss)
     elif args.algo == "fedgkt":
         from fedml_tpu.algorithms.fedgkt import FedGKTAPI, FedGKTConfig
         from fedml_tpu.models.resnet_gkt import resnet8_56, resnet56_server
@@ -213,8 +226,7 @@ def main(argv=None):
     args = apply_ci_truncation(parser.parse_args(argv))
     if args.algo not in WIRED_ALGOS:
         # reject BEFORE any dataset download / wandb run is opened
-        why = {"fedseg": "needs a segmentation dataset + model",
-               "split_nn": "needs a model-split (bottom/top) spec",
+        why = {"split_nn": "needs a model-split (bottom/top) spec",
                "vertical_fl": "needs a per-party feature-split spec"}
         reason = why.get(args.algo, "not dispatchable from generic flags")
         raise SystemExit(

@@ -82,10 +82,49 @@ def tag_prediction_head(logits: jnp.ndarray, targets: jnp.ndarray,
     }
 
 
+# -- segmentation heads (reference fedseg SegmentationLosses, utils.py:71) --
+
+IGNORE_INDEX = 255  # Pascal-VOC convention: pixels excluded from loss/metrics
+
+
+def _pixel_mask(targets: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Valid-pixel weights: example mask x (target != ignore_index)."""
+    valid = (targets != IGNORE_INDEX).astype(jnp.float32)
+    return valid * mask.reshape(mask.shape + (1,) * (targets.ndim - 1))
+
+
+def segmentation_head(logits, targets, mask) -> Stats:
+    """Mean per-valid-pixel CE (SegmentationLosses.CrossEntropyLoss)."""
+    safe_targets = jnp.where(targets == IGNORE_INDEX, 0, targets)
+    per_px = optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                             safe_targets)
+    pm = _pixel_mask(targets, mask)
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    return {"loss_sum": jnp.sum(per_px * pm), "count": jnp.sum(pm),
+            "correct_sum": jnp.sum(correct * pm)}
+
+
+def segmentation_focal_head(logits, targets, mask, gamma: float = 2.0,
+                            alpha: float = 0.5) -> Stats:
+    """Focal loss: -alpha * (1-pt)^gamma * log pt per valid pixel
+    (SegmentationLosses.FocalLoss, utils.py:95-109)."""
+    safe_targets = jnp.where(targets == IGNORE_INDEX, 0, targets)
+    logpt = -optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                             safe_targets)
+    pt = jnp.exp(logpt)
+    per_px = -((1.0 - pt) ** gamma) * alpha * logpt
+    pm = _pixel_mask(targets, mask)
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    return {"loss_sum": jnp.sum(per_px * pm), "count": jnp.sum(pm),
+            "correct_sum": jnp.sum(correct * pm)}
+
+
 TASK_HEADS: Dict[str, TaskHead] = {
     "classification": classification_head,
     "nwp": nwp_head,
     "tag_prediction": tag_prediction_head,
+    "segmentation": segmentation_head,
+    "segmentation_focal": segmentation_focal_head,
 }
 
 

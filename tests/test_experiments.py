@@ -109,3 +109,21 @@ class TestFedLaunch:
         import pytest
         with pytest.raises(SystemExit, match="split_nn"):
             fed_launch.main(self._common(tmp_path, "split_nn"))
+
+    def test_fedseg_via_launcher(self, tmp_path):
+        final = fed_launch.main(
+            ["--algo", "fedseg", "--dataset", "seg_shapes",
+             "--client_num_in_total", "3", "--client_num_per_round", "3",
+             "--comm_round", "3", "--batch_size", "8", "--lr", "0.05",
+             "--frequency_of_the_test", "1",
+             "--run_dir", str(tmp_path / "fedseg")])
+        # a constant all-background predictor gets acc ~0.88 (pixels are
+        # mostly background) and mIoU ~0.29 (bg IoU / 3); require the model
+        # to beat both, i.e. actually segment the shapes
+        assert final["test_mIoU"] > 0.34
+        assert final["test_acc"] > 0.90
+
+    def test_fedseg_rejects_classification_dataset(self, tmp_path):
+        import pytest
+        with pytest.raises(SystemExit, match="per-pixel"):
+            fed_launch.main(self._common(tmp_path, "fedseg"))
