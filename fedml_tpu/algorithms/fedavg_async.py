@@ -1,0 +1,183 @@
+"""Straggler-tolerant aggregation: quorum rounds and fully-async FedAvg.
+
+The reference has NO straggler handling — its server hard-blocks on the
+all-received barrier (FedAVGAggregator.py:50-56 ``check_whether_all_receive``)
+and one dead or slow silo stalls the federation forever (SURVEY §5.3). This
+module adds the two standard relaxations on the cross-silo actor protocol:
+
+* :class:`QuorumFedAvgServerManager` — close the round when all workers
+  reported OR when a deadline expires with at least ``quorum`` updates in;
+  late replies carry a round tag and are discarded (their silo rejoins at
+  the next SYNC broadcast, exactly like a client that missed sampling).
+  The deadline timer does not touch protocol state from its own thread: it
+  posts a self-addressed TIMEOUT message, so the state machine stays
+  single-threaded like every other manager in the comm layer.
+
+* :class:`AsyncFedAvgServerManager` — FedAsync (Xie et al., 2019,
+  arXiv:1903.03934): no rounds at all; every arriving update is merged
+  immediately with a staleness-decayed mixing weight
+  ``alpha_t = alpha * (staleness + 1) ** -poly_a`` and the worker is
+  re-dispatched at the newest model version. Throughput is bounded by the
+  slowest LINK, not the slowest silo.
+
+Both reuse the FedAvg message schema plus a round/version tag on client
+replies (``MSG_ARG_KEY_ROUND``, already part of every S2C message).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg_cross_silo import (
+    MSG_ARG_KEY_CLIENT_INDEX, MSG_ARG_KEY_MODEL_PARAMS,
+    MSG_ARG_KEY_NUM_SAMPLES, MSG_ARG_KEY_ROUND, MSG_TYPE_C2S_SEND_MODEL,
+    MSG_TYPE_S2C_FINISH, MSG_TYPE_S2C_INIT_CONFIG, MSG_TYPE_S2C_SYNC_MODEL,
+    FedAvgAggregator, FedAvgClientManager, FedAvgServerManager, _to_numpy)
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core import pytree as pt
+
+MSG_TYPE_ROUND_TIMEOUT = 9
+
+
+class QuorumFedAvgServerManager(FedAvgServerManager):
+    """All-received barrier relaxed to (all | deadline & quorum)."""
+
+    def __init__(self, *args, quorum: int = 1,
+                 round_deadline_s: float = 10.0, **kw):
+        super().__init__(*args, **kw)
+        if not (1 <= quorum <= self.worker_num):
+            raise ValueError(f"quorum {quorum} outside [1, {self.worker_num}]")
+        self.quorum = quorum
+        self.round_deadline_s = round_deadline_s
+        self._timer: Optional[threading.Timer] = None
+        self.partial_rounds: List[int] = []  # rounds closed below strength
+
+    # -- timer plumbing (single-threaded state machine preserved) ----------
+    def _arm_deadline(self) -> None:
+        self._cancel_deadline()
+        round_idx = self.round_idx
+
+        def fire():
+            tick = Message(MSG_TYPE_ROUND_TIMEOUT, self.rank, self.rank)
+            tick.add(MSG_ARG_KEY_ROUND, round_idx)
+            try:
+                self.send_message(tick)
+            except OSError:  # backend already shut down
+                pass
+
+        self._timer = threading.Timer(self.round_deadline_s, fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _cancel_deadline(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def send_init_msg(self) -> None:
+        super().send_init_msg()
+        self._arm_deadline()
+
+    def register_message_receive_handlers(self) -> None:
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(MSG_TYPE_ROUND_TIMEOUT,
+                                              self.handle_round_timeout)
+
+    # -- protocol ----------------------------------------------------------
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        if msg.get_params().get(MSG_ARG_KEY_ROUND,
+                                self.round_idx) != self.round_idx:
+            return  # stale straggler reply from a closed round: discard
+        worker = msg.get_sender_id() - 1
+        self.aggregator.add_local_trained_result(
+            worker, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+            msg.get(MSG_ARG_KEY_NUM_SAMPLES))
+        if self.aggregator.check_whether_all_receive():
+            self._close_round()
+
+    def handle_round_timeout(self, msg: Message) -> None:
+        if msg.get(MSG_ARG_KEY_ROUND) != self.round_idx:
+            return  # timer from an already-closed round
+        received = self.aggregator.received_count()
+        if received >= self.quorum:
+            self.partial_rounds.append(self.round_idx)
+            self._close_round()
+        else:
+            self._arm_deadline()  # below quorum: keep waiting
+
+    def _close_round(self) -> None:
+        self._cancel_deadline()
+        self.global_model = self.aggregator.aggregate_available()
+        if self.on_round_done is not None:
+            self.on_round_done(self.round_idx, self.global_model)
+        self.round_idx += 1
+        if self.round_idx == self.comm_round:
+            for worker in range(1, self.size):
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
+            self.finish()
+            return
+        idxs = self.aggregator.client_sampling(
+            self.round_idx, self.client_num_in_total, self.worker_num)
+        payload = _to_numpy(self.global_model)
+        for worker in range(1, self.size):
+            msg = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, worker)
+            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
+            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(msg)
+        self._arm_deadline()
+
+    def finish(self) -> None:
+        self._cancel_deadline()
+        super().finish()
+
+
+class AsyncFedAvgServerManager(FedAvgServerManager):
+    """FedAsync: merge every update on arrival, staleness-decayed."""
+
+    def __init__(self, *args, alpha: float = 0.6, poly_a: float = 0.5,
+                 max_updates: int = 100, **kw):
+        kw.setdefault("comm_round", max_updates)
+        super().__init__(*args, **kw)
+        self.alpha = alpha
+        self.poly_a = poly_a
+        self.max_updates = max_updates
+        self.version = 0
+        self.update_log: List[Dict] = []
+
+    def staleness_weight(self, staleness: int) -> float:
+        return self.alpha * float(staleness + 1) ** (-self.poly_a)
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        if self.version >= self.max_updates:
+            return
+        client_version = msg.get_params().get(MSG_ARG_KEY_ROUND, 0)
+        staleness = max(0, self.version - client_version)
+        a = self.staleness_weight(staleness)
+        w_client = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        self.global_model = pt.tree_axpy(
+            a, w_client, pt.tree_scale(self.global_model, 1.0 - a))
+        self.version += 1
+        self.update_log.append({"version": self.version,
+                                "staleness": staleness, "mix": a,
+                                "worker": msg.get_sender_id() - 1})
+        if self.on_round_done is not None:
+            self.on_round_done(self.version, self.global_model)
+        if self.version >= self.max_updates:
+            for worker in range(1, self.size):
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
+            self.finish()
+            return
+        # immediate re-dispatch of THIS worker at the newest version
+        rng = np.random.RandomState(self.version)
+        client_idx = int(rng.randint(0, self.client_num_in_total))
+        out = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, msg.get_sender_id())
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(self.global_model))
+        out.add(MSG_ARG_KEY_CLIENT_INDEX, client_idx)
+        out.add(MSG_ARG_KEY_ROUND, self.version)
+        self.send_message(out)

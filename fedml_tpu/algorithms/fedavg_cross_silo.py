@@ -80,13 +80,32 @@ class FedAvgAggregator:
             return True
         return False
 
+    def _close(self, idxs):
+        stacked = pt.tree_stack([self.model_dict[i] for i in idxs])
+        weights = np.asarray([self.sample_num_dict[i] for i in idxs],
+                             np.float32)
+        if weights.sum() <= 0.0:
+            # every reporter had an empty shard (possible under partial
+            # closes): uniform mix instead of a 0/0 NaN model
+            weights = np.ones_like(weights)
+        out = self._aggregate(stacked, jnp.asarray(weights))
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        self.flag_client_model_uploaded = [False] * self.worker_num
+        return out
+
     def aggregate(self):
-        stacked = pt.tree_stack(
-            [self.model_dict[i] for i in range(self.worker_num)])
-        weights = jnp.asarray(
-            [self.sample_num_dict[i] for i in range(self.worker_num)],
-            jnp.float32)
-        return self._aggregate(stacked, weights)
+        return self._close(range(self.worker_num))
+
+    def received_count(self) -> int:
+        """Updates in hand for the open round (quorum checks)."""
+        return len(self.model_dict)
+
+    def aggregate_available(self):
+        """Weighted mean over whichever workers reported this round, then
+        reset — the straggler-tolerant close (quorum rounds). Equal to
+        :meth:`aggregate` when everyone reported."""
+        return self._close(sorted(self.model_dict))
 
     def client_sampling(self, round_idx: int, client_num_in_total: int,
                         client_num_per_round: int) -> np.ndarray:
@@ -190,6 +209,9 @@ class FedAvgClientManager(ClientManager):
         reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
         reply.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))
         reply.add(MSG_ARG_KEY_NUM_SAMPLES, n_i)
+        # round/version tag: lets straggler-tolerant servers detect stale
+        # replies (fedavg_async.py) — the plain server ignores it
+        reply.add(MSG_ARG_KEY_ROUND, round_idx)
         self.send_message(reply)
 
 

@@ -1,0 +1,130 @@
+"""Straggler-tolerant aggregation: quorum rounds + FedAsync."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg_async import (AsyncFedAvgServerManager,
+                                               QuorumFedAvgServerManager)
+from fedml_tpu.algorithms.fedavg_cross_silo import (FedAvgAggregator,
+                                                    FedAvgClientManager)
+from fedml_tpu.comm.inproc import InProcCommManager, InProcRouter
+from fedml_tpu.data.synthetic import make_blob_federated
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.trainer.functional import TrainConfig
+
+
+class SlowClientManager(FedAvgClientManager):
+    """A straggler silo: sleeps before every local-train reply."""
+
+    def __init__(self, *args, delay_s: float = 1.0, **kw):
+        super().__init__(*args, **kw)
+        self.delay_s = delay_s
+
+    def handle_message_init(self, msg):
+        time.sleep(self.delay_s)
+        super().handle_message_init(msg)
+
+
+def _make_federation(server_cls, n_workers, slow_ranks=(), delay_s=1.0,
+                     **server_kw):
+    ds = make_blob_federated(client_num=n_workers, dim=8, class_num=3,
+                             n_samples=120, seed=1)
+    model = LogisticRegression(num_classes=3)
+    x = ds.train_data_global[0][:1]
+    global_model = model.init(jax.random.key(0), jnp.asarray(x), train=False)
+    tcfg = TrainConfig(epochs=1, batch_size=8, lr=0.3)
+
+    router = InProcRouter()
+    size = n_workers + 1
+    server = server_cls(0, size, InProcCommManager(router, 0, size),
+                        FedAvgAggregator(n_workers),
+                        client_num_in_total=ds.client_num,
+                        global_model=global_model, **server_kw)
+    clients = []
+    for rank in range(1, size):
+        cls = SlowClientManager if rank in slow_ranks else FedAvgClientManager
+        kw = {"delay_s": delay_s} if rank in slow_ranks else {}
+        clients.append(cls(rank, size, InProcCommManager(router, rank, size),
+                           ds, model, "classification", tcfg, **kw))
+    return server, clients
+
+
+def _run(server, clients, timeout=60.0):
+    """Returns the server's wall time (round latency) — clients may drain
+    queued straggler work after the federation is already done."""
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    st = threading.Thread(target=server.run, daemon=True)
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    st.start()
+    server.send_init_msg()
+    st.join(timeout=timeout)
+    server_wall = time.monotonic() - t0
+    assert not st.is_alive(), "server did not finish"
+    for t in threads:
+        t.join(timeout=30.0)
+    return server_wall
+
+
+class TestQuorumRounds:
+    def test_all_fast_behaves_like_plain_fedavg(self):
+        server, clients = _make_federation(
+            QuorumFedAvgServerManager, 3, comm_round=3,
+            quorum=2, round_deadline_s=30.0)
+        _run(server, clients)
+        assert server.round_idx == 3
+        assert server.partial_rounds == []  # nobody timed out
+
+    def test_straggler_does_not_stall_rounds(self):
+        server, clients = _make_federation(
+            QuorumFedAvgServerManager, 3, slow_ranks=(3,), delay_s=5.0,
+            comm_round=3, quorum=2, round_deadline_s=0.6)
+        wall = _run(server, clients)
+        assert server.round_idx == 3
+        assert server.partial_rounds, "expected partial (quorum) closes"
+        # 3 rounds at ~0.6 s deadline each must beat the 15 s the straggler
+        # alone would cost (3 x 5 s)
+        assert wall < 10.0, wall
+
+    def test_quorum_validation(self):
+        with pytest.raises(ValueError, match="quorum"):
+            _make_federation(QuorumFedAvgServerManager, 3, comm_round=1,
+                             quorum=5, round_deadline_s=1.0)
+
+
+class TestFedAsync:
+    def test_staleness_weight_decays(self):
+        server, _ = _make_federation(AsyncFedAvgServerManager, 2,
+                                     max_updates=4)
+        a0 = server.staleness_weight(0)
+        assert a0 == pytest.approx(server.alpha)
+        assert server.staleness_weight(3) < server.staleness_weight(1) < a0
+
+    def test_async_updates_until_budget(self):
+        server, clients = _make_federation(
+            AsyncFedAvgServerManager, 3, max_updates=9, alpha=0.5)
+        _run(server, clients)
+        assert server.version == 9
+        assert len(server.update_log) == 9
+        # every worker contributed (the re-dispatch loop keeps all busy)
+        assert {u["worker"] for u in server.update_log} == {0, 1, 2}
+        assert all(0 < u["mix"] <= server.alpha for u in server.update_log)
+
+    def test_async_with_straggler_makes_progress(self):
+        server, clients = _make_federation(
+            AsyncFedAvgServerManager, 3, slow_ranks=(3,), delay_s=3.0,
+            max_updates=8, alpha=0.5)
+        wall = _run(server, clients)
+        assert server.version == 8
+        # the two fast silos carry the update budget; the straggler's
+        # sleep must not serialize into the wall-clock
+        assert wall < 9.0, wall
+        fast_updates = sum(1 for u in server.update_log
+                           if u["worker"] in (0, 1))
+        assert fast_updates >= 6
