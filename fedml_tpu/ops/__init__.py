@@ -11,6 +11,9 @@ corresponding device-side primitives are hand-tiled Pallas kernels:
   weighted reduction rides the MXU.
 - :mod:`fedml_tpu.ops.quantize` — int8 block-scaled quantization with
   stochastic rounding for cross-silo model-delta compression.
+- :mod:`fedml_tpu.ops.flash_attention` — streaming-softmax attention for
+  the transformer path (VMEM-blocked K/V, causal block skipping), with a
+  blockwise custom VJP.
 
 Every kernel has an ``interpret=True`` path so the math is testable on the
 CPU mesh, and a pure-jnp reference used both as the CPU fallback and as the
@@ -20,6 +23,8 @@ test oracle.
 from fedml_tpu.ops.aggregate import (tree_weighted_mean_pallas,
                                      weighted_mean_flat,
                                      weighted_mean_flat_reference)
+from fedml_tpu.ops.flash_attention import (flash_attention,
+                                           make_flash_attention)
 from fedml_tpu.ops.quantize import (dequantize_int8, dequantize_tree,
                                     quantize_int8, quantize_tree)
 
@@ -31,4 +36,6 @@ __all__ = [
     "dequantize_int8",
     "quantize_tree",
     "dequantize_tree",
+    "flash_attention",
+    "make_flash_attention",
 ]

@@ -1,0 +1,197 @@
+"""Flash attention as a Pallas TPU kernel (forward) with a blockwise VJP.
+
+The transformer path's hot op. The naive attention in
+``parallel/sequence.reference_attention`` materializes the [S, S] score
+matrix in HBM; this kernel streams K/V blocks through VMEM with the online
+softmax (running max / denominator in VMEM scratch), so memory is
+O(block_q x block_k) and the matmuls land on the MXU at [block, head_dim]
+granularity.
+
+Layout: [B, S, H, D] like the rest of the framework; internally the kernel
+runs on a (B*H) x q-block x k-block grid. The k-block axis is the
+innermost, sequential grid dimension on TPU, so the scratch accumulators
+carry across k steps and the output block is finalized at the last k step.
+
+Backward: a ``jax.custom_vjp`` whose residuals are (q, k, v, out, lse);
+gradients are computed blockwise with ``lax.scan`` over k blocks (standard
+FlashAttention-2 recurrence — dS = P * (dP - rowsum(dO * O))). Each scan
+step materializes [B, H, S, block_k] score/probability tensors, so
+backward memory is O(S x block_k) — never the full [S, S] matrix, but a
+weaker bound than the forward kernel's O(block_q x block_k) VMEM tiles; a
+hand-written backward kernel can close that gap later if long-context
+training (rather than inference) becomes the bottleneck.
+
+``flash_attention(..., interpret=True)`` runs the identical kernel through
+the Pallas interpreter for CPU tests; ``make_flash_attention`` returns an
+``attn_fn`` drop-in for :class:`fedml_tpu.models.transformer.TransformerLM`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, causal: bool, scale: float,
+                block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def visible():
+        # [block_q, D] x [block_k, D]^T on the MXU, f32 accumulation
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        l_scr[:] = l_prev * corr + p.sum(axis=1, keepdims=True)
+        m_scr[:] = m_new
+        v = v_ref[0].astype(jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # whole k block in this q block's future -> skip all compute
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(visible)
+    else:
+        visible()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)           # [bq, 1]
+
+
+def _fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    bq, bk = min(block_q, s), min(block_k, s)
+    if s % bq or s % bk:
+        raise ValueError(
+            f"block sizes ({bq},{bk}) must evenly divide seq len {s}")
+    # [B, S, H, D] -> [B*H, S, D]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               block_q=bq, block_k=bk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // bq, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            # trailing singleton keeps the block 2-D-tileable on TPU
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem_scratch((bq, 1)),
+            _vmem_scratch((bq, 1)),
+            _vmem_scratch((bq, d)),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return (out.reshape(b, h, s, d).transpose(0, 2, 1, 3),
+            lse.reshape(b, h, s))  # lse [B*H, S, 1] -> [B, H, S]
+
+
+def _vmem_scratch(shape):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """softmax(QK^T/sqrt(d) [+ causal mask]) V for [B, S, H, D] inputs."""
+    out, _ = _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    bk = min(block_k, s)
+    nk = s // bk
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # D_i = rowsum(dO * O)  [B, H, S]
+    delta = jnp.einsum("bshd,bshd->bhs", dof, out.astype(jnp.float32))
+    qpos = jnp.arange(s)
+
+    def kblock(carry, j):
+        dq_acc = carry
+        ks = jax.lax.dynamic_slice_in_dim(k, j * bk, bk, 1).astype(
+            jnp.float32)
+        vs = jax.lax.dynamic_slice_in_dim(v, j * bk, bk, 1).astype(
+            jnp.float32)
+        sblk = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * scale
+        if causal:
+            kpos = j * bk + jnp.arange(bk)
+            sblk = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
+                             sblk, _NEG_INF)
+        p = jnp.exp(sblk - lse[..., None])           # [B,H,S,bk]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        return dq_acc, (dk_j, dv_j)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        kblock, jnp.zeros_like(qf), jnp.arange(nk))
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def make_flash_attention(block_q: int = 128, block_k: int = 128,
+                         interpret: bool = False):
+    """attn_fn factory for TransformerLM: (q, k, v, causal=...) -> out."""
+    def attn(q, k, v, causal: bool = True):
+        return flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return attn
