@@ -18,13 +18,14 @@ the weighted tree-mean (and, on a mesh, per-shard before the psum).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
 import numpy as np
 
 from fedml_tpu.core import pytree as pt
-from fedml_tpu.core.robust import apply_defense
+from fedml_tpu.core.robust import ROBUST_AGGREGATORS, apply_defense
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 from fedml_tpu.data.base import FederatedDataset
 
@@ -34,6 +35,11 @@ class FedAvgRobustConfig(FedAvgConfig):
     defense_type: Optional[str] = "norm_diff_clipping"
     norm_bound: float = 5.0
     stddev: float = 0.025
+    # Byzantine-robust aggregation rules (beyond the reference's pair):
+    # defense_type = median | trimmed_mean | krum
+    trim_ratio: float = 0.1       # trimmed_mean
+    num_byzantine: int = 1        # krum: assumed attacker count f
+    multi_m: int = 1              # krum: average the m best (multi-Krum)
 
 
 class FedAvgRobustAPI(FedAvgAPI):
@@ -49,13 +55,30 @@ class FedAvgRobustAPI(FedAvgAPI):
         defense_type = config.defense_type
         norm_bound, stddev = config.norm_bound, config.stddev
 
-        def defended_mean(variables, stacked, weights, key):
-            dkeys = jax.random.split(key, weights.shape[0])
-            defended = jax.vmap(
-                lambda upd, k: apply_defense(upd, variables, defense_type,
-                                             norm_bound, stddev, k))(
-                                                 stacked, dkeys)
-            return pt.tree_weighted_mean(defended, weights)
+        if defense_type in ROBUST_AGGREGATORS:
+            # aggregation-RULE defenses: replace the weighted mean itself
+            # (sample weights are deliberately ignored — a Byzantine client
+            # can lie about n_i, so robust rules treat clients uniformly)
+            rule_kwargs = {
+                "trimmed_mean": {"trim_ratio": config.trim_ratio},
+                "krum": {"num_byzantine": config.num_byzantine,
+                         "multi_m": config.multi_m},
+            }.get(defense_type, {})
+            rule = functools.partial(ROBUST_AGGREGATORS[defense_type],
+                                     **rule_kwargs)
+
+            def defended_mean(variables, stacked, weights, key):
+                return rule(stacked)
+        else:
+            # per-UPDATE defenses (reference pair): transform each client
+            # update toward the global model, then weighted-average
+            def defended_mean(variables, stacked, weights, key):
+                dkeys = jax.random.split(key, weights.shape[0])
+                defended = jax.vmap(
+                    lambda upd, k: apply_defense(upd, variables,
+                                                 defense_type, norm_bound,
+                                                 stddev, k))(stacked, dkeys)
+                return pt.tree_weighted_mean(defended, weights)
 
         super().__init__(dataset, module, task, config,
                          delete_client=delete_client,

@@ -34,11 +34,18 @@ def add_algo_args(parser: argparse.ArgumentParser):
     # fednova
     parser.add_argument("--gmf", type=float, default=0.0)
     parser.add_argument("--prox_mu", type=float, default=0.0)
-    # robust (main_fedavg_robust.py:56-63)
+    # robust (main_fedavg_robust.py:56-63; median/trimmed_mean/krum are
+    # Byzantine-robust aggregation rules beyond the reference pair)
+    from fedml_tpu.core.robust import ROBUST_AGGREGATORS
     parser.add_argument("--defense_type", type=str,
-                        default="norm_diff_clipping")
+                        default="norm_diff_clipping",
+                        choices=["norm_diff_clipping", "weak_dp", "none",
+                                 *sorted(ROBUST_AGGREGATORS)])
     parser.add_argument("--norm_bound", type=float, default=5.0)
     parser.add_argument("--stddev", type=float, default=0.025)
+    parser.add_argument("--trim_ratio", type=float, default=0.1)
+    parser.add_argument("--num_byzantine", type=int, default=1)
+    parser.add_argument("--multi_m", type=int, default=1)
     # hierarchical (group_num = edge servers)
     parser.add_argument("--group_num", type=int, default=2)
     parser.add_argument("--group_comm_round", type=int, default=2)
@@ -107,7 +114,11 @@ def run_algo(args):
                               config=FedAvgRobustConfig(
                                   defense_type=args.defense_type,
                                   norm_bound=args.norm_bound,
-                                  stddev=args.stddev, **common))
+                                  stddev=args.stddev,
+                                  trim_ratio=args.trim_ratio,
+                                  num_byzantine=args.num_byzantine,
+                                  multi_m=args.multi_m,
+                                  **common))
     elif args.algo == "hierarchical":
         from fedml_tpu.algorithms.hierarchical import (HierarchicalConfig,
                                                        HierarchicalFedAvgAPI)

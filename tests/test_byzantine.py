@@ -1,0 +1,102 @@
+"""Byzantine-robust aggregation rules: median, trimmed mean, (multi-)Krum."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.robust import (coordinate_median, krum, krum_scores,
+                                   trimmed_mean)
+
+
+def _stacked_with_outlier(c=7, scale=100.0, seed=0):
+    """c-1 honest updates near a common point + 1 wild outlier at index 0."""
+    rng = np.random.RandomState(seed)
+    base = {"w": rng.randn(4, 3).astype(np.float32),
+            "b": rng.randn(3).astype(np.float32)}
+    honest = [jax.tree.map(
+        lambda a, i=i: a + 0.01 * rng.randn(*a.shape).astype(np.float32),
+        base) for i in range(c - 1)]
+    attacker = jax.tree.map(lambda a: a + scale, base)
+    trees = [attacker] + honest
+    return base, jax.tree.map(lambda *ls: jnp.stack(ls), *trees)
+
+
+class TestMedianTrimmed:
+    def test_median_ignores_outlier(self):
+        base, stacked = _stacked_with_outlier()
+        agg = coordinate_median(stacked)
+        assert float(jnp.max(jnp.abs(agg["w"] - base["w"]))) < 0.1
+        # plain mean would be dragged ~100/7 away
+        mean = jax.tree.map(lambda l: jnp.mean(l, axis=0), stacked)
+        assert float(jnp.max(jnp.abs(mean["w"] - base["w"]))) > 5.0
+
+    def test_trimmed_mean_ignores_outlier(self):
+        base, stacked = _stacked_with_outlier()
+        agg = trimmed_mean(stacked, trim_ratio=0.2)
+        assert float(jnp.max(jnp.abs(agg["w"] - base["w"]))) < 0.1
+
+    def test_trimmed_mean_zero_trim_is_mean(self):
+        _, stacked = _stacked_with_outlier(scale=1.0)
+        agg = trimmed_mean(stacked, trim_ratio=0.0)
+        mean = jax.tree.map(lambda l: jnp.mean(l, axis=0), stacked)
+        for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(mean)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+
+    def test_trimmed_mean_overtrim_rejected(self):
+        _, stacked = _stacked_with_outlier(c=4)
+        with pytest.raises(ValueError, match="trim_ratio"):
+            trimmed_mean(stacked, trim_ratio=0.5)
+
+
+class TestKrum:
+    def test_attacker_gets_worst_score(self):
+        _, stacked = _stacked_with_outlier(c=7)
+        scores = krum_scores(stacked, num_byzantine=1)
+        assert int(jnp.argmax(scores)) == 0  # index 0 is the attacker
+
+    def test_krum_selects_honest_update(self):
+        base, stacked = _stacked_with_outlier(c=7)
+        agg = krum(stacked, num_byzantine=1)
+        assert float(jnp.max(jnp.abs(agg["w"] - base["w"]))) < 0.1
+
+    def test_multi_krum_averages_m(self):
+        base, stacked = _stacked_with_outlier(c=9)
+        agg = krum(stacked, num_byzantine=1, multi_m=3)
+        assert float(jnp.max(jnp.abs(agg["w"] - base["w"]))) < 0.1
+
+    def test_cardinality_guard(self):
+        _, stacked = _stacked_with_outlier(c=4)
+        with pytest.raises(ValueError, match="2f"):
+            krum(stacked, num_byzantine=1)
+
+
+class TestRobustFedAvgEndToEnd:
+    @pytest.mark.parametrize("defense", ["median", "trimmed_mean", "krum"])
+    def test_backdoored_client_neutralized(self, defense):
+        """A label-flipping client with a huge update cannot poison the
+        global model under the robust rules."""
+        from fedml_tpu.algorithms.fedavg_robust import (FedAvgRobustAPI,
+                                                        FedAvgRobustConfig,
+                                                        poison_client_labelflip)
+        from fedml_tpu.data.synthetic import make_blob_federated
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        ds = make_blob_federated(client_num=7, dim=8, class_num=3,
+                                 n_samples=350, seed=2,
+                                 partition_method="homo")
+        ds = poison_client_labelflip(ds, client_idx=0, target_label=0,
+                                     trigger_value=50.0)
+        api = FedAvgRobustAPI(
+            ds, LogisticRegression(num_classes=3),
+            config=FedAvgRobustConfig(
+                comm_round=6, client_num_per_round=7,
+                frequency_of_the_test=10 ** 9, defense_type=defense,
+                trim_ratio=0.15, num_byzantine=1,
+                train=TrainConfig(epochs=1, batch_size=10, lr=0.3)))
+        for r in range(6):
+            api.run_round(r)
+        rec = api.evaluate(5)
+        assert rec["test_acc"] > 0.75, (defense, rec)
