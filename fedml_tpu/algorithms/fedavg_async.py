@@ -93,7 +93,8 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             return  # stale straggler reply from a closed round: discard
         worker = msg.get_sender_id() - 1
         self.aggregator.add_local_trained_result(
-            worker, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+            worker, self._decode_model_payload(
+                msg.get(MSG_ARG_KEY_MODEL_PARAMS)),
             msg.get(MSG_ARG_KEY_NUM_SAMPLES))
         if self.aggregator.check_whether_all_receive():
             self._close_round()
@@ -159,6 +160,23 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         staleness = max(0, self.version - client_version)
         a = self.staleness_weight(staleness)
         w_client = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        from fedml_tpu.comm.compression import is_compressed
+        if is_compressed(w_client):
+            # misconfiguration (client compress=True with an async server):
+            # raising here would only kill this receive loop and hang every
+            # client — fail fast and LOUD by tearing the federation down
+            import logging
+            self.config_error = ValueError(
+                "FedAsync cannot use int8 delta compression: the global "
+                "model moves every update, so the client's base model is "
+                "already stale at decompression time — run clients with "
+                "compress=False")
+            logging.error("%s", self.config_error)
+            for worker in range(1, self.size):
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
+            self.finish()
+            return
         self.global_model = pt.tree_axpy(
             a, w_client, pt.tree_scale(self.global_model, 1.0 - a))
         self.version += 1

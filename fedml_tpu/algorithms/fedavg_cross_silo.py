@@ -143,10 +143,19 @@ class FedAvgServerManager(ServerManager):
             MSG_TYPE_C2S_SEND_MODEL,
             self.handle_message_receive_model_from_client)
 
+    def _decode_model_payload(self, payload):
+        """Int8 delta replies are rebuilt against the round's broadcast
+        model (comm/compression.py); full-precision replies pass through."""
+        from fedml_tpu.comm.compression import decompress_delta, is_compressed
+        if not is_compressed(payload):
+            return payload
+        return decompress_delta(payload, self.global_model)
+
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         worker = msg.get_sender_id() - 1
         self.aggregator.add_local_trained_result(
-            worker, msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+            worker, self._decode_model_payload(
+                msg.get(MSG_ARG_KEY_MODEL_PARAMS)),
             msg.get(MSG_ARG_KEY_NUM_SAMPLES))
         if not self.aggregator.check_whether_all_receive():
             return
@@ -178,13 +187,15 @@ class FedAvgClientManager(ClientManager):
 
     def __init__(self, rank: int, size: int, com_manager,
                  dataset: FederatedDataset, module, task: str,
-                 train_cfg: TrainConfig, seed: int = 0):
+                 train_cfg: TrainConfig, seed: int = 0,
+                 compress: bool = False):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
         self._local_train = jax.jit(make_local_train(module, task, train_cfg))
         self._n_pad = dataset.padded_len(train_cfg.batch_size)
         self._bsz = train_cfg.batch_size
         self._base_key = jax.random.key(seed)
+        self.compress = compress
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -207,7 +218,14 @@ class FedAvgClientManager(ClientManager):
             jnp.asarray(mask[0]), key)
         n_i = float(self.dataset.train_data_local_num_dict[int(client_idx)])
         reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
-        reply.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))
+        if self.compress:
+            from fedml_tpu.comm.compression import compress_delta
+            ckey = jax.random.fold_in(jax.random.fold_in(
+                jax.random.key(977), round_idx), self.rank)
+            reply.add(MSG_ARG_KEY_MODEL_PARAMS,
+                      compress_delta(new_vars, variables, ckey))
+        else:
+            reply.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))
         reply.add(MSG_ARG_KEY_NUM_SAMPLES, n_i)
         # round/version tag: lets straggler-tolerant servers detect stale
         # replies (fedavg_async.py) — the plain server ignores it
@@ -220,7 +238,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           worker_num: int = 2, comm_round: int = 2,
                           train_cfg: Optional[TrainConfig] = None,
                           backend: str = "INPROC",
-                          addresses=None, wire_codec: bool = True):
+                          addresses=None, wire_codec: bool = True,
+                          compress: bool = False):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -264,7 +283,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         com = create_comm_manager(backend, rank, size, router=router,
                                   addresses=addresses, wire_codec=wire_codec)
         clients.append(FedAvgClientManager(rank, size, com, dataset, module,
-                                           task, train_cfg))
+                                           task, train_cfg,
+                                           compress=compress))
 
     threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
     server_thread = threading.Thread(target=server.run, daemon=True)
