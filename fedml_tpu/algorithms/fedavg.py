@@ -127,6 +127,11 @@ class FedAvgAPI:
         self.variables = module.init(jax.random.key(self.config.seed),
                                      jnp.asarray(sample_x), train=False)
         self.history: List[Dict] = []
+        # packed-cohort cache: when a round samples the same client set
+        # (e.g. full participation), skip host packing and re-upload — the
+        # device-side analogue of the reference's update_dataset re-pointing
+        # (FedAVGTrainer.py:25-30)
+        self._pack_cache = None
 
     # -- one round ---------------------------------------------------------
     def _prepare_round(self, round_idx: int):
@@ -136,15 +141,28 @@ class FedAvgAPI:
         idxs = sample_clients(round_idx, self.dataset.client_num,
                               cfg.client_num_per_round,
                               delete_client=self.delete_client)
-        x, y, mask = self.dataset.pack_clients(idxs, cfg.train.batch_size,
-                                               n_pad=self._n_pad)
-        weights = self.dataset.client_weights(idxs)
+        # key includes the dataset identity (mid-run swaps, e.g. escalating
+        # a poisoning attack, must invalidate); cache only under full
+        # participation — partial cohorts are seeded per round and would
+        # just pin dead device buffers without ever hitting
+        cohort = (id(self.dataset),) + tuple(int(i) for i in idxs)
+        if self._pack_cache is not None and self._pack_cache[0] == cohort:
+            xd, yd, maskd, wd = self._pack_cache[1]
+        else:
+            self._pack_cache = None  # free the old buffers before packing
+            x, y, mask = self.dataset.pack_clients(idxs,
+                                                   cfg.train.batch_size,
+                                                   n_pad=self._n_pad)
+            weights = self.dataset.client_weights(idxs)
+            xd, yd, maskd, wd = (jnp.asarray(x), jnp.asarray(y),
+                                 jnp.asarray(mask), jnp.asarray(weights))
+            if len(idxs) == self.dataset.client_num:
+                self._pack_cache = (cohort, (xd, yd, maskd, wd))
         round_key = jax.random.fold_in(self._base_key, round_idx)
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
             jnp.asarray(np.asarray(idxs), dtype=jnp.uint32))
         agg_key = jax.random.fold_in(round_key, 2**31 - 1)
-        return idxs, (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
-                      keys, jnp.asarray(weights), agg_key)
+        return idxs, (xd, yd, maskd, keys, wd, agg_key)
 
     def run_round(self, round_idx: int):
         idxs, (x, y, mask, keys, weights, agg_key) = self._prepare_round(
