@@ -79,6 +79,14 @@ def _seg_shapes(data_dir, **kw):
         client_num=kw.get("client_num_in_total", 4))
 
 
+def _img_blob(data_dir, **kw):
+    from fedml_tpu.data.synthetic import make_image_blob_federated
+    return make_image_blob_federated(
+        client_num=kw.get("client_num_in_total", 4),
+        partition_method=kw.get("partition_method", "homo"),
+        partition_alpha=kw.get("partition_alpha", 0.5))
+
+
 LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "mnist": _mnist,
     "shakespeare": _shakespeare,
@@ -92,6 +100,7 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "synthetic": _synthetic_generated,  # generated in-memory (no files)
     "blob": _blob,                      # test/bench workhorse
     "seg_shapes": _seg_shapes,          # synthetic segmentation (fedseg)
+    "img_blob": _img_blob,              # synthetic NHWC image classification
 }
 
 # reference --dataset name -> (model factory name, task head)
@@ -109,6 +118,7 @@ DEFAULT_MODEL_AND_TASK = {
     "synthetic": ("lr", "classification"),
     "blob": ("lr", "classification"),
     "seg_shapes": ("segnet", "segmentation"),
+    "img_blob": ("resnet56", "classification"),
 }
 
 

@@ -42,6 +42,9 @@ def add_federated_args(parser: argparse.ArgumentParser):
     parser.add_argument("--use_wandb", action="store_true")
     parser.add_argument("--checkpoint_dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--compress", action="store_true",
+                        help="int8 delta compression for client->server "
+                             "model updates (cross-silo backends)")
     parser.add_argument("--ci", type=int, default=0,
                         help="1 = tiny smoke-run truncation (reference --ci)")
     return parser

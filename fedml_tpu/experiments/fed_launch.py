@@ -184,6 +184,17 @@ def run_algo(args):
                                      epochs=args.epochs,
                                      batch_size=args.batch_size, lr=args.lr,
                                      arch_lr=args.arch_lr, seed=args.seed))
+        # FedNASAPI has no train() wrapper: drive the search rounds here
+        for r in range(args.comm_round):
+            rec = api.run_round(r)
+            sink.log({k: v for k, v in rec.items() if k != "genotype"},
+                     step=r)
+            logging.info("round %d: search_loss=%.4f", r, rec["search_loss"])
+        final = {**api.evaluate(), "genotype": str(api.history[-1]["genotype"])}
+        sink.log(final)
+        sink.finish()
+        logging.info("final: %s", final)
+        return final
     elif args.algo == "centralized":
         from fedml_tpu.algorithms.centralized import CentralizedTrainer
         trainer = CentralizedTrainer(ds, model, task=task, cfg=tcfg,

@@ -84,7 +84,8 @@ def run_cross_silo(args, ds, model, task, sink):
     _, history = run_fedavg_cross_silo(
         ds, model, task=task, worker_num=args.client_num_per_round,
         comm_round=args.comm_round, train_cfg=make_train_config(args),
-        backend=args.backend, addresses=addresses)
+        backend=args.backend, addresses=addresses,
+        compress=getattr(args, "compress", False))
     for rec in history:
         sink.log(rec, step=rec["round"])
     return history[-1] if history else {}

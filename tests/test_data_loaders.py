@@ -250,3 +250,42 @@ class TestRegistry:
         check_contract(ds)
         with pytest.raises(ValueError, match="unknown dataset"):
             load_data("imagenet22k")
+
+
+class TestSyntheticImageBlob:
+    def test_img_blob_registry_contract(self):
+        from fedml_tpu.data.registry import load_data
+
+        ds = load_data("img_blob", client_num_in_total=3)
+        x, y = ds.train_data_global
+        assert x.ndim == 4 and x.shape[-1] == 3  # NHWC
+        assert ds.client_num == 3
+        assert ds.class_num == 4
+
+    def test_img_blob_learnable_by_cnn_head(self):
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+        from fedml_tpu.data.synthetic import make_image_blob_federated
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        ds = make_image_blob_federated(client_num=3, samples_per_client=40,
+                                       image_size=16, class_num=3)
+        # flatten-image LR is enough for the color-pattern classes
+        import flax.linen as nn
+
+        class FlatLR(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(3)(x.reshape((x.shape[0], -1)))
+
+        api = FedAvgAPI(ds, FlatLR(), config=FedAvgConfig(
+            comm_round=6, client_num_per_round=3,
+            frequency_of_the_test=10 ** 9,
+            train=TrainConfig(epochs=1, batch_size=8, lr=0.1)))
+        for r in range(6):
+            api.run_round(r)
+        rec = api.evaluate(5)
+        assert rec["test_acc"] > 0.8, rec
