@@ -141,13 +141,16 @@ class FedAvgAPI:
         idxs = sample_clients(round_idx, self.dataset.client_num,
                               cfg.client_num_per_round,
                               delete_client=self.delete_client)
-        # key includes the dataset identity (mid-run swaps, e.g. escalating
-        # a poisoning attack, must invalidate); cache only under full
-        # participation — partial cohorts are seeded per round and would
-        # just pin dead device buffers without ever hitting
-        cohort = (id(self.dataset),) + tuple(int(i) for i in idxs)
-        if self._pack_cache is not None and self._pack_cache[0] == cohort:
-            xd, yd, maskd, wd = self._pack_cache[1]
+        # key holds a strong reference to the dataset object (mid-run swaps,
+        # e.g. escalating a poisoning attack, must invalidate — and holding
+        # the reference prevents CPython id-reuse false hits); cache only
+        # under full participation — partial cohorts are seeded per round
+        # and would just pin dead device buffers without ever hitting
+        cohort = tuple(int(i) for i in idxs)
+        if (self._pack_cache is not None
+                and self._pack_cache[0] is self.dataset
+                and self._pack_cache[1] == cohort):
+            xd, yd, maskd, wd = self._pack_cache[2]
         else:
             self._pack_cache = None  # free the old buffers before packing
             x, y, mask = self.dataset.pack_clients(idxs,
@@ -157,7 +160,8 @@ class FedAvgAPI:
             xd, yd, maskd, wd = (jnp.asarray(x), jnp.asarray(y),
                                  jnp.asarray(mask), jnp.asarray(weights))
             if len(idxs) == self.dataset.client_num:
-                self._pack_cache = (cohort, (xd, yd, maskd, wd))
+                self._pack_cache = (self.dataset, cohort,
+                                    (xd, yd, maskd, wd))
         round_key = jax.random.fold_in(self._base_key, round_idx)
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
             jnp.asarray(np.asarray(idxs), dtype=jnp.uint32))

@@ -149,6 +149,9 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         self.max_updates = max_updates
         self.version = 0
         self.update_log: List[Dict] = []
+        # probed by launchers after a run; only the compressed-payload
+        # error path ever assigns it
+        self.config_error = None
 
     def staleness_weight(self, staleness: int) -> float:
         return self.alpha * float(staleness + 1) ** (-self.poly_a)

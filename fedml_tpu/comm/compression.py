@@ -42,6 +42,23 @@ def _tree_size(tree) -> int:
                for l in jax.tree.leaves(tree))
 
 
+def _tree_fingerprint(tree) -> str:
+    """Cheap structure hash over the leaf (path, shape, dtype) list.
+
+    A total-parameter-count check alone admits any version skew that
+    preserves the count (transposed layer, swapped widths) and silently
+    corrupts the rebuilt aggregate; the fingerprint rejects it."""
+    import hashlib
+
+    parts = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        # metadata only — leaf.dtype avoids a device->host copy of the tree
+        dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+        parts.append(f"{jax.tree_util.keystr(path)}:"
+                     f"{tuple(leaf.shape)}:{np.dtype(dtype).name}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
 def compress_delta(new_tree, base_tree, key,
                    interpret: Optional[bool] = None) -> Dict[str, Any]:
     """int8-quantize (new - base); returns a codec-friendly payload dict
@@ -52,7 +69,8 @@ def compress_delta(new_tree, base_tree, key,
                                         interpret=_resolve_interpret(
                                             interpret))
     return {COMPRESSED_FLAG: True, "q": np.asarray(vals),
-            "s": np.asarray(scales), "d": _tree_size(delta)}
+            "s": np.asarray(scales), "d": _tree_size(delta),
+            "fp": _tree_fingerprint(base_tree)}
 
 
 def decompress_delta(payload: Dict[str, Any], base_tree,
@@ -66,6 +84,15 @@ def decompress_delta(payload: Dict[str, Any], base_tree,
             f"compressed delta carries {payload['d']} parameters but the "
             f"receiver's model has {expected} — model-version skew or a "
             "malformed payload; refusing to rebuild")
+    # count can survive version skew (transposed layer, swapped widths);
+    # the structure fingerprint cannot
+    if "fp" in payload:
+        fp = _tree_fingerprint(base_tree)
+        if payload["fp"] != fp:
+            raise ValueError(
+                f"compressed delta structure fingerprint {payload['fp']} "
+                f"does not match the receiver's model ({fp}) — the sender "
+                "trained a differently-shaped tree; refusing to rebuild")
     leaves, treedef = jax.tree.flatten(base_tree)
     spec = (treedef, [(l.shape, np.asarray(l).dtype.name) for l in leaves],
             expected)

@@ -10,8 +10,14 @@ backend, so managers and algorithm protocols are transport-agnostic.
 
 Wire protocol (little-endian), mirroring the router:
   HELLO:           u32 magic 'FMLR'  u32 rank
+  HELLO+AUTH:      u32 magic 'FMLS'  u32 rank  u32 token_len  token
   DATA (send):     u32 dest_rank     u64 len   payload
   DATA (receive):  u32 src_rank      u64 len   payload
+
+A shared-secret ``token`` authenticates the rank claim against a router
+started with the same token; without it any reachable host could register as
+any rank. Payloads are still cleartext — run the broker behind TLS
+termination or on a trusted network (see native/router.cpp).
 """
 
 from __future__ import annotations
@@ -27,7 +33,9 @@ from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.tcp import _recv_exact
 
 _MAGIC = 0x464D4C52  # 'FMLR'
+_MAGIC_AUTH = 0x464D4C53  # 'FMLS'
 _HELLO = struct.Struct("<II")
+_HELLO_AUTH = struct.Struct("<III")
 _HDR = struct.Struct("<IQ")
 _STOP = object()
 
@@ -36,14 +44,19 @@ class RoutedCommManager(BaseCommunicationManager):
     """One rank's connection to the message router."""
 
     def __init__(self, rank: int, router_address: Tuple[str, int],
-                 connect_timeout: float = 30.0):
+                 connect_timeout: float = 30.0,
+                 token: Optional[bytes] = None):
         super().__init__()
         self.rank = rank
         self._sock = socket.create_connection(router_address,
                                               timeout=connect_timeout)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.sendall(_HELLO.pack(_MAGIC, rank))
+        if token:
+            self._sock.sendall(
+                _HELLO_AUTH.pack(_MAGIC_AUTH, rank, len(token)) + token)
+        else:
+            self._sock.sendall(_HELLO.pack(_MAGIC, rank))
         self._send_lock = threading.Lock()
         self._inbox: "queue.Queue" = queue.Queue()
         self._running = False

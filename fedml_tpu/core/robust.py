@@ -119,13 +119,19 @@ def coordinate_median(stacked):
 
 def trimmed_mean(stacked, trim_ratio: float = 0.1):
     """Coordinate-wise beta-trimmed mean: drop the beta*C smallest and
-    largest values per coordinate, average the rest (Yin et al., 2018)."""
+    largest values per coordinate, average the rest (Yin et al., 2018).
+
+    With a positive ``trim_ratio`` at least one value is trimmed from each
+    end even when ``trim_ratio * C < 1`` — a silent fall-through to a plain
+    mean would give a caller who selected a robust rule zero Byzantine
+    protection (e.g. the default 0.1 with fewer than 10 clients)."""
     def tm(leaf):
         c = leaf.shape[0]
-        t = int(trim_ratio * c)
+        t = max(1, int(trim_ratio * c)) if trim_ratio > 0 else 0
         if 2 * t >= c:
             raise ValueError(
-                f"trim_ratio {trim_ratio} removes all {c} clients")
+                f"trim_ratio {trim_ratio} with {c} clients would trim "
+                f"{2 * t} >= {c} values — need more clients or less trim")
         s = jnp.sort(leaf, axis=0)
         return jnp.mean(s[t:c - t] if t else s, axis=0)
 

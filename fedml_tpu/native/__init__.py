@@ -69,6 +69,7 @@ def load_lib() -> ctypes.CDLL:
     lib = ctypes.CDLL(str(path))
     lib.fedml_router_start.restype = ctypes.c_void_p
     lib.fedml_router_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_char_p,
                                        ctypes.POINTER(ctypes.c_int)]
     lib.fedml_router_stop.argtypes = [ctypes.c_void_p]
     lib.fedml_router_port.restype = ctypes.c_int
@@ -91,10 +92,15 @@ class NativeRouter:
     single-host simulation it lives in-process.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[bytes] = None):
+        """``token``: shared secret every silo must present in its HELLO.
+        None/empty = open router (trusted-network / test deployments only —
+        see the security note in native/router.cpp)."""
         lib = load_lib()
         out_port = ctypes.c_int(-1)
         self._handle = lib.fedml_router_start(host.encode(), port,
+                                              token or b"",
                                               ctypes.byref(out_port))
         if not self._handle:
             raise NativeUnavailable(

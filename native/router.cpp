@@ -12,8 +12,19 @@
 //
 // Wire protocol (all integers little-endian):
 //   HELLO  (client -> router, once):  u32 magic 'FMLR'  u32 rank
+//   HELLO+AUTH (when a shared secret is configured):
+//                                     u32 magic 'FMLS'  u32 rank
+//                                     u32 token_len     token bytes
 //   DATA   (client -> router):        u32 dest_rank     u64 len   payload
 //   DATA   (router -> client):        u32 src_rank      u64 len   payload
+//
+// Security: a router started with a non-empty token rejects any HELLO that
+// does not carry the matching token (constant-time compare), closing the
+// hole where any host that can reach the port could claim an arbitrary rank
+// (including rank 0) and receive the broadcast model or inject updates.
+// The token authenticates rank claims only — payloads still cross the wire
+// in cleartext, so production deployments must run the broker behind TLS
+// termination (stunnel/envoy/nginx stream proxy) or on a trusted network.
 //
 // Frames to a rank that has not connected yet are buffered (bounded by
 // kMaxPendingBytes per rank) and flushed on its HELLO — so the federation
@@ -45,9 +56,25 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x464d4c52;  // 'FMLR'
+constexpr uint32_t kMagic = 0x464d4c52;      // 'FMLR' (legacy, token-less)
+constexpr uint32_t kMagicAuth = 0x464d4c53;  // 'FMLS' (token follows)
 constexpr size_t kMaxPendingBytes = 1ull << 30;  // 1 GiB buffered per absent rank
 constexpr size_t kMaxFrameBytes = 4ull << 30;    // 4 GiB per frame
+constexpr uint32_t kMaxTokenLen = 4096;
+
+// Constant-time equality: timing must leak neither matching prefix length
+// nor the configured token's length, so iterate over the attacker-supplied
+// buffer (whose length the peer already knows), folding the secret in
+// cyclically.
+bool token_eq(const std::string& a, const char* b, size_t blen) {
+  unsigned diff = static_cast<unsigned>(a.size() ^ blen);
+  if (a.empty()) return blen == 0;
+  for (size_t i = 0; i < blen; ++i) {
+    diff |= static_cast<unsigned char>(a[i % a.size()]) ^
+            static_cast<unsigned char>(b[i]);
+  }
+  return diff == 0;
+}
 
 bool read_exact(int fd, void* buf, size_t n) {
   auto* p = static_cast<char*>(buf);
@@ -92,6 +119,9 @@ struct Client {
 class Router {
  public:
   Router() = default;
+
+  // Require this shared secret in every HELLO (call before Start).
+  void SetToken(const char* token) { token_ = token ? token : ""; }
 
   // Returns the bound port (useful with port=0), or -1 on failure.
   int Start(const char* host, int port) {
@@ -177,8 +207,29 @@ class Router {
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_timeout,
                  sizeof(hello_timeout));
     uint32_t magic = 0, rank = 0;
-    if (!read_exact(fd, &magic, 4) || magic != kMagic ||
+    if (!read_exact(fd, &magic, 4) ||
+        (magic != kMagic && magic != kMagicAuth) ||
         !read_exact(fd, &rank, 4)) {
+      ::close(fd);
+      return;
+    }
+    if (magic == kMagicAuth) {
+      uint32_t tlen = 0;
+      if (!read_exact(fd, &tlen, 4) || tlen > kMaxTokenLen) {
+        ::close(fd);
+        return;
+      }
+      std::vector<char> tok(tlen);
+      if (tlen > 0 && !read_exact(fd, tok.data(), tlen)) {
+        ::close(fd);
+        return;
+      }
+      if (!token_eq(token_, tok.data(), tok.size())) {
+        ::close(fd);
+        return;
+      }
+    } else if (!token_.empty()) {
+      // token required but the peer sent a legacy HELLO: reject
       ::close(fd);
       return;
     }
@@ -307,6 +358,7 @@ class Router {
     std::deque<Frame> frames;
   };
 
+  std::string token_;  // empty = open (legacy HELLO accepted)
   int listen_fd_ = -1;
   int port_ = -1;
   std::atomic<bool> running_{false};
@@ -325,8 +377,12 @@ class Router {
 
 extern "C" {
 
-void* fedml_router_start(const char* host, int port, int* out_port) {
+// token may be null or empty for an open (unauthenticated) router; a
+// non-empty token makes every HELLO carry-and-match it ('FMLS' form).
+void* fedml_router_start(const char* host, int port, const char* token,
+                         int* out_port) {
   auto* r = new Router();
+  r->SetToken(token);
   int bound = r->Start(host, port);
   if (bound < 0) {
     delete r;

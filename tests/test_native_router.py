@@ -90,6 +90,43 @@ class TestRouterCore:
             assert dup.recv(1) == b""
             a.close(), dup.close()
 
+    def test_auth_token_gates_registration(self):
+        _AUTH = struct.Struct("<III")
+        with NativeRouter(token=b"sekrit") as r:
+            # correct token: full route works (RoutedCommManager wire form)
+            a = socket.create_connection(("127.0.0.1", r.port), timeout=10)
+            a.sendall(_AUTH.pack(0x464D4C53, 3, 6) + b"sekrit")
+            _send(a, 3, b"ok")
+            assert _recv(a) == (3, b"ok")
+            # wrong token: closed before registration
+            bad = socket.create_connection(("127.0.0.1", r.port), timeout=10)
+            bad.sendall(_AUTH.pack(0x464D4C53, 4, 5) + b"wrong")
+            bad.settimeout(10)
+            assert bad.recv(1) == b""
+            # legacy token-less HELLO: also rejected when a token is set
+            legacy = socket.create_connection(("127.0.0.1", r.port),
+                                              timeout=10)
+            legacy.sendall(_HELLO.pack(_MAGIC, 5))
+            legacy.settimeout(10)
+            assert legacy.recv(1) == b""
+            a.close(), bad.close(), legacy.close()
+
+    def test_auth_token_routed_backend(self):
+        from fedml_tpu.comm.routed import RoutedCommManager
+
+        with NativeRouter(token=b"tok") as r:
+            m = RoutedCommManager(2, ("127.0.0.1", r.port), token=b"tok")
+            try:
+                from fedml_tpu.comm.message import Message
+                m.send_message(Message(1, sender_id=2, receiver_id=2))
+                # self-addressed frame comes back -> HELLO was accepted
+                src, length = _HDR.unpack(
+                    m._sock.recv(_HDR.size, socket.MSG_WAITALL))
+                assert src == 2
+                m._sock.recv(length, socket.MSG_WAITALL)
+            finally:
+                m._sock.close()
+
     def test_large_frame(self):
         with NativeRouter() as r:
             a, b = _dial(r.port, 0), _dial(r.port, 1)
