@@ -239,7 +239,7 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           train_cfg: Optional[TrainConfig] = None,
                           backend: str = "INPROC",
                           addresses=None, wire_codec: bool = True,
-                          compress: bool = False):
+                          compress: bool = False, token=None):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -274,14 +274,15 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     aggregator = FedAvgAggregator(worker_num)
     server_com = create_comm_manager(backend, 0, size, router=router,
                                      addresses=addresses,
-                                     wire_codec=wire_codec)
+                                     wire_codec=wire_codec, token=token)
     server = FedAvgServerManager(0, size, server_com, aggregator, comm_round,
                                  dataset.client_num, global_model,
                                  on_round_done=on_round_done)
     clients = []
     for rank in range(1, size):
         com = create_comm_manager(backend, rank, size, router=router,
-                                  addresses=addresses, wire_codec=wire_codec)
+                                  addresses=addresses, wire_codec=wire_codec,
+                                  token=token)
         clients.append(FedAvgClientManager(rank, size, com, dataset, module,
                                            task, train_cfg,
                                            compress=compress))

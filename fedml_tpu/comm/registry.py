@@ -13,7 +13,8 @@ def create_comm_manager(
         backend: str, rank: int, size: int,
         router: Optional[InProcRouter] = None,
         addresses: Optional[Dict[int, Tuple[str, int]]] = None,
-        wire_codec: bool = False) -> BaseCommunicationManager:
+        wire_codec: bool = False,
+        token: Optional[bytes] = None) -> BaseCommunicationManager:
     """``backend``: "INPROC" (simulation/tests), "TCP" (framed sockets,
     cross-host), "GRPC" (cross-silo RPC), "ROUTED" (dial-out frames through
     the native C++ broker, native/router.cpp — the NAT-friendly star
@@ -25,7 +26,7 @@ def create_comm_manager(
             raise ValueError(
                 'ROUTED backend needs addresses={"router": (host, port)}')
         from fedml_tpu.comm.routed import RoutedCommManager
-        return RoutedCommManager(rank, addresses["router"])
+        return RoutedCommManager(rank, addresses["router"], token=token)
     if key in ("INPROC", "MPI"):
         if router is None:
             raise ValueError("INPROC backend needs a shared InProcRouter")

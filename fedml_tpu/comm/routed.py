@@ -57,6 +57,24 @@ class RoutedCommManager(BaseCommunicationManager):
                 _HELLO_AUTH.pack(_MAGIC_AUTH, rank, len(token)) + token)
         else:
             self._sock.sendall(_HELLO.pack(_MAGIC, rank))
+        # Registration handshake: the router sends nothing on success, so a
+        # rejected HELLO (token mismatch, duplicate rank) would otherwise
+        # only surface later as a generic "connection lost" mid-round. A
+        # self-addressed empty frame echoes back iff we were registered.
+        try:
+            self._sock.sendall(_HDR.pack(rank, 0))
+            src, length = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
+            if src != rank or length != 0:
+                raise ConnectionError(
+                    f"rank {rank}: unexpected first frame from router "
+                    f"(src={src}, len={length})")
+        except (ConnectionError, OSError) as exc:
+            self._sock.close()
+            raise ConnectionError(
+                f"rank {rank}: router at {router_address} closed the "
+                "connection during registration — auth token mismatch "
+                "(client and router must both set the same token, or "
+                "neither) or this rank is already connected") from exc
         self._send_lock = threading.Lock()
         self._inbox: "queue.Queue" = queue.Queue()
         self._running = False

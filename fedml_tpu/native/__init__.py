@@ -68,8 +68,9 @@ def load_lib() -> ctypes.CDLL:
     path = build_lib()
     lib = ctypes.CDLL(str(path))
     lib.fedml_router_start.restype = ctypes.c_void_p
+    # token is (pointer, length) so binary secrets with NUL bytes survive
     lib.fedml_router_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                       ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_int,
                                        ctypes.POINTER(ctypes.c_int)]
     lib.fedml_router_stop.argtypes = [ctypes.c_void_p]
     lib.fedml_router_port.restype = ctypes.c_int
@@ -99,8 +100,9 @@ class NativeRouter:
         see the security note in native/router.cpp)."""
         lib = load_lib()
         out_port = ctypes.c_int(-1)
+        tok = bytes(token) if token else b""
         self._handle = lib.fedml_router_start(host.encode(), port,
-                                              token or b"",
+                                              tok, len(tok),
                                               ctypes.byref(out_port))
         if not self._handle:
             raise NativeUnavailable(

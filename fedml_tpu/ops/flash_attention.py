@@ -12,14 +12,20 @@ runs on a (B*H) x q-block x k-block grid. The k-block axis is the
 innermost, sequential grid dimension on TPU, so the scratch accumulators
 carry across k steps and the output block is finalized at the last k step.
 
-Backward: a ``jax.custom_vjp`` whose residuals are (q, k, v, out, lse);
-gradients are computed blockwise with ``lax.scan`` over k blocks (standard
-FlashAttention-2 recurrence — dS = P * (dP - rowsum(dO * O))). Each scan
-step materializes [B, H, S, block_k] score/probability tensors, so
-backward memory is O(S x block_k) — never the full [S, S] matrix, but a
-weaker bound than the forward kernel's O(block_q x block_k) VMEM tiles; a
-hand-written backward kernel can close that gap later if long-context
-training (rather than inference) becomes the bottleneck.
+Backward: a ``jax.custom_vjp`` whose residuals are (q, k, v, out, lse),
+computed by two Pallas kernels with the FlashAttention-2 recurrence
+(dS = P * (dP - rowsum(dO * O)), P recomputed from the saved lse — the
+[S, S] score matrix is never materialized):
+
+- dK/dV kernel: grid (B*H, k-block, q-block), q innermost sequential, so
+  the [block_k, D] accumulators live in VMEM scratch across the q sweep;
+- dQ kernel: grid (B*H, q-block, k-block), k innermost, [block_q, D]
+  accumulator in scratch.
+
+Both match the forward's O(block_q x block_k) VMEM bound, so long-context
+*training* keeps the memory win; the extra recompute of S is the standard
+FA-2 trade (one more QK^T matmul on the MXU instead of an HBM-resident
+probability tensor). Causal runs skip fully-masked blocks on both grids.
 
 ``flash_attention(..., interpret=True)`` runs the identical kernel through
 the Pallas interpreter for CPU tests; ``make_flash_attention`` returns an
@@ -147,43 +153,142 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _bwd_block_grads(q, k, v, do, lse, delta, causal, scale,
+                     qi, kj, block_q, block_k):
+    """Recompute P for one (q-block, k-block) tile and return (p, ds).
+
+    Shared by both backward kernels; all operands are f32 VMEM tiles."""
+    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jnp.exp(s - lse)                             # [bq, bk]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                     scale: float, block_q: int, block_k: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def visible():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_block_grads(
+            q, k_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            do, lse_ref[0], delta_ref[0], causal, scale, qi, kj,
+            block_q, block_k)
+        # P^T dO and dS^T Q: [bq, bk] x [bq, D] contracted over bq -> [bk, D]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # this k block is in every row of the q block's future -> skip
+        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(visible)
+    else:
+        visible()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, causal: bool, scale: float,
+                   block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def visible():
+        k = k_ref[0].astype(jnp.float32)
+        _, ds = _bwd_block_grads(
+            q_ref[0].astype(jnp.float32), k, v_ref[0].astype(jnp.float32),
+            do_ref[0].astype(jnp.float32), lse_ref[0], delta_ref[0],
+            causal, scale, qi, kj, block_q, block_k)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(visible)
+    else:
+        visible()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
 def _flash_bwd(causal, block_q, block_k, interpret, res, do):
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    bk = min(block_k, s)
-    nk = s // bk
+    bq, bk = min(block_q, s), min(block_k, s)
 
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    # D_i = rowsum(dO * O)  [B, H, S]
-    delta = jnp.einsum("bshd,bshd->bhs", dof, out.astype(jnp.float32))
-    qpos = jnp.arange(s)
+    to_bh = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
+    qt, kt, vt, dot_ = to_bh(q), to_bh(k), to_bh(v), to_bh(do)
+    # D_i = rowsum(dO * O): elementwise+reduce, XLA fuses it — no kernel
+    delta = jnp.sum(dot_.astype(jnp.float32) *
+                    to_bh(out).astype(jnp.float32), axis=-1, keepdims=True)
+    lse_t = lse.reshape(b * h, s, 1)
 
-    def kblock(carry, j):
-        dq_acc = carry
-        ks = jax.lax.dynamic_slice_in_dim(k, j * bk, bk, 1).astype(
-            jnp.float32)
-        vs = jax.lax.dynamic_slice_in_dim(v, j * bk, bk, 1).astype(
-            jnp.float32)
-        sblk = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * scale
-        if causal:
-            kpos = j * bk + jnp.arange(bk)
-            sblk = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
-                             sblk, _NEG_INF)
-        p = jnp.exp(sblk - lse[..., None])           # [B,H,S,bk]
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
-        ds = p * (dp - delta[..., None]) * scale
-        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
-        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        return dq_acc, (dk_j, dv_j)
+    row = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+    col = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    row_s = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0))
+    # dk/dv grid: (BH, k-block, q-block) — program ids are (bh, j, i)
+    rowT = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    colT = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    rowT_s = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0))
 
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        kblock, jnp.zeros_like(qf), jnp.arange(nk))
-    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
-    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk),
+        grid=(b * h, s // bk, s // bq),
+        in_specs=[rowT, colT, colT, rowT, rowT_s, rowT_s],
+        out_specs=[colT, colT],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        scratch_shapes=[_vmem_scratch((bk, d)), _vmem_scratch((bk, d))],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse_t, delta)
+
+    dq, = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk),
+        grid=(b * h, s // bq, s // bk),
+        in_specs=[row, col, col, row, row_s, row_s],
+        out_specs=[row],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype)],
+        scratch_shapes=[_vmem_scratch((bq, d))],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse_t, delta)
+
+    from_bh = lambda t: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return from_bh(dq), from_bh(dk), from_bh(dv)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

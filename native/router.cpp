@@ -121,7 +121,10 @@ class Router {
   Router() = default;
 
   // Require this shared secret in every HELLO (call before Start).
-  void SetToken(const char* token) { token_ = token ? token : ""; }
+  // Length-delimited: binary tokens may contain NUL bytes.
+  void SetToken(const char* token, size_t len) {
+    token_.assign(token ? token : "", token ? len : 0);
+  }
 
   // Returns the bound port (useful with port=0), or -1 on failure.
   int Start(const char* host, int port) {
@@ -377,12 +380,13 @@ class Router {
 
 extern "C" {
 
-// token may be null or empty for an open (unauthenticated) router; a
+// token may be null/zero-length for an open (unauthenticated) router; a
 // non-empty token makes every HELLO carry-and-match it ('FMLS' form).
+// token_len is explicit so binary secrets with NUL bytes survive the FFI.
 void* fedml_router_start(const char* host, int port, const char* token,
-                         int* out_port) {
+                         int token_len, int* out_port) {
   auto* r = new Router();
-  r->SetToken(token);
+  r->SetToken(token, token_len > 0 ? static_cast<size_t>(token_len) : 0);
   int bound = r->Start(host, port);
   if (bound < 0) {
     delete r;

@@ -112,20 +112,31 @@ class TestRouterCore:
             a.close(), bad.close(), legacy.close()
 
     def test_auth_token_routed_backend(self):
-        from fedml_tpu.comm.routed import RoutedCommManager
+        from fedml_tpu.comm.registry import create_comm_manager
 
-        with NativeRouter(token=b"tok") as r:
-            m = RoutedCommManager(2, ("127.0.0.1", r.port), token=b"tok")
-            try:
-                from fedml_tpu.comm.message import Message
-                m.send_message(Message(1, sender_id=2, receiver_id=2))
-                # self-addressed frame comes back -> HELLO was accepted
-                src, length = _HDR.unpack(
-                    m._sock.recv(_HDR.size, socket.MSG_WAITALL))
-                assert src == 2
-                m._sock.recv(length, socket.MSG_WAITALL)
-            finally:
-                m._sock.close()
+        # binary token with an embedded NUL: must survive the FFI intact
+        tok = b"\x00bin\x00tok"
+        with NativeRouter(token=tok) as r:
+            # the production path: registry -> RoutedCommManager(token=...);
+            # __init__ performs the registration handshake, so constructing
+            # successfully proves the HELLO was accepted
+            m = create_comm_manager("ROUTED", 2, 2,
+                                    addresses={"router": ("127.0.0.1",
+                                                          r.port)},
+                                    token=tok)
+            m._sock.close()
+            # wrong token surfaces as a clear ConnectionError at
+            # construction, not a generic mid-round connection loss
+            with pytest.raises(ConnectionError, match="token mismatch"):
+                create_comm_manager("ROUTED", 3, 2,
+                                    addresses={"router": ("127.0.0.1",
+                                                          r.port)},
+                                    token=b"\x00bin\x00WRONG")
+            # token-less client against a tokened router: same clear error
+            with pytest.raises(ConnectionError, match="token mismatch"):
+                create_comm_manager("ROUTED", 4, 2,
+                                    addresses={"router": ("127.0.0.1",
+                                                          r.port)})
 
     def test_large_frame(self):
         with NativeRouter() as r:
