@@ -1,0 +1,77 @@
+"""Subprocess worker for the 2-process multihost rendezvous smoke test.
+
+Run as: python multihost_worker.py <coordinator_addr> <num_procs> <proc_id>
+
+Each process presents 4 virtual CPU devices, so the 2-process job forms an
+8-device global mesh — the same shape the reference exercises with
+``mpirun -np N -hostfile`` on localhost (run_fedavg_distributed_pytorch.sh:19-22),
+but through jax.distributed's real rendezvous + DCN collectives instead of
+mpi4py sends. Prints MULTIHOST_OK <psum_result> on success.
+"""
+
+import os
+import sys
+
+# must precede jax import: each process is a fake 4-device host
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+
+def main() -> None:
+    coordinator, num_procs, proc_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+
+    # the axon plugin (sitecustomize) sets jax_platforms programmatically,
+    # overriding the env var — force CPU via config before any backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fedml_tpu.parallel import multihost
+
+    pid, count = multihost.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    assert (pid, count) == (proc_id, num_procs), (pid, count)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert len(jax.devices()) == 4 * num_procs, len(jax.devices())
+
+    mesh = multihost.global_client_mesh()
+    n_clients = mesh.shape["clients"]
+
+    # every host feeds only its local rows (the multi-host data contract)
+    lo, hi = multihost.local_client_slice(mesh, n_clients)
+    local = np.arange(lo, hi, dtype=np.float32)[:, None]  # client idx as data
+    stacked = multihost.host_local_to_global(mesh, local, n_clients)
+
+    @jax.jit
+    def global_sum(x):
+        return jnp.sum(x)
+
+    total = float(global_sum(stacked))
+    expect = float(sum(range(n_clients)))
+    assert total == expect, (total, expect)
+
+    assert multihost.all_hosts_agree(7)
+
+    # cross-host weighted aggregation through the mesh (the FedAvg psum path)
+    weights = multihost.host_local_to_global(
+        mesh, np.full((hi - lo, 1), proc_id + 1.0, np.float32), n_clients)
+    wsum = float(jax.jit(lambda w, x: jnp.sum(w * x))(weights, stacked))
+    per_host = n_clients // num_procs
+    expect_w = sum((h + 1.0) * i for h in range(num_procs)
+                   for i in range(h * per_host, (h + 1) * per_host))
+    assert wsum == expect_w, (wsum, expect_w)
+
+    print(f"MULTIHOST_OK {total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Real 2-process jax.distributed rendezvous (VERDICT round-1 item 9).
+
+tests/test_multihost.py covers the multihost helpers single-process; this
+exercises the actual coordinator handshake: 2 subprocesses × 4 virtual CPU
+devices form one 8-device global mesh and run cross-host collectives.
+Mirrors the reference's localhost-cluster trick
+(run_fedavg_distributed_pytorch.sh:19-22) without MPI.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost rendezvous hung:\n" + "\n---\n".join(
+            p.stdout.read() if p.stdout else "" for p in procs))
+
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+        assert "MULTIHOST_OK 28.0" in out, out  # sum(range(8))
