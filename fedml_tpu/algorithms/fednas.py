@@ -28,7 +28,8 @@ import optax
 
 from fedml_tpu.core import pytree as pt
 from fedml_tpu.data.base import FederatedDataset
-from fedml_tpu.models.darts import (DartsNetwork, init_alphas,
+from fedml_tpu.models.darts import (DartsNetwork, gdas_tau,
+                                    gumbel_softmax_weights, init_alphas,
                                     parse_genotype)
 
 
@@ -43,6 +44,11 @@ class FedNASConfig:
     arch_lr: float = 3e-4       # alpha Adam (reference --arch_learning_rate)
     arch_wd: float = 1e-3
     seed: int = 0
+    # "darts" = soft mixture (model_search.py); "gdas" = hard gumbel-softmax
+    # single-path sampling with ST gradients (model_search_gdas.py)
+    variant: str = "darts"
+    tau_max: float = 10.0       # GDAS temperature anneal bounds
+    tau_min: float = 0.1
 
 
 class FedNASAPI:
@@ -73,14 +79,19 @@ class FedNASAPI:
         self._round_fn = jax.jit(self._make_round())
         self.history: List[Dict] = []
 
-    def _apply(self, variables, alphas, x, train, mutable=False):
-        w = jax.nn.softmax(alphas["normal"], axis=-1)
-        wr = jax.nn.softmax(alphas["reduce"], axis=-1)
+    def _apply_w(self, variables, w, wr, x, train, mutable=False):
         if mutable:
             m = [k for k in variables if k != "params"]
             return self.model.apply(variables, x, w, wr, train=True,
                                     mutable=m)
         return self.model.apply(variables, x, w, wr, train=train)
+
+    def _apply(self, variables, alphas, x, train, mutable=False):
+        # deterministic mixture (also how GDAS nets are evaluated here:
+        # sampling at eval would make test accuracy a random variable)
+        w = jax.nn.softmax(alphas["normal"], axis=-1)
+        wr = jax.nn.softmax(alphas["reduce"], axis=-1)
+        return self._apply_w(variables, w, wr, x, train, mutable=mutable)
 
     def _make_round(self):
         cfg = self.cfg
@@ -88,13 +99,24 @@ class FedNASAPI:
         n_pad = self._n_pad
         nb = n_pad // bsz
         tx_w, tx_a = self._tx_w, self._tx_a
-        apply = self._apply
+        apply_w = self._apply_w
+        variant = cfg.variant
+
+        def mixing_weights(alphas, key, tau):
+            """Per-edge op mixture: soft softmax (DARTS) or hard ST gumbel
+            sample (GDAS)."""
+            if variant == "gdas":
+                kn, kr = jax.random.split(key)
+                return (gumbel_softmax_weights(kn, alphas["normal"], tau),
+                        gumbel_softmax_weights(kr, alphas["reduce"], tau))
+            return (jax.nn.softmax(alphas["normal"], axis=-1),
+                    jax.nn.softmax(alphas["reduce"], axis=-1))
 
         def masked_ce(logits, y, m):
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
 
-        def one_client(variables, alphas, x, y, mask, rng):
+        def one_client(variables, alphas, x, y, mask, rng, tau):
             """Alternating search: for each train batch, (1) alpha step on
             the *next* (val) batch, (2) weight step on the train batch —
             the reference's per-batch architect/optimizer alternation."""
@@ -105,7 +127,8 @@ class FedNASAPI:
 
             def step(carry, inp):
                 params, colls, alphas, opt_w, opt_a = carry
-                idx_train, idx_val = inp
+                idx_train, idx_val, skey = inp
+                ka, kw = jax.random.split(skey)
                 xt, yt, mt = (jnp.take(x, idx_train, 0),
                               jnp.take(y, idx_train, 0),
                               jnp.take(mask, idx_train, 0))
@@ -115,18 +138,20 @@ class FedNASAPI:
 
                 # (1) architecture step: d val_loss / d alphas (1st order)
                 def val_loss(a):
-                    logits, _ = apply({"params": params, **colls}, a, xv,
-                                      True, mutable=True)
+                    w, wr = mixing_weights(a, ka, tau)
+                    logits, _ = apply_w({"params": params, **colls}, w, wr,
+                                        xv, True, mutable=True)
                     return masked_ce(logits, yv, mv)
 
                 ga = jax.grad(val_loss)(alphas)
                 ua, opt_a = tx_a.update(ga, opt_a, alphas)
                 alphas = optax.apply_updates(alphas, ua)
 
-                # (2) weight step on the train batch
+                # (2) weight step on the train batch (GDAS: fresh sample)
                 def train_loss(p):
-                    logits, updates = apply({"params": p, **colls}, alphas,
-                                            xt, True, mutable=True)
+                    w, wr = mixing_weights(alphas, kw, tau)
+                    logits, updates = apply_w({"params": p, **colls}, w, wr,
+                                              xt, True, mutable=True)
                     return masked_ce(logits, yt, mt), updates
 
                 (loss, updates), gw = jax.value_and_grad(
@@ -137,11 +162,14 @@ class FedNASAPI:
                 return (params, colls, alphas, opt_w, opt_a), loss
 
             def epoch(carry, key):
-                perm = jax.random.permutation(key, n_pad)
+                kperm, kstep = jax.random.split(key)
+                perm = jax.random.permutation(kperm, n_pad)
                 batches = perm[:nb * bsz].reshape(nb, bsz)
                 val_batches = jnp.roll(batches, 1, axis=0)  # next as val
+                step_keys = jax.random.split(kstep, nb)
                 carry, losses = jax.lax.scan(step, carry,
-                                             (batches, val_batches))
+                                             (batches, val_batches,
+                                              step_keys))
                 return carry, jnp.mean(losses)
 
             keys = jax.random.split(rng, cfg.epochs)
@@ -149,10 +177,10 @@ class FedNASAPI:
                 epoch, (params, colls, alphas, opt_w, opt_a), keys)
             return {"params": params, **colls}, alphas, jnp.mean(losses)
 
-        def round_fn(variables, alphas, x, y, mask, weights, rngs):
+        def round_fn(variables, alphas, x, y, mask, weights, rngs, tau):
             stacked_vars, stacked_alphas, losses = jax.vmap(
-                one_client, in_axes=(None, None, 0, 0, 0, 0))(
-                variables, alphas, x, y, mask, rngs)
+                one_client, in_axes=(None, None, 0, 0, 0, 0, None))(
+                variables, alphas, x, y, mask, rngs, tau)
             new_vars = pt.tree_weighted_mean(stacked_vars, weights)
             new_alphas = pt.tree_weighted_mean(stacked_alphas, weights)
             return new_vars, new_alphas, jnp.mean(losses)
@@ -167,9 +195,11 @@ class FedNASAPI:
         weights = jnp.asarray(self.ds.client_weights(idxs))
         rkey = jax.random.fold_in(jax.random.key(cfg.seed), round_idx)
         rngs = jax.random.split(rkey, len(idxs))
+        tau = jnp.float32(gdas_tau(round_idx, cfg.comm_round,
+                                   cfg.tau_max, cfg.tau_min))
         self.variables, self.alphas, loss = self._round_fn(
             self.variables, self.alphas, jnp.asarray(x), jnp.asarray(y),
-            jnp.asarray(mask), weights, rngs)
+            jnp.asarray(mask), weights, rngs, tau)
         rec = {"round": round_idx, "search_loss": float(loss),
                "genotype": self.genotype()}
         self.history.append(rec)

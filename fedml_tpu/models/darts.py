@@ -236,6 +236,43 @@ def init_alphas(steps: int, rng: np.random.RandomState):
             np.asarray(1e-3 * rng.randn(k, len(PRIMITIVES)), np.float32))
 
 
+def gumbel_softmax_weights(key, alphas, tau: float, hard: bool = True):
+    """GDAS single-path sampling (reference Network_GumbelSoftmax.forward,
+    model_search_gdas.py:122-133: ``F.gumbel_softmax(alphas, tau, True)``).
+
+    Straight-through estimator: forward sees a one-hot per edge (one primitive
+    active), backward flows through the soft gumbel-softmax. The reference's
+    eager-mode trick of *skipping* zero-weight branches
+    (model_search_gdas.py MixedOp.forward cpu_weights test) is
+    data-dependent control flow XLA can't tile; here all branches run and the
+    one-hot contraction selects — on the MXU the branch convs are batched
+    back-to-back and the masked sum fuses into their epilogue, which is
+    faster than eight ``lax.cond`` branches serializing.
+    """
+    import jax
+    import jax.numpy as jnp_
+
+    gumbel = -jnp_.log(-jnp_.log(
+        jax.random.uniform(key, alphas.shape, minval=1e-20, maxval=1.0)))
+    soft = jax.nn.softmax((alphas + gumbel) / tau, axis=-1)
+    if not hard:
+        return soft
+    onehot = jax.nn.one_hot(jnp_.argmax(soft, axis=-1), soft.shape[-1],
+                            dtype=soft.dtype)
+    return onehot + soft - jax.lax.stop_gradient(soft)  # ST gradient
+
+
+def gdas_tau(epoch: int, total_epochs: int, tau_max: float = 10.0,
+             tau_min: float = 0.1) -> float:
+    """Linear temperature annealing tau_max → tau_min over the search
+    (the schedule GDAS drives through the reference's ``set_tau``,
+    model_search_gdas.py:117-120; the paper's 10 → 0.1 default)."""
+    if total_epochs <= 1:
+        return tau_min
+    frac = min(max(epoch / (total_epochs - 1), 0.0), 1.0)
+    return tau_max + (tau_min - tau_max) * frac
+
+
 def parse_genotype(alphas_normal: np.ndarray,
                    alphas_reduce: np.ndarray, steps: int = 4,
                    multiplier: int = 4) -> Genotype:

@@ -1,12 +1,17 @@
 """DARTS search space + FedNAS bilevel rounds (tiny configs for CPU)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algorithms.fednas import FedNASAPI, FedNASConfig
 from fedml_tpu.models.darts import (PRIMITIVES, DartsNetwork, Genotype,
+                                    gdas_tau, gumbel_softmax_weights,
                                     init_alphas, parse_genotype)
+from fedml_tpu.models.darts_visualize import (format_genotype,
+                                              genotype_to_dot, plot)
 from tests.test_fedgkt import make_image_federation
 
 
@@ -76,6 +81,81 @@ class TestGenotype:
         g = parse_genotype(alphas, alphas, steps=steps, multiplier=2)
         node1_edges = [j for _, j in g.normal[2:4]]
         assert set(node1_edges) == {0, 2}
+
+
+class TestGdas:
+    def test_hard_sample_is_onehot_with_st_gradient(self):
+        alphas = jnp.asarray(np.random.RandomState(0)
+                             .randn(5, len(PRIMITIVES)), jnp.float32)
+        w = gumbel_softmax_weights(jax.random.key(0), alphas, tau=1.0)
+        # forward: exactly one op active per edge
+        wn = np.asarray(w)
+        np.testing.assert_allclose(np.sum(wn, -1), 1.0, rtol=1e-5)
+        # (1 + soft - stop_grad(soft)) in fp32 ⇒ ≈1, not exactly 1
+        assert int(np.sum(wn > 0.5)) == 5
+        np.testing.assert_allclose(np.max(wn, -1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(np.sort(wn, -1)[:, :-1], 0.0, atol=1e-5)
+        # backward: ST estimator passes soft gradients to every logit
+        g = jax.grad(lambda a: jnp.sum(
+            gumbel_softmax_weights(jax.random.key(0), a, 1.0) ** 2))(alphas)
+        assert float(jnp.max(jnp.abs(g))) > 0
+
+    def test_soft_mode_matches_softmax_at_high_tau_limit(self):
+        alphas = jnp.zeros((3, len(PRIMITIVES)))
+        w = gumbel_softmax_weights(jax.random.key(1), alphas, tau=1e6,
+                                   hard=False)
+        np.testing.assert_allclose(np.asarray(w),
+                                   1.0 / len(PRIMITIVES), atol=1e-4)
+
+    def test_tau_anneals_linearly(self):
+        import pytest
+        assert gdas_tau(0, 10) == 10.0
+        assert gdas_tau(9, 10) == pytest.approx(0.1)
+        assert 0.1 < gdas_tau(5, 10) < 10.0
+
+    def test_gdas_search_round(self):
+        ds = make_image_federation(client_num=2, n_per=32, hw=16)
+        api = FedNASAPI(ds, tiny_net(ds.class_num),
+                        FedNASConfig(comm_round=2, epochs=1, batch_size=8,
+                                     variant="gdas"))
+        a0 = jax.tree.map(jnp.copy, api.alphas)
+        rec = api.run_round(0)
+        assert np.isfinite(rec["search_loss"])
+        da = sum(float(jnp.max(jnp.abs(a - b))) for a, b in zip(
+            jax.tree.leaves(a0), jax.tree.leaves(api.alphas)))
+        assert da > 0
+        assert isinstance(rec["genotype"], Genotype)
+
+
+class TestVisualize:
+    def _genotype(self):
+        alphas = np.zeros((DartsNetwork.num_edges(2), len(PRIMITIVES)),
+                          np.float32)
+        alphas[:, PRIMITIVES.index("sep_conv_3x3")] = 1.0
+        return parse_genotype(alphas, alphas, steps=2, multiplier=2)
+
+    def test_dot_source_structure(self):
+        g = self._genotype()
+        dot = genotype_to_dot(g.normal, name="normal")
+        assert dot.startswith('digraph "normal"')
+        assert '"c_{k-2}"' in dot and '"c_{k-1}"' in dot
+        assert dot.count('[label="sep_conv_3x3"]') == len(g.normal)
+        # every intermediate node feeds the output concat node
+        for i in range(len(g.normal) // 2):
+            assert f'"{i}" -> "c_{{k}}";' in dot
+
+    def test_plot_writes_both_cells(self, tmp_path):
+        paths = plot(self._genotype(), str(tmp_path), prefix="r3_")
+        assert [os.path.basename(p) for p in paths] == [
+            "r3_normal.dot", "r3_reduction.dot"]
+        for p in paths:
+            with open(p) as fh:
+                assert "digraph" in fh.read()
+
+    def test_format_genotype_text(self):
+        txt = format_genotype(self._genotype())
+        assert "normal (concat" in txt and "reduce (concat" in txt
+        assert "node 0 <-" in txt
 
 
 class TestFedNAS:
