@@ -48,6 +48,10 @@ class FedGKTConfig:
     whether_training_on_client: bool = True
     whether_distill_on_the_server: bool = True
     seed: int = 0
+    # torch .pth checkpoint mirroring the client model — every client's
+    # feature extractor warm-starts from it (reference create_client_model,
+    # main_fedgkt.py:124-167 loading cv/pretrained/*/resnet56/best.pth)
+    pretrained_client_path: Optional[str] = None
 
 
 def kl_distill(student_logits, teacher_logits, T: float) -> jnp.ndarray:
@@ -80,6 +84,16 @@ class FedGKTAPI:
 
         client_keys = jax.random.split(kc, dataset.client_num)
         self.client_vars = jax.vmap(init_client)(client_keys)
+        if cfg.pretrained_client_path:
+            from fedml_tpu.utils.torch_import import (
+                load_torch_state_dict, torch_to_flax_variables)
+            warm = torch_to_flax_variables(
+                load_torch_state_dict(cfg.pretrained_client_path),
+                client_module.init(kc, sample_x, train=False))
+            n = dataset.client_num
+            self.client_vars = jax.tree.map(
+                lambda l: jnp.tile(jnp.asarray(l)[None],
+                                   (n,) + (1,) * jnp.asarray(l).ndim), warm)
         _, feats = client_module.apply(
             jax.tree.map(lambda v: v[0], self.client_vars), sample_x,
             train=False)

@@ -52,6 +52,9 @@ def add_algo_args(parser: argparse.ArgumentParser):
     # fedgkt (main_fedgkt.py)
     parser.add_argument("--epochs_client", type=int, default=1)
     parser.add_argument("--epochs_server", type=int, default=1)
+    parser.add_argument("--pretrained_path", type=str, default=None,
+                        help="torch .pth mirroring the GKT client model; "
+                             "warm-starts every client feature extractor")
     parser.add_argument("--alpha", type=float, default=1.0)
     parser.add_argument("--temperature", type=float, default=1.0)
     # decentralized online (main_decentralized_fl args)
@@ -231,7 +234,9 @@ def run_algo(args):
                                      batch_size=args.batch_size,
                                      alpha=args.alpha,
                                      temperature=args.temperature,
-                                     seed=args.seed))
+                                     seed=args.seed,
+                                     pretrained_client_path=(
+                                         args.pretrained_path)))
     else:  # pragma: no cover - main() rejects unwired algos up front
         raise SystemExit(f"--algo {args.algo} is not wired in fed_launch")
 

@@ -1,0 +1,201 @@
+"""Torch-checkpoint import: warm-start flax models from .pth state_dicts.
+
+Reference parity: FedGKT initializes the client feature extractor from a
+pretrained torch ResNet-56 checkpoint
+(fedml_experiments/distributed/fedgkt/main_fedgkt.py:124-167,
+``resnet56_pretrained(..., pretrained=True, path=...)`` and the pretrained
+ckpt dirs under fedml_api/model/cv/pretrained/). Our models are flax, so the
+import path is a structural converter rather than ``load_state_dict``:
+
+- torch tensors are grouped by kind (conv kernels [O,I,H,W], bn 4-tuples,
+  linear weights [O,I]) in state_dict insertion order;
+- the flax variable tree is walked in module-creation order (flax dicts
+  preserve insertion order, which IS creation order for ``@nn.compact``);
+- kinds are matched queue-to-queue with layout transposition
+  (OIHW→HWIO, [O,I]→[I,O]) and strict shape checks.
+
+For architectures that mirror each other block-for-block (our CifarResNet /
+GKT ResNets vs the reference's resnet_client/resnet_server layer order) this
+is exact; any drift surfaces as a shape mismatch, never silent corruption.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a .pth file into {name: ndarray} (CPU, no grad). Accepts both a
+    bare state_dict and the common {'state_dict': ...} checkpoint wrapper;
+    strips DataParallel's 'module.' prefix."""
+    import torch
+
+    blob = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(blob, dict) and "state_dict" in blob and not any(
+            hasattr(v, "numpy") for v in blob.values()):
+        blob = blob["state_dict"]
+    out = {}
+    for k, v in blob.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        if hasattr(v, "numpy"):
+            out[k] = v.detach().cpu().numpy()
+    return out
+
+
+def _group_torch(state: Dict[str, np.ndarray]):
+    """Kind-ordered queues from a torch state_dict (insertion order)."""
+    convs: List[np.ndarray] = []
+    bns: List[Dict[str, np.ndarray]] = []
+    linears: List[Tuple[np.ndarray, Any]] = []
+    bn_acc: Dict[str, Dict[str, np.ndarray]] = {}
+
+    def bn_prefix(key):  # "layer1.0.bn1.weight" -> "layer1.0.bn1"
+        return key.rsplit(".", 1)[0]
+
+    pending_linear_w = None
+    pending_linear_prefix = None
+    for key, val in state.items():
+        leaf = key.rsplit(".", 1)[-1]
+        if leaf == "num_batches_tracked":
+            continue
+        if pending_linear_w is not None and not (
+                leaf == "bias" and bn_prefix(key) == pending_linear_prefix):
+            linears.append((pending_linear_w, None))
+            pending_linear_w = pending_linear_prefix = None
+        if val.ndim == 4 and leaf == "weight":
+            convs.append(val)
+        elif val.ndim == 2 and leaf == "weight":
+            pending_linear_w = val
+            pending_linear_prefix = bn_prefix(key)
+        elif leaf == "bias" and pending_linear_w is not None:
+            linears.append((pending_linear_w, val))
+            pending_linear_w = pending_linear_prefix = None
+        elif leaf in ("weight", "bias", "running_mean", "running_var"):
+            acc = bn_acc.setdefault(bn_prefix(key), {})
+            acc[leaf] = val
+            if len(acc) == 4:
+                bns.append(bn_acc.pop(bn_prefix(key)))
+        else:
+            raise ValueError(f"unrecognized torch tensor {key!r} "
+                             f"shape {val.shape}")
+    if pending_linear_w is not None:
+        linears.append((pending_linear_w, None))
+    if bn_acc:
+        raise ValueError(f"incomplete BatchNorm groups: {sorted(bn_acc)}")
+    return convs, bns, linears
+
+
+def torch_to_flax_variables(state: Dict[str, np.ndarray],
+                            variables: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill a flax variable tree (params + batch_stats) from a torch
+    state_dict of the mirrored architecture. Returns a new tree; raises on
+    any count or shape mismatch."""
+    convs, bns, linears = _group_torch(state)
+    ci = bi = li = 0
+    # bn params arrive per-module; track each module's tensors by position:
+    # flax visits scale,bias under params and mean,var under batch_stats,
+    # in the SAME module order, so two independent cursors share bns.
+    bi_stats = 0
+
+    def conv_kernel(leaf):
+        nonlocal ci
+        if ci >= len(convs):
+            raise ValueError("torch checkpoint has fewer conv layers")
+        w = convs[ci]
+        ci += 1
+        out = np.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+        if out.shape != leaf.shape:
+            raise ValueError(f"conv #{ci - 1}: torch {out.shape} vs "
+                             f"flax {leaf.shape}")
+        return out
+
+    def dense(leaf, name):
+        nonlocal li
+        if li >= len(linears):
+            raise ValueError("torch checkpoint has fewer linear layers")
+        w, b = linears[li]
+        if name == "kernel":
+            out = np.transpose(w)  # [O,I] -> [I,O]
+        else:
+            li_b = b if b is not None else np.zeros(w.shape[0], w.dtype)
+            out = li_b
+            li += 1  # bias closes the module
+        if name == "kernel" and b is None:
+            li += 1  # bias-free linear: kernel closes it
+        if out.shape != leaf.shape:
+            raise ValueError(f"linear #{li}: torch {out.shape} vs "
+                             f"flax {leaf.shape} ({name})")
+        return out
+
+    def bn_param(leaf, name):
+        nonlocal bi
+        idx = bi
+        if name == "bias":
+            bi += 1  # bias is the second (last) bn tensor under params
+        src = {"scale": "weight", "bias": "bias"}[name]
+        if idx >= len(bns):
+            raise ValueError("torch checkpoint has fewer BatchNorm layers")
+        out = bns[idx][src]
+        if out.shape != leaf.shape:
+            raise ValueError(f"bn #{idx}: torch {out.shape} vs "
+                             f"flax {leaf.shape} ({name})")
+        return out
+
+    def bn_stat(leaf, name):
+        nonlocal bi_stats
+        idx = bi_stats
+        if name == "var":
+            bi_stats += 1
+        src = {"mean": "running_mean", "var": "running_var"}[name]
+        if idx >= len(bns):
+            raise ValueError("torch checkpoint has fewer BatchNorm layers")
+        out = bns[idx][src]
+        if out.shape != leaf.shape:
+            raise ValueError(f"bn stats #{idx}: torch {out.shape} vs "
+                             f"flax {leaf.shape}")
+        return out
+
+    # rebuild params and batch_stats leaf-by-leaf in creation order
+    new_vars: Dict[str, Any] = {}
+    for coll, tree in variables.items():
+        if coll == "params":
+            new_vars[coll] = _fill(tree, conv_kernel, dense, bn_param,
+                                   is_stats=False)
+        elif coll == "batch_stats":
+            new_vars[coll] = _fill(tree, None, None, None, is_stats=True,
+                                   bn_stat=bn_stat)
+        else:
+            new_vars[coll] = tree
+
+    if ci != len(convs):
+        raise ValueError(f"{len(convs) - ci} torch conv layers unused")
+    if li != len(linears):
+        raise ValueError(f"{len(linears) - li} torch linear layers unused")
+    if bi != len(bns):
+        raise ValueError(f"{len(bns) - bi} torch BatchNorm layers unused")
+    return new_vars
+
+
+def _fill(tree, conv_kernel, dense, bn_param, is_stats=False, bn_stat=None,
+          path=()):
+    if isinstance(tree, dict):
+        return {k: _fill(v, conv_kernel, dense, bn_param, is_stats, bn_stat,
+                         path + (k,))
+                for k, v in tree.items()}
+    leaf = np.asarray(tree)
+    modname = path[-2] if len(path) >= 2 else ""
+    name = path[-1]
+    if is_stats:
+        if "BatchNorm" in modname and name in ("mean", "var"):
+            return bn_stat(leaf, name)
+        return tree
+    if "Conv" in modname and name == "kernel":
+        return conv_kernel(leaf)
+    if "Dense" in modname:
+        return dense(leaf, name)
+    if "BatchNorm" in modname and name in ("scale", "bias"):
+        return bn_param(leaf, name)
+    raise ValueError(f"unhandled flax leaf {'/'.join(path)}")

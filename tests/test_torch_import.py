@@ -1,0 +1,151 @@
+"""Torch-checkpoint warm-start (GKT pretrained init parity).
+
+Builds torch mirrors of our flax GKT/CIFAR ResNets, loads their state_dicts
+through utils/torch_import, and checks the flax forward pass reproduces the
+torch forward numerically — the property the reference relies on when
+initializing GKT clients from pretrained ResNet-56 checkpoints
+(main_fedgkt.py:124-167).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from fedml_tpu.models.resnet_gkt import resnet8_56  # noqa: E402
+from fedml_tpu.utils.torch_import import (  # noqa: E402
+    load_torch_state_dict, torch_to_flax_variables)
+
+
+class TorchBottleneck(tnn.Module):
+    """Mirror of models/resnet.py BottleneckBlock (same creation order)."""
+
+    def __init__(self, c_in, planes, stride=1, expansion=4):
+        super().__init__()
+        c_out = planes * expansion
+        self.conv1 = tnn.Conv2d(c_in, planes, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, stride=stride, padding=1,
+                                bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.conv3 = tnn.Conv2d(planes, c_out, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(c_out)
+        self.has_ds = stride != 1 or c_in != c_out
+        if self.has_ds:
+            self.ds_conv = tnn.Conv2d(c_in, c_out, 1, stride=stride,
+                                      bias=False)
+            self.ds_bn = tnn.BatchNorm2d(c_out)
+
+    def forward(self, x):
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = torch.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        identity = self.ds_bn(self.ds_conv(x)) if self.has_ds else x
+        return torch.relu(out + identity)
+
+
+class TorchGKTClient(tnn.Module):
+    """Mirror of ResNetClientGKT (stem + 2 stage-1 bottlenecks + aux head)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.stem = tnn.Conv2d(3, 16, 3, padding=1, bias=False)
+        self.stem_bn = tnn.BatchNorm2d(16)
+        self.block1 = TorchBottleneck(16, 16)
+        self.block2 = TorchBottleneck(64, 16)
+        self.fc = tnn.Linear(64, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.stem_bn(self.stem(x)))
+        x = self.block1(x)
+        x = self.block2(x)
+        pooled = x.mean(dim=(2, 3))
+        return self.fc(pooled), x
+
+
+def _randomize_bn_stats(model, rng):
+    """Non-trivial running stats so eval-mode equivalence actually tests
+    the batch_stats import."""
+    for m in model.modules():
+        if isinstance(m, tnn.BatchNorm2d):
+            m.running_mean.copy_(torch.tensor(
+                rng.randn(m.num_features) * 0.1, dtype=torch.float32))
+            m.running_var.copy_(torch.tensor(
+                1.0 + 0.1 * rng.rand(m.num_features), dtype=torch.float32))
+
+
+def test_gkt_client_forward_matches_torch(tmp_path):
+    torch.manual_seed(0)
+    tmodel = TorchGKTClient(num_classes=10)
+    with torch.no_grad():
+        _randomize_bn_stats(tmodel, np.random.RandomState(0))
+    tmodel.eval()
+
+    path = str(tmp_path / "best.pth")
+    torch.save(tmodel.state_dict(), path)
+
+    fmodel = resnet8_56(num_classes=10)
+    x = np.random.RandomState(1).randn(2, 8, 8, 3).astype(np.float32)
+    variables = fmodel.init(jax.random.key(0), jnp.asarray(x), train=False)
+    variables = torch_to_flax_variables(load_torch_state_dict(path),
+                                        variables)
+
+    logits, feats = fmodel.apply(variables, jnp.asarray(x), train=False)
+    with torch.no_grad():
+        tlogits, tfeats = tmodel(torch.tensor(np.transpose(x, (0, 3, 1, 2))))
+
+    np.testing.assert_allclose(np.asarray(logits), tlogits.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(feats),
+                               np.transpose(tfeats.numpy(), (0, 2, 3, 1)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_checkpoint_wrapper_and_dataparallel_prefix(tmp_path):
+    tmodel = TorchGKTClient(num_classes=4)
+    wrapped = {"epoch": 3, "state_dict": {
+        "module." + k: v for k, v in tmodel.state_dict().items()}}
+    path = str(tmp_path / "ckpt.pth")
+    torch.save(wrapped, path)
+    state = load_torch_state_dict(path)
+    assert not any(k.startswith("module.") for k in state)
+    assert "stem.weight" in state
+
+
+def test_fedgkt_warm_start(tmp_path):
+    """FedGKTAPI with pretrained_client_path: every client starts from the
+    checkpoint weights instead of random init."""
+    from fedml_tpu.algorithms.fedgkt import FedGKTAPI, FedGKTConfig
+    from fedml_tpu.models.resnet_gkt import resnet56_server
+    from tests.test_fedgkt import make_image_federation
+
+    tmodel = TorchGKTClient(num_classes=3)
+    path = str(tmp_path / "best.pth")
+    torch.save(tmodel.state_dict(), path)
+
+    ds = make_image_federation(client_num=2, n_per=16, hw=8)
+    api = FedGKTAPI(ds, resnet8_56(ds.class_num),
+                    resnet56_server(ds.class_num),
+                    FedGKTConfig(comm_round=1, batch_size=8,
+                                 pretrained_client_path=path))
+    stem = api.client_vars["params"]["Conv_0"]["kernel"]
+    expected = np.transpose(tmodel.stem.weight.detach().numpy(),
+                            (2, 3, 1, 0))
+    for c in range(ds.client_num):
+        np.testing.assert_allclose(np.asarray(stem)[c], expected,
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_shape_mismatch_raises(tmp_path):
+    tmodel = TorchGKTClient(num_classes=7)  # wrong head width
+    path = str(tmp_path / "bad.pth")
+    torch.save(tmodel.state_dict(), path)
+    fmodel = resnet8_56(num_classes=10)
+    variables = fmodel.init(jax.random.key(0), jnp.zeros((1, 8, 8, 3)),
+                            train=False)
+    with pytest.raises(ValueError):
+        torch_to_flax_variables(load_torch_state_dict(path), variables)
