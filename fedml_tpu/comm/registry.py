@@ -36,9 +36,24 @@ def create_comm_manager(
             raise ValueError("TCP backend needs {rank: (host, port)}")
         from fedml_tpu.comm.tcp import TcpCommManager
         return TcpCommManager(rank, addresses)
-    if key in ("GRPC", "MQTT"):
+    if key == "GRPC":
         if addresses is None:
             raise ValueError("GRPC backend needs {rank: (host, port)}")
         from fedml_tpu.comm.grpc_backend import GrpcCommManager
         return GrpcCommManager(rank, addresses)
+    if key == "GRPC_PROTO":
+        # reference-wire-compatible mode (grpc_comm_manager.proto)
+        if addresses is None:
+            raise ValueError("GRPC_PROTO backend needs {rank: (host, port)}")
+        from fedml_tpu.comm.grpc_proto import ProtoGrpcCommManager
+        return ProtoGrpcCommManager(rank, addresses)
+    if key == "MQTT":
+        # broker pub/sub with the reference topic scheme + JSON payloads
+        if addresses is None or "broker" not in addresses:
+            raise ValueError(
+                'MQTT backend needs addresses={"broker": (host, port)}')
+        from fedml_tpu.comm.mqtt import MqttCommManager
+        host, port = addresses["broker"]
+        return MqttCommManager(host, port, client_id=rank,
+                               client_num=size - 1)
     raise ValueError(f"unknown backend: {backend!r}")

@@ -1,0 +1,152 @@
+"""MQTT backend: wire codec, broker routing, reference topic scheme."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.mqtt import (MiniMqttBroker, MiniMqttClient,
+                                 MqttCommManager, _encode_remaining_length)
+
+
+class _Obs:
+    def __init__(self):
+        self.got = []
+        self.event = threading.Event()
+
+    def receive_message(self, msg_type, msg):
+        self.got.append(msg)
+        self.event.set()
+
+
+@pytest.fixture()
+def broker():
+    b = MiniMqttBroker()
+    yield b
+    b.stop()
+
+
+class TestWire:
+    def test_remaining_length_encoding(self):
+        # spec §2.2.3 worked examples
+        assert _encode_remaining_length(0) == b"\x00"
+        assert _encode_remaining_length(127) == b"\x7f"
+        assert _encode_remaining_length(128) == b"\x80\x01"
+        assert _encode_remaining_length(16383) == b"\xff\x7f"
+        assert _encode_remaining_length(2097152) == b"\x80\x80\x80\x01"
+
+    def test_pubsub_roundtrip(self, broker):
+        got = []
+        done = threading.Event()
+
+        def on_msg(topic, payload):
+            got.append((topic, payload))
+            done.set()
+
+        sub = MiniMqttClient("127.0.0.1", broker.port, "sub", on_msg)
+        sub.subscribe("t/x")
+        pub = MiniMqttClient("127.0.0.1", broker.port, "pub",
+                             lambda *a: None)
+        pub.publish("t/x", b"hello \xc3\xa9" + bytes(range(256)))
+        assert done.wait(10)
+        assert got[0][0] == "t/x"
+        assert got[0][1].endswith(bytes(range(256)))
+        pub.close()
+        sub.close()
+
+    def test_exact_topic_isolation(self, broker):
+        got = []
+        sub = MiniMqttClient("127.0.0.1", broker.port, "s",
+                             lambda t, p: got.append(t))
+        sub.subscribe("fedml1")
+        pub = MiniMqttClient("127.0.0.1", broker.port, "p", lambda *a: None)
+        pub.publish("fedml2", b"x")  # different topic: must not arrive
+        pub.publish("fedml1", b"y")
+        deadline = threading.Event()
+        for _ in range(100):
+            if got:
+                break
+            deadline.wait(0.05)
+        assert got == ["fedml1"]
+        pub.close()
+        sub.close()
+
+
+def test_registry_dispatch(broker):
+    from fedml_tpu.comm.registry import create_comm_manager
+
+    mgr = create_comm_manager("MQTT", rank=1, size=3,
+                              addresses={"broker": ("127.0.0.1",
+                                                    broker.port)})
+    assert isinstance(mgr, MqttCommManager)
+    mgr.stop_receive_message()
+    with pytest.raises(ValueError):
+        create_comm_manager("MQTT", rank=0, size=2)
+
+
+class TestCommManager:
+    def test_reference_topic_scheme_roundtrip(self, broker):
+        """Server(0) <-> client(1) through the broker with the reference's
+        fedml0_<cid> / fedml<cid> topics and JSON payloads."""
+        server = MqttCommManager("127.0.0.1", broker.port, client_id=0,
+                                 client_num=2)
+        client = MqttCommManager("127.0.0.1", broker.port, client_id=1)
+        sobs, cobs = _Obs(), _Obs()
+        server.add_observer(sobs)
+        client.add_observer(cobs)
+        ts = threading.Thread(target=server.handle_receive_message,
+                              daemon=True)
+        tc = threading.Thread(target=client.handle_receive_message,
+                              daemon=True)
+        ts.start()
+        tc.start()
+        try:
+            # client uplink: publishes fedml1, server subscribed
+            client.send_message(
+                Message(type=3, sender_id=1, receiver_id=0)
+                .add("model_params", {"w": np.asarray([1.5, -2.0],
+                                                      np.float32)})
+                .add("num_samples", 12))
+            assert sobs.event.wait(10)
+            msg = sobs.got[0]
+            assert msg.get_type() == 3 and msg.get_sender_id() == 1
+            assert msg.get("num_samples") == 12
+            np.testing.assert_allclose(msg.get("model_params")["w"],
+                                       [1.5, -2.0])
+
+            # server downlink: publishes fedml0_1, client subscribed
+            server.send_message(Message(type=1, sender_id=0, receiver_id=1)
+                                .add("round_idx", 7))
+            assert cobs.event.wait(10)
+            assert cobs.got[0].get("round_idx") == 7
+        finally:
+            server.stop_receive_message()
+            client.stop_receive_message()
+            ts.join(timeout=5)
+            tc.join(timeout=5)
+
+    def test_server_receives_from_multiple_clients(self, broker):
+        server = MqttCommManager("127.0.0.1", broker.port, client_id=0,
+                                 client_num=3)
+        sobs = _Obs()
+        server.add_observer(sobs)
+        ts = threading.Thread(target=server.handle_receive_message,
+                              daemon=True)
+        ts.start()
+        clients = [MqttCommManager("127.0.0.1", broker.port, client_id=c)
+                   for c in (1, 2, 3)]
+        try:
+            for c, mgr in zip((1, 2, 3), clients):
+                mgr.send_message(Message(type=3, sender_id=c, receiver_id=0)
+                                 .add("client_idx", c))
+            for _ in range(200):
+                if len(sobs.got) == 3:
+                    break
+                threading.Event().wait(0.05)
+            assert sorted(m.get("client_idx") for m in sobs.got) == [1, 2, 3]
+        finally:
+            server.stop_receive_message()
+            for mgr in clients:
+                mgr.stop_receive_message()
+            ts.join(timeout=5)
