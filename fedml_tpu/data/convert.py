@@ -1,0 +1,149 @@
+"""Offline converter CLI: raw dataset formats → TPU-ready array packs.
+
+The reference decodes raw files on every epoch inside DataLoader workers;
+our loaders (data/images.py, data/imagefolder.py) want a one-time offline
+conversion into contiguous arrays the device can slurp. This module is that
+step:
+
+    python -m fedml_tpu.data.convert imagenet-h5  <tree> <out.h5>  [--image-size 64]
+    python -m fedml_tpu.data.convert imagenet-npz <tree> <out.npz> [--image-size 64]
+    python -m fedml_tpu.data.convert landmarks <images_dir> <split_csv> <out_dir>
+
+- ``imagenet-h5`` writes the reference's hdf5 pack layout
+  (datasets_hdf5.py: train_img/train_labels/val_img/val_labels), chunked so
+  the streaming reader (imagefolder.Hdf5ImageNetSource) can slice it.
+- ``imagenet-npz`` writes the x_train/y_train/x_test/y_test pack
+  data/images.py ``_load_pack`` expects.
+- ``landmarks`` decodes ``<images_dir>/<image_id>.jpg`` for every image id in
+  the federated split csv (reference Landmarks/data_loader.py mapping files;
+  fetched by data/gld/download_from_aws_s3.sh) into ``landmarks.npz`` +
+  ``image_ids.txt``, the pair load_partition_data_landmarks reads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+import numpy as np
+
+from fedml_tpu.data.imagefolder import decode_image, scan_image_tree
+from fedml_tpu.data.images import read_landmarks_csv
+
+
+def convert_imagenet_tree_h5(data_dir: str, out_path: str,
+                             image_size: int = 64, normalize: bool = False,
+                             chunk: int = 256) -> None:
+    """ImageFolder tree → hdf5 pack, streamed (never the whole split in
+    RAM). Stored unnormalized by default so the pack is dtype-compact."""
+    import h5py
+
+    with h5py.File(out_path, "w", libver="latest") as f:
+        for split, key in (("train", "train"), ("val", "val")):
+            samples, _, _ = scan_image_tree(os.path.join(data_dir, split))
+            n = len(samples)
+            dimg = f.create_dataset(
+                f"{key}_img", shape=(n, image_size, image_size, 3),
+                dtype=np.float32,
+                chunks=(min(chunk, n), image_size, image_size, 3))
+            f.create_dataset(f"{key}_labels",
+                             data=np.asarray([c for _, c in samples],
+                                             np.int32))
+            buf: List[np.ndarray] = []
+            start = 0
+            for path, _ in samples:
+                buf.append(decode_image(path, image_size, normalize))
+                if len(buf) == chunk:
+                    dimg[start:start + len(buf)] = np.stack(buf)
+                    start += len(buf)
+                    buf.clear()
+            if buf:
+                dimg[start:start + len(buf)] = np.stack(buf)
+        f.attrs["image_size"] = image_size
+        f.attrs["normalized"] = normalize
+
+
+def convert_imagenet_tree_npz(data_dir: str, out_path: str,
+                              image_size: int = 64,
+                              normalize: bool = False) -> None:
+    from fedml_tpu.data.imagefolder import load_imagefolder_split
+
+    x_train, y_train = load_imagefolder_split(
+        os.path.join(data_dir, "train"), image_size, normalize)
+    x_test, y_test = load_imagefolder_split(
+        os.path.join(data_dir, "val"), image_size, normalize)
+    np.savez_compressed(out_path, x_train=x_train, y_train=y_train,
+                        x_test=x_test, y_test=y_test)
+
+
+def convert_landmarks(images_dir: str, split_csv: str, out_dir: str,
+                      image_size: int = 64, normalize: bool = False) -> None:
+    """Landmarks image dir + federated split csv → (landmarks.npz,
+    image_ids.txt) for load_partition_data_landmarks."""
+    users = read_landmarks_csv(split_csv)
+    image_ids: List[str] = []
+    seen = set()
+    for entries in users.values():
+        for image_id, _ in entries:
+            if image_id not in seen:
+                seen.add(image_id)
+                image_ids.append(image_id)
+
+    arrays, kept = [], []
+    for image_id in image_ids:
+        for ext in (".jpg", ".jpeg", ".png"):
+            path = os.path.join(images_dir, image_id + ext)
+            if os.path.exists(path):
+                arrays.append(decode_image(path, image_size, normalize))
+                kept.append(image_id)
+                break
+    if not arrays:
+        raise RuntimeError(f"no images from {split_csv} found under "
+                           f"{images_dir}")
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez_compressed(os.path.join(out_dir, "landmarks.npz"),
+                        images=np.stack(arrays))
+    with open(os.path.join(out_dir, "image_ids.txt"), "w") as f:
+        f.write("\n".join(kept) + "\n")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("python -m fedml_tpu.data.convert")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("imagenet-h5")
+    p.add_argument("data_dir")
+    p.add_argument("out_path")
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--normalize", action="store_true")
+
+    p = sub.add_parser("imagenet-npz")
+    p.add_argument("data_dir")
+    p.add_argument("out_path")
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--normalize", action="store_true")
+
+    p = sub.add_parser("landmarks")
+    p.add_argument("images_dir")
+    p.add_argument("split_csv")
+    p.add_argument("out_dir")
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--normalize", action="store_true")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "imagenet-h5":
+        convert_imagenet_tree_h5(args.data_dir, args.out_path,
+                                 args.image_size, args.normalize)
+    elif args.cmd == "imagenet-npz":
+        convert_imagenet_tree_npz(args.data_dir, args.out_path,
+                                  args.image_size, args.normalize)
+    elif args.cmd == "landmarks":
+        convert_landmarks(args.images_dir, args.split_csv, args.out_dir,
+                          args.image_size, args.normalize)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

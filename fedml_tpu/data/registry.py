@@ -87,6 +87,34 @@ def _img_blob(data_dir, **kw):
         partition_alpha=kw.get("partition_alpha", 0.5))
 
 
+def _imagenet_tree(data_dir, **kw):
+    from fedml_tpu.data.imagefolder import load_partition_data_imagenet_tree
+    return load_partition_data_imagenet_tree(
+        data_dir, client_number=kw.get("client_num_in_total", 100),
+        image_size=kw.get("image_size", 64))
+
+
+def _imagenet_hdf5(data_dir, **kw):
+    from fedml_tpu.data.imagefolder import load_partition_data_imagenet_hdf5
+    return load_partition_data_imagenet_hdf5(
+        data_dir, client_number=kw.get("client_num_in_total", 100))
+
+
+def _imagenet_pack(data_dir, **kw):
+    from fedml_tpu.data.images import load_partition_data_imagenet
+    return load_partition_data_imagenet(
+        data_dir, client_number=kw.get("client_num_in_total", 100),
+        partition_method=kw.get("partition_method", "hetero"),
+        partition_alpha=kw.get("partition_alpha", 0.5))
+
+
+def _landmarks(data_dir, **kw):
+    from fedml_tpu.data.images import load_partition_data_landmarks
+    return load_partition_data_landmarks(
+        data_dir, kw.get("split_csv", "federated_train.csv"),
+        class_num=kw.get("class_num", 2028))
+
+
 LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "mnist": _mnist,
     "shakespeare": _shakespeare,
@@ -101,6 +129,12 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "blob": _blob,                      # test/bench workhorse
     "seg_shapes": _seg_shapes,          # synthetic segmentation (fedseg)
     "img_blob": _img_blob,              # synthetic NHWC image classification
+    # reference --dataset names for the ImageNet/Landmarks family
+    "ILSVRC2012": _imagenet_tree,       # raw ImageFolder tree
+    "ILSVRC2012_hdf5": _imagenet_hdf5,  # streaming hdf5 pack
+    "ILSVRC2012_pack": _imagenet_pack,  # preconverted npz/h5 array pack
+    "gld23k": _landmarks,
+    "gld160k": _landmarks,
 }
 
 # reference --dataset name -> (model factory name, task head)
