@@ -117,7 +117,8 @@ class FedAvgServerManager(ServerManager):
     def __init__(self, rank: int, size: int, com_manager,
                  aggregator: FedAvgAggregator, comm_round: int,
                  client_num_in_total: int, global_model,
-                 on_round_done=None):
+                 on_round_done=None, checkpoint_mgr=None,
+                 resume: bool = False):
         super().__init__(rank, size, com_manager)
         self.aggregator = aggregator
         self.comm_round = comm_round
@@ -126,16 +127,35 @@ class FedAvgServerManager(ServerManager):
         self.round_idx = 0
         self.on_round_done = on_round_done
         self.worker_num = size - 1
+        self.checkpoint_mgr = checkpoint_mgr
+        if checkpoint_mgr is not None and resume:
+            # resume = restart the protocol at the checkpointed round: the
+            # init broadcast carries (restored model, restored round), and
+            # since sampling + client RNG derive from the round index the
+            # continuation is bit-identical to an uninterrupted run
+            restored = checkpoint_mgr.restore_latest(
+                {"variables": self.global_model})
+            if restored:
+                state, meta = restored
+                self.global_model = state["variables"]
+                self.round_idx = meta["round_idx"]
 
     def send_init_msg(self) -> None:
+        if self.round_idx >= self.comm_round:
+            # resumed from a checkpoint of an already-finished run
+            for worker in range(1, self.size):
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
+            self.finish()
+            return
         idxs = self.aggregator.client_sampling(
-            0, self.client_num_in_total, self.worker_num)
+            self.round_idx, self.client_num_in_total, self.worker_num)
         payload = _to_numpy(self.global_model)
         for worker in range(1, self.size):
             msg = Message(MSG_TYPE_S2C_INIT_CONFIG, self.rank, worker)
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
-            msg.add(MSG_ARG_KEY_ROUND, 0)
+            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
             self.send_message(msg)
 
     def register_message_receive_handlers(self) -> None:
@@ -163,6 +183,9 @@ class FedAvgServerManager(ServerManager):
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.global_model)
         self.round_idx += 1
+        if self.checkpoint_mgr is not None:
+            self.checkpoint_mgr.save(self.round_idx,
+                                     {"variables": self.global_model})
         if self.round_idx == self.comm_round:
             for worker in range(1, self.size):
                 self.send_message(
@@ -239,7 +262,9 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           train_cfg: Optional[TrainConfig] = None,
                           backend: str = "INPROC",
                           addresses=None, wire_codec: bool = True,
-                          compress: bool = False, token=None):
+                          compress: bool = False, token=None,
+                          checkpoint_dir: Optional[str] = None,
+                          resume: bool = False):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -271,13 +296,20 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                 max(1.0, float(stats["count"])),
             })
 
+    checkpoint_mgr = None
+    if checkpoint_dir:
+        from fedml_tpu.utils.checkpoint import CheckpointManager
+        checkpoint_mgr = CheckpointManager(checkpoint_dir)
+
     aggregator = FedAvgAggregator(worker_num)
     server_com = create_comm_manager(backend, 0, size, router=router,
                                      addresses=addresses,
                                      wire_codec=wire_codec, token=token)
     server = FedAvgServerManager(0, size, server_com, aggregator, comm_round,
                                  dataset.client_num, global_model,
-                                 on_round_done=on_round_done)
+                                 on_round_done=on_round_done,
+                                 checkpoint_mgr=checkpoint_mgr,
+                                 resume=resume)
     clients = []
     for rank in range(1, size):
         com = create_comm_manager(backend, rank, size, router=router,

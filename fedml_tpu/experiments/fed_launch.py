@@ -91,15 +91,13 @@ def run_algo(args):
                   seed=args.seed, train=tcfg)
 
     if args.algo == "fedavg":
-        from fedml_tpu.experiments.main_fedavg import (
-            BACKEND_RUNNERS, warn_unsupported_checkpointing)
-        warn_unsupported_checkpointing(args)
+        from fedml_tpu.experiments.main_fedavg import BACKEND_RUNNERS
         final = BACKEND_RUNNERS[args.backend](args, ds, model, task, sink)
         sink.finish()
         return final
     if args.checkpoint_dir:
-        logging.warning("--checkpoint_dir is only wired for --algo fedavg "
-                        "--backend simulation; ignoring for %r", args.algo)
+        logging.warning("--checkpoint_dir is only wired for --algo fedavg; "
+                        "ignoring for %r", args.algo)
     if args.algo == "fedopt":
         from fedml_tpu.algorithms.fedopt import FedOptAPI, FedOptConfig
         api = FedOptAPI(ds, model, task=task, config=FedOptConfig(

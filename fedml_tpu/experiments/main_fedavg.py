@@ -68,7 +68,9 @@ def run_spmd(args, ds, model, task, sink):
         frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
         train=make_train_config(args))
     api = DistributedFedAvgAPI(ds, model, task=task, config=cfg)
-    final = api.train()
+    mgr = (CheckpointManager(args.checkpoint_dir)
+           if args.checkpoint_dir else None)
+    final = api.train(checkpoint_mgr=mgr, resume=args.resume)
     for rec in api.history:
         sink.log(rec, step=rec["round"])
     return final
@@ -85,7 +87,8 @@ def run_cross_silo(args, ds, model, task, sink):
         ds, model, task=task, worker_num=args.client_num_per_round,
         comm_round=args.comm_round, train_cfg=make_train_config(args),
         backend=args.backend, addresses=addresses,
-        compress=getattr(args, "compress", False))
+        compress=getattr(args, "compress", False),
+        checkpoint_dir=args.checkpoint_dir, resume=args.resume)
     for rec in history:
         sink.log(rec, step=rec["round"])
     return history[-1] if history else {}
@@ -102,14 +105,6 @@ def apply_ci_truncation(args):
     return args
 
 
-def warn_unsupported_checkpointing(args):
-    if args.checkpoint_dir and args.backend != "simulation":
-        logging.warning(
-            "--checkpoint_dir/--resume are only wired for "
-            "--backend simulation; backend %r will not checkpoint",
-            args.backend)
-
-
 # shared with fed_launch so the two entry points cannot drift
 BACKEND_RUNNERS = {"simulation": run_simulation, "spmd": run_spmd,
                    "inproc": run_cross_silo, "tcp": run_cross_silo,
@@ -121,7 +116,6 @@ def main(argv=None):
     add_federated_args(parser)
     args = apply_ci_truncation(parser.parse_args(argv))
     logging.basicConfig(level=logging.INFO)
-    warn_unsupported_checkpointing(args)
     ds, model, task = build_dataset_and_model(args)
     sink = MetricsSink(args.run_dir, config=vars(args),
                        use_wandb=args.use_wandb)

@@ -215,10 +215,22 @@ class DistributedFedAvgAPI:
             put(jnp.asarray(mask)), put(keys), put(jnp.asarray(weights)))
         return idxs, stats
 
-    def train(self) -> Dict:
+    def train(self, checkpoint_mgr=None, resume: bool = False) -> Dict:
+        """Round loop with optional round-level checkpoint/resume: client
+        sampling and per-client RNG are (seed, round)-derived, so restarting
+        from ``(round_idx, variables)`` is bit-identical to never stopping
+        (utils/checkpoint.py)."""
         from fedml_tpu.algorithms.fedavg import _normalized
         cfg = self.config
-        for round_idx in range(cfg.comm_round):
+        start = 0
+        if checkpoint_mgr is not None and resume:
+            restored = checkpoint_mgr.restore_latest(
+                {"variables": self.variables})
+            if restored:
+                state, meta = restored
+                self.variables = state["variables"]
+                start = meta["round_idx"]
+        for round_idx in range(start, cfg.comm_round):
             _, stats = self.run_round(round_idx)
             last = round_idx == cfg.comm_round - 1
             if round_idx % cfg.frequency_of_the_test == 0 or last:
@@ -231,4 +243,7 @@ class DistributedFedAvgAPI:
                         self.variables, jnp.asarray(xt), jnp.asarray(yt),
                         jnp.ones(len(xt), jnp.float32)), "test"))
                 self.history.append(rec)
+            if checkpoint_mgr is not None:
+                checkpoint_mgr.save(round_idx + 1,
+                                    {"variables": self.variables})
         return self.history[-1] if self.history else {}

@@ -1,0 +1,95 @@
+"""Kill-and-resume parity for the spmd and cross-silo backends
+(VERDICT round-1 item 5): a run checkpointed at round k and restarted must
+produce bit-identical final weights to an uninterrupted run, because client
+sampling and all client RNG derive from (seed, round_idx)."""
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.trainer.functional import TrainConfig
+from fedml_tpu.utils.checkpoint import CheckpointManager
+
+
+@pytest.fixture(scope="module")
+def federation(small_dataset):
+    return small_dataset
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestSpmdResume:
+    def _api(self, ds, comm_round):
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig)
+        return DistributedFedAvgAPI(
+            ds, LogisticRegression(num_classes=ds.class_num),
+            config=DistributedFedAvgConfig(
+                comm_round=comm_round, client_num_per_round=4,
+                frequency_of_the_test=10,
+                train=TrainConfig(epochs=1, batch_size=8, lr=0.1)))
+
+    def test_resume_is_bit_identical(self, federation, tmp_path):
+        ds = federation
+        # uninterrupted 4-round run
+        full = self._api(ds, 4)
+        full.train()
+
+        # "killed" after round 2: checkpoints exist for rounds 1 and 2
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        first = self._api(ds, 2)
+        first.train(checkpoint_mgr=mgr)
+        assert mgr.latest_round() == 2
+
+        # fresh process: new API, resume from the latest checkpoint
+        resumed = self._api(ds, 4)
+        resumed.train(checkpoint_mgr=mgr, resume=True)
+        _tree_equal(resumed.variables, full.variables)
+        assert mgr.latest_round() == 4
+
+    def test_resume_without_checkpoint_starts_fresh(self, federation,
+                                                    tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        api = self._api(federation, 1)
+        api.train(checkpoint_mgr=mgr, resume=True)  # no checkpoint yet: ok
+        assert mgr.latest_round() == 1
+
+
+class TestCrossSiloResume:
+    def _run(self, ds, comm_round, checkpoint_dir=None, resume=False):
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        return run_fedavg_cross_silo(
+            ds, LogisticRegression(num_classes=ds.class_num),
+            worker_num=2, comm_round=comm_round,
+            train_cfg=TrainConfig(epochs=1, batch_size=8, lr=0.1),
+            backend="INPROC", checkpoint_dir=checkpoint_dir, resume=resume)
+
+    def test_resume_is_bit_identical(self, federation, tmp_path):
+        ds = federation
+        full_model, _ = self._run(ds, 4)
+
+        ckdir = str(tmp_path / "silo_ck")
+        self._run(ds, 2, checkpoint_dir=ckdir)
+        assert CheckpointManager(ckdir).latest_round() == 2
+
+        resumed_model, history = self._run(ds, 4, checkpoint_dir=ckdir,
+                                           resume=True)
+        _tree_equal(resumed_model, full_model)
+        # the resumed protocol ran only rounds 2..3
+        assert [h["round"] for h in history] == [2, 3]
+
+    def test_resume_of_finished_run_is_noop(self, federation, tmp_path):
+        ds = federation
+        ckdir = str(tmp_path / "done_ck")
+        model_a, _ = self._run(ds, 2, checkpoint_dir=ckdir)
+        model_b, history = self._run(ds, 2, checkpoint_dir=ckdir,
+                                     resume=True)
+        _tree_equal(model_a, model_b)
+        assert history == []
