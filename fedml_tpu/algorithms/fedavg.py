@@ -75,6 +75,11 @@ class FedAvgConfig:
     client_num_per_round: int = 10
     frequency_of_the_test: int = 5
     seed: int = 0
+    # evaluate train metrics on a fixed seeded subsample of the global train
+    # union instead of sweeping all of it every test round (the reference
+    # subsamples evaluation the same way for its largest federation,
+    # fedavg_api.py:115 _generate_validation_set). None = full union.
+    eval_train_subsample: Optional[int] = None
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 
 
@@ -118,7 +123,10 @@ class FedAvgAPI:
             new_vars = hook(variables, stacked, weights, agg_key)
             return new_vars, totals
 
-        self._round_fn = jax.jit(round_fn)
+        # donate the variables buffer: the old global model is dead the
+        # moment the round closes, so XLA reuses its HBM for the new one
+        # instead of holding both live (free bandwidth on big models)
+        self._round_fn = jax.jit(round_fn, donate_argnums=(0,))
         self._eval_fn = jax.jit(make_eval(module, task))
         self._n_pad = dataset.padded_len(cfg.batch_size)
         self._base_key = jax.random.key(self.config.seed)
@@ -132,6 +140,11 @@ class FedAvgAPI:
         # device-side analogue of the reference's update_dataset re-pointing
         # (FedAVGTrainer.py:25-30)
         self._pack_cache = None
+        # eval arrays live on device across test rounds (re-uploading the
+        # global unions every evaluation dominated host time on image sets)
+        self._eval_cache = None
+        from fedml_tpu.utils.tracing import RoundTimer
+        self.timer = RoundTimer()
 
     # -- one round ---------------------------------------------------------
     def _prepare_round(self, round_idx: int):
@@ -169,10 +182,13 @@ class FedAvgAPI:
         return idxs, (xd, yd, maskd, keys, wd, agg_key)
 
     def run_round(self, round_idx: int):
-        idxs, (x, y, mask, keys, weights, agg_key) = self._prepare_round(
-            round_idx)
-        self.variables, stats = self._round_fn(self.variables, x, y, mask,
-                                               keys, weights, agg_key)
+        with self.timer.phase("pack"):
+            idxs, (x, y, mask, keys, weights, agg_key) = self._prepare_round(
+                round_idx)
+        with self.timer.phase("dispatch"):
+            self.variables, stats = self._round_fn(self.variables, x, y,
+                                                   mask, keys, weights,
+                                                   agg_key)
         return idxs, stats
 
     # -- the outer loop (reference fedavg_api.py:46-95) ---------------------
@@ -183,30 +199,49 @@ class FedAvgAPI:
             _, train_stats = self.run_round(round_idx)
             last = round_idx == cfg.comm_round - 1
             if round_idx % cfg.frequency_of_the_test == 0 or last:
-                rec = self.evaluate(round_idx)
+                with self.timer.phase("eval"):
+                    rec = self.evaluate(round_idx)
                 # mean local-optimization loss this round (distinct from the
                 # post-aggregation train_loss evaluate() reports)
                 rec["train_loss_local"] = float(train_stats["loss_sum"]) / max(
                     1.0, float(train_stats["count"]))
                 rec["wall_s"] = time.time() - t0
+                # host/device phase breakdown (pack / dispatch / eval means)
+                rec.update({f"phase_{k}_ms": v * 1e3
+                            for k, v in self.timer.means().items()})
                 self.history.append(rec)
                 logging.info("round %d: %s", round_idx, rec)
         return self.history[-1] if self.history else {}
 
     # -- evaluation (reference _local_test_on_all_clients; the per-client
     #    weighted sums equal the global-union sums, so we evaluate globally) --
+    def _eval_arrays(self):
+        """Device-resident eval unions, uploaded once per dataset (with the
+        optional seeded train subsample)."""
+        if self._eval_cache is None or self._eval_cache[0] is not self.dataset:
+            xg, yg = self.dataset.train_data_global
+            sub = self.config.eval_train_subsample
+            if sub and len(xg) > sub:
+                sel = np.random.RandomState(self.config.seed).choice(
+                    len(xg), sub, replace=False)
+                xg, yg = xg[sel], yg[sel]
+            train = (jnp.asarray(xg), jnp.asarray(yg),
+                     jnp.ones(len(xg), jnp.float32))
+            xt, yt = self.dataset.test_data_global
+            test = ((jnp.asarray(xt), jnp.asarray(yt),
+                     jnp.ones(len(xt), jnp.float32)) if len(xt) else None)
+            self._eval_cache = (self.dataset, train, test)
+        return self._eval_cache[1], self._eval_cache[2]
+
     def evaluate(self, round_idx: int) -> Dict:
         """Normalized federation metrics: {train,test}_{acc,loss,total} as
         means over the global train/test unions (equal to the reference's
         per-client weighted sums in _local_test_on_all_clients)."""
         rec = {"round": round_idx}
-        xg, yg = self.dataset.train_data_global
-        rec.update(_normalized(self._eval_fn(
-            self.variables, jnp.asarray(xg), jnp.asarray(yg),
-            jnp.ones(len(xg), jnp.float32)), "train"))
-        xt, yt = self.dataset.test_data_global
-        if len(xt):
-            rec.update(_normalized(self._eval_fn(
-                self.variables, jnp.asarray(xt), jnp.asarray(yt),
-                jnp.ones(len(xt), jnp.float32)), "test"))
+        train, test = self._eval_arrays()
+        rec.update(_normalized(self._eval_fn(self.variables, *train),
+                               "train"))
+        if test is not None:
+            rec.update(_normalized(self._eval_fn(self.variables, *test),
+                                   "test"))
         return rec

@@ -74,13 +74,17 @@ def _weighted_psum_mean(stacked, weights, axes: Tuple[str, ...]):
 
 
 def make_spmd_round(module, task: str, cfg: TrainConfig, mesh: Mesh,
-                    axis: str = "clients"):
+                    axis: str = "clients", donate: bool = False):
     """Compile one FedAvg round over ``mesh[axis]``.
 
     Inputs are client-major: x [P, n_pad, ...], y, mask, keys, weights with
     P = clients_per_round (a multiple of the axis size; each device trains
     P/axis_size clients via vmap). Returns (replicated new variables,
     psum-reduced train stats).
+
+    ``donate=True`` lets XLA reuse the incoming variables' HBM for the new
+    model (the driver overwrites its reference each round); leave False when
+    the caller reuses the same variables across calls (parity tests).
     """
     local_train = make_local_train(module, task, cfg)
 
@@ -98,11 +102,12 @@ def make_spmd_round(module, task: str, cfg: TrainConfig, mesh: Mesh,
         body, mesh=mesh,
         in_specs=(P(), sharded, sharded, sharded, sharded, sharded),
         out_specs=(P(), P()),
-    ))
+    ), donate_argnums=(0,) if donate else ())
 
 
 def make_hierarchical_spmd_round(module, task: str, cfg: TrainConfig,
-                                 mesh: Mesh, group_comm_round: int = 1):
+                                 mesh: Mesh, group_comm_round: int = 1,
+                                 donate: bool = False):
     """Two-tier FedAvg round on a ('group', 'clients') mesh: run
     ``group_comm_round`` edge rounds (train + psum over 'clients' within each
     group), then one cloud aggregation (psum over 'group') — the reference's
@@ -146,7 +151,7 @@ def make_hierarchical_spmd_round(module, task: str, cfg: TrainConfig,
         body, mesh=mesh,
         in_specs=(P(), sharded, sharded, sharded, sharded, sharded),
         out_specs=(P(), P()),
-    ))
+    ), donate_argnums=(0,) if donate else ())
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,7 +181,7 @@ class DistributedFedAvgAPI:
         self.mesh = mesh or build_mesh({"clients": len(jax.devices())})
         self.n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
         self._round_fn = make_spmd_round(module, task, self.config.train,
-                                         self.mesh)
+                                         self.mesh, donate=True)
         self._eval_fn = jax.jit(make_eval(module, task))
         self._n_pad = dataset.padded_len(self.config.train.batch_size)
         self._base_key = jax.random.key(self.config.seed)

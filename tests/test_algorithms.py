@@ -156,8 +156,9 @@ class TestRobustFedAvg:
                                    config=FedAvgRobustConfig(
                                        defense_type="norm_diff_clipping",
                                        norm_bound=bound, train=tc, **shared))
-        w0_u = undefended.variables
-        w0_d = defended.variables
+        # the round donates the variables buffer — snapshot by copy
+        w0_u = jax.tree.map(jnp.copy, undefended.variables)
+        w0_d = jax.tree.map(jnp.copy, defended.variables)
         undefended.run_round(0)
         defended.run_round(0)
         step_u = float(pt.tree_norm(pt.tree_sub(undefended.variables, w0_u)))
