@@ -1,25 +1,38 @@
-"""Benchmark: FedAvg rounds/sec on the FEMNIST+CNN headline config.
+"""Benchmark suite v2 — flagship FedAvg throughput with MFU, plus
+ResNet-18-GN, transformer flash-attention, and time-to-target-accuracy.
 
-Workload (BASELINE.md cross-device row): 10 clients/round, B=20, E=1, the
-2-conv CNN_DropOut (1.2M params, 62 classes), ~340 samples/client — one full
-FedAvg round including host-side client packing, host->device transfer, local
-SGD for all sampled clients, and weighted aggregation.
+Workloads (BASELINE.md rows):
+1. ``fedavg_femnist_cnn`` (headline): 10 clients/round, B=20, E=1, the
+   2-conv CNN_DropOut (~1.2M params, 62 classes), ~340 samples/client — one
+   full FedAvg round = host packing + transfer + local SGD for every sampled
+   client + weighted aggregation, all one jitted program. Reported with the
+   XLA cost model's FLOPs/round (utils/flops.cost_analysis) and MFU against
+   the chip's bf16 peak.
+2. ``resnet18_gn_fedcifar100``: same round shape at fed-CIFAR100 scale
+   (ResNet-18 + GroupNorm, 24x24x3, B=20) — the heavier conv workload.
+3. ``transformer_flash_s2048``: causal LM train step (4-layer, width 256,
+   S=2048) with the Pallas flash-attention kernel; tokens/s plus the
+   speedup over the XLA reference attention.
+4. ``time_to_target_acc``: seconds for the seeded blob federation to reach
+   92% test accuracy (BASELINE.md names time-to-target as a north-star
+   metric; the federation is fully reproducible, seed=3).
 
-Ours: the whole round is ONE jitted program (vmapped clients + weighted tree
-mean) on the TPU chip. Baseline: a faithful reference-style implementation —
-sequential per-client torch training loops + state_dict averaging on the host
-(the reference's standalone simulation semantics, fedml_api/standalone/fedavg/
-fedavg_api.py:46-141) — measured on this machine's CPU (the reference's GPU
-hardware is not available here; the baseline number is therefore generous to
-us on conv nets and is recorded for trend tracking across rounds, not as an
-8xA100 claim).
+``vs_baseline`` on the headline metric is measured against a faithful
+reference-style sequential torch simulation **on this machine's CPU**
+(fedml_api/standalone/fedavg/fedavg_api.py:46-141 semantics). The
+reference's published hardware (4x RTX 2080Ti / A100s) is not reachable
+from this box, so that ratio is a trend-tracking number, NOT an
+8xA100 claim — it is labeled ``torch_cpu_this_host`` in the extras.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", "extra": {...per-workload...}}.
+Full details land in runs/bench_details.json.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -29,54 +42,212 @@ CLIENTS_PER_ROUND = 10
 SAMPLES_PER_CLIENT = 340
 BATCH = 20
 CLASSES = 62
-TIMED_ROUNDS = 100  # rounds are ~3 ms on-chip; a long window beats noise
 BASELINE_ROUNDS = 2
 
+# bf16 peak TFLOP/s per chip by device_kind substring (public specs).
+# MFU is reported against bf16 peak even for f32 programs — conservative.
+_PEAK_TFLOPS = [("v6", 918.0), ("v5p", 459.0), ("v5", 197.0),
+                ("v4", 275.0), ("v3", 61.4), ("v2", 23.0)]
 
-def make_data(seed: int = 0):
+
+def _device_peak_tflops() -> float:
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return float("nan")  # CPU or unknown: MFU not meaningful
+
+
+def _is_tpu() -> bool:
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def make_data(seed: int = 0, hw: int = 28, chans: int = 1,
+              classes: int = CLASSES, samples: int = SAMPLES_PER_CLIENT):
     rng = np.random.RandomState(seed)
-    x = rng.randn(CLIENTS_PER_ROUND, SAMPLES_PER_CLIENT, 28, 28, 1).astype(
+    x = rng.randn(CLIENTS_PER_ROUND, samples, hw, hw, chans).astype(
         np.float32)
-    y = rng.randint(0, CLASSES,
-                    (CLIENTS_PER_ROUND, SAMPLES_PER_CLIENT)).astype(np.int32)
+    y = rng.randint(0, classes,
+                    (CLIENTS_PER_ROUND, samples)).astype(np.int32)
     return x, y
 
 
-def bench_ours() -> float:
-    import jax
-    import jax.numpy as jnp
-
+def _make_api(model_name: str, hw: int, chans: int, classes: int,
+              timed_rounds: int, samples: int = SAMPLES_PER_CLIENT):
     from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
     from fedml_tpu.data.base import FederatedDataset
     from fedml_tpu.models import create_model
     from fedml_tpu.trainer.functional import TrainConfig
 
-    x, y = make_data()
+    x, y = make_data(hw=hw, chans=chans, classes=classes, samples=samples)
     train_local = {c: (x[c], y[c]) for c in range(CLIENTS_PER_ROUND)}
     ds = FederatedDataset.from_client_arrays(
-        train_local, {c: None for c in range(CLIENTS_PER_ROUND)}, CLASSES)
-    model = create_model("cnn", output_dim=CLASSES)
+        train_local, {c: None for c in range(CLIENTS_PER_ROUND)}, classes)
+    model = create_model(model_name, output_dim=classes)
     api = FedAvgAPI(ds, model, config=FedAvgConfig(
-        comm_round=TIMED_ROUNDS, client_num_per_round=CLIENTS_PER_ROUND,
+        comm_round=timed_rounds, client_num_per_round=CLIENTS_PER_ROUND,
         frequency_of_the_test=10**9,
         train=TrainConfig(epochs=1, batch_size=BATCH, lr=0.1)))
+    return api
+
+
+def _round_flops(api) -> float:
+    """FLOPs of the compiled round program (XLA cost model)."""
+    import jax
+
+    from fedml_tpu.utils.flops import cost_analysis
+
+    _, args = api._prepare_round(0)
+    try:
+        costs = cost_analysis(
+            lambda v, *a: api._round_fn(v, *a), api.variables, *args)
+        return float(costs.get("flops", float("nan")))
+    except Exception:  # cost model unavailable on some backends
+        return float("nan")
+
+
+def _bench_rounds(api, timed_rounds: int) -> float:
+    import jax
 
     api.run_round(0)  # compile
     jax.block_until_ready(api.variables)
     t0 = time.perf_counter()
-    for r in range(1, TIMED_ROUNDS + 1):
+    for r in range(1, timed_rounds + 1):
         api.run_round(r)
     jax.block_until_ready(api.variables)
+    return timed_rounds / (time.perf_counter() - t0)
+
+
+def bench_fedavg_cnn() -> dict:
+    timed = 100 if _is_tpu() else 20
+    api = _make_api("cnn", 28, 1, CLASSES, timed + 1)
+    flops = _round_flops(api)
+    rps = _bench_rounds(api, timed)
+    achieved = rps * flops  # FLOP/s through the round program
+    peak = _device_peak_tflops() * 1e12
+    return {
+        "rounds_per_sec": round(rps, 3),
+        "round_flops": flops,
+        "achieved_tflops": round(achieved / 1e12, 3),
+        "mfu": round(achieved / peak, 4) if peak == peak else None,
+        "phase_ms": {k: round(v * 1e3, 3)
+                     for k, v in api.timer.means().items()},
+    }
+
+
+def bench_resnet18_gn() -> dict:
+    timed = 20 if _is_tpu() else 3
+    api = _make_api("resnet18_gn", 24, 3, 100, timed + 1,
+                    samples=5 * BATCH)
+    flops = _round_flops(api)
+    rps = _bench_rounds(api, timed)
+    achieved = rps * flops
+    peak = _device_peak_tflops() * 1e12
+    return {
+        "rounds_per_sec": round(rps, 3),
+        "round_flops": flops,
+        "achieved_tflops": round(achieved / 1e12, 3),
+        "mfu": round(achieved / peak, 4) if peak == peak else None,
+    }
+
+
+def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
+                            steps: int = 10) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.ops.flash_attention import flash_attention
+
+    interpret = not _is_tpu()
+    if interpret:
+        seq_len, batch, steps = 512, 2, 2  # CPU smoke shapes
+
+    def flash_fn(q, k, v, causal=True):
+        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+
+    vocab = 1024
+    tokens = np.random.RandomState(0).randint(
+        0, vocab, (batch, seq_len)).astype(np.int32)
+
+    def tokens_per_sec(attn_fn) -> float:
+        model = TransformerLM(vocab_size=vocab, width=256, depth=4,
+                              num_heads=4, max_len=seq_len, attn_fn=attn_fn)
+        variables = model.init(jax.random.key(0), jnp.asarray(tokens[:1]),
+                               train=False)
+
+        @jax.jit
+        def step(v, x):
+            def loss(params):
+                logits = model.apply({"params": params}, x, train=False)
+                return jnp.mean(
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        logits[:, :-1], x[:, 1:]))
+            g = jax.grad(loss)(v["params"])
+            return {"params": jax.tree.map(
+                lambda p, gg: p - 1e-3 * gg, v["params"], g)}
+
+        x = jnp.asarray(tokens)
+        variables = step(variables, x)  # compile
+        jax.block_until_ready(variables)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            variables = step(variables, x)
+        jax.block_until_ready(variables)
+        return steps * batch * seq_len / (time.perf_counter() - t0)
+
+    flash_tps = tokens_per_sec(flash_fn)
+    ref_tps = tokens_per_sec(None)  # default = XLA reference attention
+    return {
+        "tokens_per_sec": round(flash_tps, 1),
+        "seq_len": seq_len,
+        "speedup_vs_reference_attention": round(flash_tps / ref_tps, 3),
+    }
+
+
+def bench_time_to_target(target_acc: float = 0.92, max_rounds: int = 60
+                         ) -> dict:
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+
+    ds = make_blob_federated(client_num=10, dim=32, class_num=8,
+                             n_samples=4000, seed=3)
+    api = FedAvgAPI(ds, LogisticRegression(num_classes=ds.class_num),
+                    config=FedAvgConfig(
+                        comm_round=max_rounds, client_num_per_round=10,
+                        frequency_of_the_test=10**9,
+                        train=TrainConfig(epochs=1, batch_size=32, lr=0.3)))
+    api.run_round(0)  # compile (excluded: TTA measures the steady state)
+    api.evaluate(0)
+    jax.block_until_ready(api.variables)
+
+    t0 = time.perf_counter()
+    reached = None
+    for r in range(1, max_rounds + 1):
+        api.run_round(r)
+        acc = api.evaluate(r).get("test_acc", 0.0)
+        if acc >= target_acc:
+            reached = r
+            break
     dt = time.perf_counter() - t0
-    return TIMED_ROUNDS / dt
+    return {
+        "seconds_to_target": round(dt, 4) if reached else None,
+        "rounds_to_target": reached,
+        "target_acc": target_acc,
+    }
 
 
 def bench_torch_baseline() -> float:
-    """Reference-style sequential simulation (torch CPU)."""
+    """Reference-style sequential simulation (torch CPU, this host)."""
     import torch
     import torch.nn as tnn
-
-    torch.set_num_threads(max(1, torch.get_num_threads()))
 
     class CNN(tnn.Module):
         def __init__(self):
@@ -118,24 +289,42 @@ def bench_torch_baseline() -> float:
                 crit(model(xb), yb).backward()
                 opt.step()
             locals_sd.append(
-                {k: v.detach().clone() for k, v in model.state_dict().items()})
+                {k: v.detach().clone()
+                 for k, v in model.state_dict().items()})
         global_sd = {
             k: sum(sd[k] for sd in locals_sd) / len(locals_sd)
             for k in global_sd
         }
-    dt = time.perf_counter() - t0
-    return BASELINE_ROUNDS / dt
+    return BASELINE_ROUNDS / (time.perf_counter() - t0)
 
 
 def main():
-    ours = bench_ours()
+    flagship = bench_fedavg_cnn()
+    resnet = bench_resnet18_gn()
+    transformer = bench_transformer_flash()
+    tta = bench_time_to_target()
     base = bench_torch_baseline()
-    print(json.dumps({
+
+    extra = {
+        "fedavg_femnist_cnn": flagship,
+        "resnet18_gn_fedcifar100": resnet,
+        "transformer_flash_s2048": transformer,
+        "time_to_target_acc": tta,
+        "baseline_kind": "torch_cpu_this_host (reference-style sequential "
+                         "simulation; NOT the published GPU baseline)",
+        "baseline_rounds_per_sec": round(base, 3),
+    }
+    line = {
         "metric": "fedavg_rounds_per_sec_femnist_cnn",
-        "value": round(ours, 3),
+        "value": flagship["rounds_per_sec"],
         "unit": "rounds/s",
-        "vs_baseline": round(ours / base, 2),
-    }))
+        "vs_baseline": round(flagship["rounds_per_sec"] / base, 2),
+        "extra": extra,
+    }
+    os.makedirs("runs", exist_ok=True)
+    with open(os.path.join("runs", "bench_details.json"), "w") as f:
+        json.dump(line, f, indent=2)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -27,6 +27,63 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+# -- fast/slow split --------------------------------------------------------
+# `pytest -m "not slow"` is the CI lane (< 5 min on a 2023 laptop-class box);
+# the full suite runs ~25 min. Measured with --durations; regenerate the
+# lists when a module's compile load changes (threshold: ~5 s per test).
+
+SLOW_MODULES = {
+    "test_models.py",         # whole zoo compiles (~4.5 min)
+    "test_efficientnet.py",   # B0-B7 compiles (~1 min)
+    "test_fednas.py",         # DARTS/GDAS bilevel search (~5 min)
+    "test_fedgkt.py",         # client fleet + server distillation (~2 min)
+    "test_fedseg.py",         # segmentation e2e (~40 s)
+    "test_fedavg_async.py",   # quorum/async protocols (~40 s)
+    "test_transformer.py",    # LM + sequence-parallel (~30 s)
+    "test_flash_attention.py",  # Pallas interpret mode (~40 s)
+}
+
+SLOW_TESTS = {
+    "test_fedavg.py::TestFedAvgEndToEnd::test_cnn_on_image_federation",
+    "test_fedavg.py::TestFedAvgEndToEnd::test_learns_blobs_with_sampling",
+    "test_fedavg.py::TestCentralizedEquivalence::"
+    "test_accuracy_equivalence_to_three_decimals",
+    "test_fedavg.py::TestLocalTrain::"
+    "test_full_batch_sgd_matches_manual_gradient_step",
+    "test_fedavg.py::TestFlaxModelTrainerProtocol::"
+    "test_train_and_test_roundtrip",
+    "test_experiments.py::TestFedLaunch::test_fedseg_via_launcher",
+    "test_experiments.py::TestFedLaunch::test_turboaggregate_matches_fedavg",
+    "test_experiments.py::TestFedLaunch::test_fedopt",
+    "test_experiments.py::TestFedLaunch::test_robust",
+    "test_experiments.py::TestFedAvgMain::"
+    "test_resume_matches_uninterrupted_run",
+    "test_experiments.py::TestFedAvgMain::test_spmd_backend",
+    "test_split_vertical.py::TestVerticalFL::"
+    "test_party_gradient_matches_global_autograd",
+    "test_contribution.py::TestLeaveOneOut::"
+    "test_unique_client_more_influential_than_duplicate",
+    "test_comm.py::TestCrossSiloFedAvg::test_matches_standalone_simulation",
+    "test_compression.py::TestCompressedFederation::"
+    "test_fedavg_cross_silo_with_compression_converges",
+    "test_checkpoint_resume.py::TestSpmdResume::test_resume_is_bit_identical",
+    "test_checkpoint_resume.py::TestCrossSiloResume::"
+    "test_resume_is_bit_identical",
+    "test_algorithms.py::TestHierarchical::test_grouped_training_learns",
+    "test_utils.py::TestCheckpoint::test_resume_continues_identically",
+    "test_torch_import.py::test_fedgkt_warm_start",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = item.nodeid.split("::", 1)[0].rsplit("/", 1)[-1]
+        rel = fname + "::" + item.nodeid.split("::", 1)[1] \
+            if "::" in item.nodeid else fname
+        if fname in SLOW_MODULES or rel in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
