@@ -37,6 +37,9 @@ def add_federated_args(parser: argparse.ArgumentParser):
     parser.add_argument("--epochs", type=int, default=1)
     parser.add_argument("--comm_round", type=int, default=10)
     parser.add_argument("--frequency_of_the_test", type=int, default=5)
+    parser.add_argument("--eval_train_subsample", type=int, default=None,
+                        help="evaluate train metrics on a fixed seeded "
+                             "subsample of the train union (None = full)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--run_dir", type=str, default="./runs/latest")
     parser.add_argument("--use_wandb", action="store_true")

@@ -35,7 +35,10 @@ def run_simulation(args, ds, model, task, sink):
     cfg = FedAvgConfig(comm_round=args.comm_round,
                        client_num_per_round=args.client_num_per_round,
                        frequency_of_the_test=args.frequency_of_the_test,
-                       seed=args.seed, train=make_train_config(args))
+                       seed=args.seed,
+                       eval_train_subsample=getattr(
+                           args, "eval_train_subsample", None),
+                       train=make_train_config(args))
     api = FedAvgAPI(ds, model, task=task, config=cfg)
     mgr = (CheckpointManager(args.checkpoint_dir)
            if args.checkpoint_dir else None)
