@@ -190,6 +190,10 @@ class DistributedFedAvgAPI:
         self.variables = module.init(jax.random.key(self.config.seed),
                                      jnp.asarray(sample_x), train=False)
         self.history: List[Dict] = []
+        # same-cohort device cache as FedAvgAPI._pack_cache: full
+        # participation re-samples the identical set each round, so the
+        # sharded x/y/mask/weights can stay resident across rounds
+        self._pack_cache = None
 
     def _pad_round(self, idxs: np.ndarray):
         """Pad the sampled-client list to a mesh-size multiple with
@@ -206,18 +210,30 @@ class DistributedFedAvgAPI:
         cfg = self.config
         idxs = sample_clients(round_idx, self.dataset.client_num,
                               cfg.client_num_per_round)
-        padded, alive = self._pad_round(np.asarray(idxs))
-        x, y, mask = self.dataset.pack_clients(padded, cfg.train.batch_size,
-                                               n_pad=self._n_pad)
-        mask = mask * alive[:, None]
-        weights = self.dataset.client_weights(padded) * alive
+        put = lambda a: jax.device_put(a, self._data_sharding)
+        cohort = tuple(int(i) for i in idxs)
+        if (self._pack_cache is not None
+                and self._pack_cache[0] is self.dataset
+                and self._pack_cache[1] == cohort):
+            padded, xd, yd, maskd, wd = self._pack_cache[2]
+        else:
+            self._pack_cache = None
+            padded, alive = self._pad_round(np.asarray(idxs))
+            x, y, mask = self.dataset.pack_clients(
+                padded, cfg.train.batch_size, n_pad=self._n_pad)
+            mask = mask * alive[:, None]
+            weights = self.dataset.client_weights(padded) * alive
+            xd, yd, maskd, wd = (put(jnp.asarray(x)), put(jnp.asarray(y)),
+                                 put(jnp.asarray(mask)),
+                                 put(jnp.asarray(weights)))
+            if len(idxs) == self.dataset.client_num:
+                self._pack_cache = (self.dataset, cohort,
+                                    (padded, xd, yd, maskd, wd))
         round_key = jax.random.fold_in(self._base_key, round_idx)
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
-            jnp.asarray(padded, dtype=jnp.uint32))
-        put = lambda a: jax.device_put(a, self._data_sharding)
+            jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
         self.variables, stats = self._round_fn(
-            self.variables, put(jnp.asarray(x)), put(jnp.asarray(y)),
-            put(jnp.asarray(mask)), put(keys), put(jnp.asarray(weights)))
+            self.variables, xd, yd, maskd, put(keys), wd)
         return idxs, stats
 
     def train(self, checkpoint_mgr=None, resume: bool = False) -> Dict:
