@@ -87,7 +87,8 @@ def make_data(seed: int = 0, hw: int = 28, chans: int = 1,
 
 
 def _make_api(model_name: str, hw: int, chans: int, classes: int,
-              timed_rounds: int, samples: int = SAMPLES_PER_CLIENT):
+              timed_rounds: int, samples: int = SAMPLES_PER_CLIENT,
+              compute_dtype=None):
     from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
     from fedml_tpu.data.base import FederatedDataset
     from fedml_tpu.models import create_model
@@ -101,7 +102,8 @@ def _make_api(model_name: str, hw: int, chans: int, classes: int,
     api = FedAvgAPI(ds, model, config=FedAvgConfig(
         comm_round=timed_rounds, client_num_per_round=CLIENTS_PER_ROUND,
         frequency_of_the_test=10**9,
-        train=TrainConfig(epochs=1, batch_size=BATCH, lr=0.1)))
+        train=TrainConfig(epochs=1, batch_size=BATCH, lr=0.1,
+                          compute_dtype=compute_dtype)))
     return api
 
 
@@ -151,6 +153,16 @@ def bench_fedavg_cnn() -> dict:
         "phase_ms": {k: round(v * 1e3, 3)
                      for k, v in api.timer.means().items()},
     }
+
+
+def bench_fedavg_cnn_bf16() -> dict:
+    """Flagship workload with the bf16 compute path (MXU-native inputs;
+    masters stay f32). TPU-only — CPU bf16 is emulated and meaningless."""
+    if not _is_tpu():
+        return {"skipped": "bf16 path is TPU-only"}
+    api = _make_api("cnn", 28, 1, CLASSES, 101, compute_dtype="bfloat16")
+    rps = _bench_rounds(api, 100)
+    return {"rounds_per_sec": round(rps, 3)}
 
 
 def bench_resnet18_gn() -> dict:
@@ -332,6 +344,7 @@ def main():
     _log(f"backend={jax.default_backend()} "
          f"device={jax.devices()[0].device_kind!r}")
     flagship = _run("fedavg_femnist_cnn", bench_fedavg_cnn)
+    flagship_bf16 = _run("fedavg_femnist_cnn_bf16", bench_fedavg_cnn_bf16)
     resnet = _run("resnet18_gn", bench_resnet18_gn)
     transformer = _run("transformer_flash", bench_transformer_flash)
     tta = _run("time_to_target", bench_time_to_target)
@@ -340,6 +353,7 @@ def main():
 
     extra = {
         "fedavg_femnist_cnn": flagship,
+        "fedavg_femnist_cnn_bf16": flagship_bf16,
         "resnet18_gn_fedcifar100": resnet,
         "transformer_flash_s2048": transformer,
         "time_to_target_acc": tta,

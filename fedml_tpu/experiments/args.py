@@ -37,6 +37,10 @@ def add_federated_args(parser: argparse.ArgumentParser):
     parser.add_argument("--epochs", type=int, default=1)
     parser.add_argument("--comm_round", type=int, default=10)
     parser.add_argument("--frequency_of_the_test", type=int, default=5)
+    parser.add_argument("--compute_dtype", type=str, default=None,
+                        choices=[None, "bfloat16", "float32"],
+                        help="mixed precision: forward/backward dtype "
+                             "(masters stay f32)")
     parser.add_argument("--eval_train_subsample", type=int, default=None,
                         help="evaluate train metrics on a fixed seeded "
                              "subsample of the train union (None = full)")

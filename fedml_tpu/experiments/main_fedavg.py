@@ -26,7 +26,8 @@ from fedml_tpu.utils.metrics import MetricsSink
 def make_train_config(args) -> TrainConfig:
     return TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                        lr=args.lr, client_optimizer=args.client_optimizer,
-                       wd=args.wd)
+                       wd=args.wd,
+                       compute_dtype=getattr(args, "compute_dtype", None))
 
 
 def run_simulation(args, ds, model, task, sink):

@@ -39,6 +39,10 @@ class TrainConfig:
     wd: float = 0.0
     momentum: float = 0.0
     shuffle: bool = True
+    # mixed precision: run forward/backward in this dtype (e.g. "bfloat16"
+    # — the MXU's native input type) while master params, optimizer state,
+    # loss, and metrics stay float32. None = pure f32 (parity tests).
+    compute_dtype: Optional[str] = None
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
@@ -111,6 +115,17 @@ def make_local_train(module, task: str, cfg: TrainConfig):
     head: TaskHead = TASK_HEADS[task]
     forward = make_forward(module)
     tx = make_optimizer(cfg)
+    cdtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+
+    def _to_compute(tree):
+        return jax.tree.map(
+            lambda a: a.astype(cdtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+    def _to_f32(tree):
+        return jax.tree.map(
+            lambda a: a.astype(jnp.float32)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
     def local_train(variables, x, y, mask, rng):
         n_pad = x.shape[0]
@@ -130,7 +145,19 @@ def make_local_train(module, task: str, cfg: TrainConfig):
             mb = jnp.take(mask, idx, axis=0)
 
             def loss_fn(p):
-                out, new_vars = forward({"params": p, **colls}, xb, True, key)
+                if cdtype is not None:
+                    # bf16 forward/backward off f32 masters: the cast is on
+                    # the autodiff path, so grads come back f32; updated
+                    # collections (BN stats) are restored to f32 to keep the
+                    # scan carry type stable
+                    out, new_vars = forward(
+                        {"params": _to_compute(p), **_to_compute(colls)},
+                        _to_compute(xb), True, key)
+                    out = out.astype(jnp.float32)
+                    new_vars = _to_f32(new_vars)
+                else:
+                    out, new_vars = forward({"params": p, **colls}, xb,
+                                            True, key)
                 stats = head(out, yb, mb)
                 loss = stats["loss_sum"] / jnp.maximum(stats["count"], 1.0)
                 return loss, (new_vars, stats)
