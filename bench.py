@@ -88,19 +88,19 @@ def make_data(seed: int = 0, hw: int = 28, chans: int = 1,
 
 def _make_api(model_name: str, hw: int, chans: int, classes: int,
               timed_rounds: int, samples: int = SAMPLES_PER_CLIENT,
-              compute_dtype=None):
+              compute_dtype=None, clients: int = CLIENTS_PER_ROUND):
     from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
     from fedml_tpu.data.base import FederatedDataset
     from fedml_tpu.models import create_model
     from fedml_tpu.trainer.functional import TrainConfig
 
     x, y = make_data(hw=hw, chans=chans, classes=classes, samples=samples)
-    train_local = {c: (x[c], y[c]) for c in range(CLIENTS_PER_ROUND)}
+    train_local = {c: (x[c], y[c]) for c in range(clients)}
     ds = FederatedDataset.from_client_arrays(
-        train_local, {c: None for c in range(CLIENTS_PER_ROUND)}, classes)
+        train_local, {c: None for c in range(clients)}, classes)
     model = create_model(model_name, output_dim=classes)
     api = FedAvgAPI(ds, model, config=FedAvgConfig(
-        comm_round=timed_rounds, client_num_per_round=CLIENTS_PER_ROUND,
+        comm_round=timed_rounds, client_num_per_round=clients,
         frequency_of_the_test=10**9,
         train=TrainConfig(epochs=1, batch_size=BATCH, lr=0.1,
                           compute_dtype=compute_dtype)))
@@ -135,12 +135,14 @@ def _bench_rounds(api, timed_rounds: int) -> float:
 
 
 def bench_fedavg_cnn() -> dict:
-    # CPU smoke: XLA-CPU conv backward runs this round in minutes, so shrink
-    # to 2 batches/client — the CPU numbers are only a does-it-run check;
-    # the driver measures on the real chip
-    timed = 100 if _is_tpu() else 3
-    samples = SAMPLES_PER_CLIENT if _is_tpu() else 2 * BATCH
-    api = _make_api("cnn", 28, 1, CLASSES, timed + 1, samples=samples)
+    # CPU smoke: XLA-CPU conv backward runs ~1000x below the chip, so shrink
+    # to 2 clients x 2 batches — the CPU numbers are only a does-it-run
+    # check; the driver measures on the real chip
+    tpu = _is_tpu()
+    timed = 100 if tpu else 2
+    api = _make_api("cnn", 28, 1, CLASSES, timed + 1,
+                    samples=SAMPLES_PER_CLIENT if tpu else 2 * BATCH,
+                    clients=CLIENTS_PER_ROUND if tpu else 2)
     flops = _round_flops(api)
     rps = _bench_rounds(api, timed)
     achieved = rps * flops  # FLOP/s through the round program
@@ -166,9 +168,11 @@ def bench_fedavg_cnn_bf16() -> dict:
 
 
 def bench_resnet18_gn() -> dict:
-    timed = 20 if _is_tpu() else 2
+    tpu = _is_tpu()
+    timed = 20 if tpu else 2
     api = _make_api("resnet18_gn", 24, 3, 100, timed + 1,
-                    samples=5 * BATCH if _is_tpu() else BATCH)
+                    samples=5 * BATCH if tpu else BATCH,
+                    clients=CLIENTS_PER_ROUND if tpu else 2)
     flops = _round_flops(api)
     rps = _bench_rounds(api, timed)
     achieved = rps * flops

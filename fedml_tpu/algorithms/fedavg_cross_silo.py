@@ -133,12 +133,23 @@ class FedAvgServerManager(ServerManager):
             # init broadcast carries (restored model, restored round), and
             # since sampling + client RNG derive from the round index the
             # continuation is bit-identical to an uninterrupted run
-            restored = checkpoint_mgr.restore_latest(
-                {"variables": self.global_model})
+            restored = checkpoint_mgr.restore_latest(self._checkpoint_state())
             if restored:
                 state, meta = restored
-                self.global_model = state["variables"]
+                self._load_state(state)
                 self.round_idx = meta["round_idx"]
+
+    # subclasses (FedOpt) extend the round-state tuple with server opt state
+    def _checkpoint_state(self):
+        return {"variables": self.global_model}
+
+    def _load_state(self, state) -> None:
+        self.global_model = state["variables"]
+
+    def _aggregate_round(self):
+        """Close the round: default is the plain sample-weighted average;
+        FedOpt overrides with a persistent server-optimizer step."""
+        return self.aggregator.aggregate()
 
     def send_init_msg(self) -> None:
         if self.round_idx >= self.comm_round:
@@ -179,13 +190,13 @@ class FedAvgServerManager(ServerManager):
             msg.get(MSG_ARG_KEY_NUM_SAMPLES))
         if not self.aggregator.check_whether_all_receive():
             return
-        self.global_model = self.aggregator.aggregate()
+        self.global_model = self._aggregate_round()
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.global_model)
         self.round_idx += 1
         if self.checkpoint_mgr is not None:
             self.checkpoint_mgr.save(self.round_idx,
-                                     {"variables": self.global_model})
+                                     self._checkpoint_state())
         if self.round_idx == self.comm_round:
             for worker in range(1, self.size):
                 self.send_message(
@@ -201,6 +212,57 @@ class FedAvgServerManager(ServerManager):
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
             msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
             self.send_message(msg)
+
+
+class FedOptServerManager(FedAvgServerManager):
+    """Cross-silo FedOpt: the round closes with a persistent server
+    optimizer on the pseudo-gradient instead of installing the average
+    (reference fedml_api/distributed/fedopt/FedOptAggregator.py:70-123 —
+    avg, ``w_old − w_avg`` into the optimizer, step). Client silos are
+    unchanged; only the server's close step differs, so the same
+    FedAvgClientManager processes run against either server."""
+
+    def __init__(self, *args, server_optimizer: str = "adam",
+                 server_lr: float = 1e-3, server_momentum: float = 0.0,
+                 **kw):
+        from fedml_tpu.algorithms.fedopt import get_server_optimizer
+
+        global_model = args[6] if len(args) > 6 else kw["global_model"]
+        opt_kw = {}
+        if server_optimizer == "sgd" and server_momentum:
+            opt_kw["momentum"] = server_momentum
+        self._server_tx = get_server_optimizer(server_optimizer, server_lr,
+                                               **opt_kw)
+        self.server_opt_state = self._server_tx.init(global_model["params"])
+        server_tx = self._server_tx
+
+        def opt_step(old_params, avg_params, opt_state):
+            pseudo_grad = pt.tree_sub(old_params, avg_params)
+            updates, opt_state = server_tx.update(pseudo_grad, opt_state,
+                                                  old_params)
+            import optax
+            return optax.apply_updates(old_params, updates), opt_state
+
+        self._opt_step = jax.jit(opt_step)
+        # super() last: checkpoint resume may overwrite the fresh opt state
+        # through the _load_state hook below
+        super().__init__(*args, **kw)
+
+    def _checkpoint_state(self):
+        return {"variables": self.global_model,
+                "server_opt": self.server_opt_state}
+
+    def _load_state(self, state) -> None:
+        self.global_model = state["variables"]
+        self.server_opt_state = state["server_opt"]
+
+    def _aggregate_round(self):
+        avg = self.aggregator.aggregate()
+        new_params, self.server_opt_state = self._opt_step(
+            self.global_model["params"], avg["params"],
+            self.server_opt_state)
+        # BN/other collections keep the plain average
+        return {**avg, "params": new_params}
 
 
 class FedAvgClientManager(ClientManager):
@@ -264,7 +326,10 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           addresses=None, wire_codec: bool = True,
                           compress: bool = False, token=None,
                           checkpoint_dir: Optional[str] = None,
-                          resume: bool = False):
+                          resume: bool = False,
+                          server_optimizer: Optional[str] = None,
+                          server_lr: float = 1e-3,
+                          server_momentum: float = 0.0):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -305,11 +370,18 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     server_com = create_comm_manager(backend, 0, size, router=router,
                                      addresses=addresses,
                                      wire_codec=wire_codec, token=token)
-    server = FedAvgServerManager(0, size, server_com, aggregator, comm_round,
-                                 dataset.client_num, global_model,
-                                 on_round_done=on_round_done,
-                                 checkpoint_mgr=checkpoint_mgr,
-                                 resume=resume)
+    common = dict(on_round_done=on_round_done,
+                  checkpoint_mgr=checkpoint_mgr, resume=resume)
+    if server_optimizer:
+        server = FedOptServerManager(
+            0, size, server_com, aggregator, comm_round,
+            dataset.client_num, global_model,
+            server_optimizer=server_optimizer, server_lr=server_lr,
+            server_momentum=server_momentum, **common)
+    else:
+        server = FedAvgServerManager(0, size, server_com, aggregator,
+                                     comm_round, dataset.client_num,
+                                     global_model, **common)
     clients = []
     for rank in range(1, size):
         com = create_comm_manager(backend, rank, size, router=router,

@@ -92,7 +92,10 @@ def run_cross_silo(args, ds, model, task, sink):
         comm_round=args.comm_round, train_cfg=make_train_config(args),
         backend=args.backend, addresses=addresses,
         compress=getattr(args, "compress", False),
-        checkpoint_dir=args.checkpoint_dir, resume=args.resume)
+        checkpoint_dir=args.checkpoint_dir, resume=args.resume,
+        # fedopt-style server step when the launcher passes the fedopt flags
+        server_optimizer=getattr(args, "cross_silo_server_optimizer", None),
+        server_lr=getattr(args, "server_lr", 1e-3))
     for rec in history:
         sink.log(rec, step=rec["round"])
     return history[-1] if history else {}

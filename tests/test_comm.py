@@ -129,3 +129,30 @@ class TestCrossSiloFedAvg:
             worker_num=n_workers, comm_round=2, train_cfg=tcfg)
         tree_close(model, sim.variables, rtol=1e-5, atol=1e-6)
         assert history and history[-1]["round"] == 1
+
+    def test_fedopt_server_matches_standalone(self, small_dataset):
+        """Cross-silo FedOpt (server Adam on the pseudo-gradient) ==
+        standalone FedOptAPI, same seeds."""
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        from fedml_tpu.algorithms.fedopt import FedOptAPI, FedOptConfig
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        ds = small_dataset
+        tcfg = TrainConfig(epochs=1, batch_size=4, lr=0.1)
+        n_workers = ds.client_num
+
+        sim = FedOptAPI(ds, LogisticRegression(num_classes=ds.class_num),
+                        config=FedOptConfig(
+                            comm_round=3, client_num_per_round=n_workers,
+                            server_optimizer="adam", server_lr=0.05,
+                            train=tcfg))
+        for r in range(3):
+            sim.run_round(r)
+
+        model, _ = run_fedavg_cross_silo(
+            ds, LogisticRegression(num_classes=ds.class_num),
+            worker_num=n_workers, comm_round=3, train_cfg=tcfg,
+            server_optimizer="adam", server_lr=0.05)
+        tree_close(model, sim.variables, rtol=1e-4, atol=1e-5)
