@@ -240,7 +240,7 @@ def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
     }
 
 
-def bench_time_to_target(target_acc: float = 0.92, max_rounds: int = 60
+def bench_time_to_target(target_acc: float = 0.95, max_rounds: int = 60
                          ) -> dict:
     import jax
 
@@ -249,13 +249,17 @@ def bench_time_to_target(target_acc: float = 0.92, max_rounds: int = 60
     from fedml_tpu.models.lr import LogisticRegression
     from fedml_tpu.trainer.functional import TrainConfig
 
-    ds = make_blob_federated(client_num=10, dim=32, class_num=8,
-                             n_samples=4000, seed=3)
+    # partial participation + low lr so the target takes tens of rounds —
+    # a 1-round hit measures nothing
+    ds = make_blob_federated(client_num=32, dim=32, class_num=8,
+                             n_samples=8000, seed=3,
+                             partition_method="hetero", partition_alpha=0.3)
     api = FedAvgAPI(ds, LogisticRegression(num_classes=ds.class_num),
                     config=FedAvgConfig(
-                        comm_round=max_rounds, client_num_per_round=10,
+                        comm_round=max_rounds, client_num_per_round=8,
                         frequency_of_the_test=10**9,
-                        train=TrainConfig(epochs=1, batch_size=32, lr=0.3)))
+                        train=TrainConfig(epochs=1, batch_size=64,
+                                          lr=0.003)))
     api.run_round(0)  # compile (excluded: TTA measures the steady state)
     api.evaluate(0)
     jax.block_until_ready(api.variables)
@@ -366,12 +370,16 @@ def main():
         "baseline_rounds_per_sec": round(base, 3) if base == base else None,
     }
     headline = flagship.get("rounds_per_sec", 0.0)
+    # CPU runs shrink the workload (smoke shapes), so the ratio against the
+    # full-size torch baseline is only meaningful on the chip
+    extra["smoke_shapes"] = not _is_tpu()
     line = {
         "metric": "fedavg_rounds_per_sec_femnist_cnn",
         "value": headline,
         "unit": "rounds/s",
         "vs_baseline": (round(headline / base, 2)
-                        if base == base and base > 0 else None),
+                        if _is_tpu() and base == base and base > 0
+                        else None),
         "extra": extra,
     }
     os.makedirs("runs", exist_ok=True)
