@@ -48,6 +48,33 @@ def _fed_shakespeare(data_dir, **kw):
         data_dir, client_limit=kw.get("client_limit"))
 
 
+def _stackoverflow_nwp(data_dir, **kw):
+    import os
+
+    from fedml_tpu.data.tff_h5 import (
+        load_count_vocab, load_partition_data_federated_stackoverflow_nwp)
+    vocab = load_count_vocab(
+        os.path.join(data_dir, "stackoverflow.word_count"),
+        limit=kw.get("vocab_size", 10000))
+    return load_partition_data_federated_stackoverflow_nwp(
+        data_dir, vocab, client_limit=kw.get("client_limit"))
+
+
+def _stackoverflow_lr(data_dir, **kw):
+    import os
+
+    from fedml_tpu.data.tff_h5 import (
+        load_count_vocab, load_partition_data_federated_stackoverflow_lr)
+    vocab = load_count_vocab(
+        os.path.join(data_dir, "stackoverflow.word_count"),
+        limit=kw.get("vocab_size", 10000))
+    tags = load_count_vocab(
+        os.path.join(data_dir, "stackoverflow.tag_count"),
+        limit=kw.get("tag_size", 500))
+    return load_partition_data_federated_stackoverflow_lr(
+        data_dir, vocab, tags, client_limit=kw.get("client_limit"))
+
+
 def _cifar_family(name):
     def load(data_dir, **kw):
         from fedml_tpu.data.cifar import load_partition_data_cifar
@@ -122,6 +149,8 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "femnist": _femnist,
     "fed_cifar100": _fed_cifar100,
     "fed_shakespeare": _fed_shakespeare,
+    "stackoverflow_nwp": _stackoverflow_nwp,
+    "stackoverflow_lr": _stackoverflow_lr,
     "cifar10": _cifar_family("cifar10"),
     "cifar100": _cifar_family("cifar100"),
     "cinic10": _cifar_family("cinic10"),

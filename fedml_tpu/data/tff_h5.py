@@ -182,6 +182,22 @@ def so_tokenizer(sentence: str, vocab: Dict[str, int], max_seq_len: int = 20,
     return np.asarray(ids, np.int32)
 
 
+def load_count_vocab(path: str, limit: Optional[int] = None) -> list:
+    """Frequency-ranked vocab from a ``<word> <count>`` file — the
+    stackoverflow.word_count / .tag_count artifacts the reference's loaders
+    read (stackoverflow_nwp/utils.py:24-31: top-10k words; stackoverflow_lr:
+    top-500 tags)."""
+    words = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if parts:
+                words.append(parts[0])
+            if limit is not None and len(words) >= limit:
+                break
+    return words
+
+
 def load_partition_data_federated_stackoverflow_nwp(
         data_dir: str, vocab_words: Sequence[str],
         train_file: str = "stackoverflow_train.h5",

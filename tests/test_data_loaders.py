@@ -138,6 +138,32 @@ class TestTffH5:
         check_contract(ds)
         assert ds.class_num == len(vocab_words) + 4
 
+    def test_stackoverflow_registry_reads_count_files(self, tmp_path):
+        """load_data('stackoverflow_nwp', dir) builds the vocab from the
+        stackoverflow.word_count artifact (frequency-ranked, reference
+        stackoverflow_nwp/utils.py:24-31)."""
+        from fedml_tpu.data.registry import load_data
+        from fedml_tpu.data.tff_h5 import load_count_vocab
+
+        (tmp_path / "stackoverflow.word_count").write_text(
+            "how 900\nto 800\nuse 700\njax 600\ntorch 500\n")
+        (tmp_path / "stackoverflow.tag_count").write_text(
+            "ml 300\ncompilers 200\n")
+        assert load_count_vocab(
+            str(tmp_path / "stackoverflow.word_count"), limit=3) == [
+                "how", "to", "use"]
+        clients = {"dev": {
+            "tokens": np.array([b"how to use jax"], dtype="S50"),
+            "tags": np.array([b"ml"], dtype="S50")}}
+        self._write_h5(str(tmp_path / "stackoverflow_train.h5"), clients)
+        self._write_h5(str(tmp_path / "stackoverflow_test.h5"), clients)
+        ds = load_data("stackoverflow_nwp", str(tmp_path), vocab_size=4)
+        check_contract(ds)
+        assert ds.class_num == 4 + 4  # vocab + pad/oov/bos/eos
+        ds_lr = load_data("stackoverflow_lr", str(tmp_path))
+        check_contract(ds_lr)
+        assert ds_lr.train_data_local_dict[0][1].shape[1] == 2  # 2 tags
+
     def test_stackoverflow_lr_multihot(self, tmp_path):
         from fedml_tpu.data.tff_h5 import (
             load_partition_data_federated_stackoverflow_lr)
