@@ -1,12 +1,21 @@
-"""Subprocess worker for the 2-process multihost rendezvous smoke test.
+"""Subprocess worker for the 2-process multihost rendezvous smoke tests.
 
-Run as: python multihost_worker.py <coordinator_addr> <num_procs> <proc_id>
+Run as: python multihost_worker.py <coordinator> <num_procs> <proc_id> [mode]
 
 Each process presents 4 virtual CPU devices, so the 2-process job forms an
 8-device global mesh — the same shape the reference exercises with
 ``mpirun -np N -hostfile`` on localhost (run_fedavg_distributed_pytorch.sh:19-22),
 but through jax.distributed's real rendezvous + DCN collectives instead of
-mpi4py sends. Prints MULTIHOST_OK <psum_result> on success.
+mpi4py sends.
+
+Modes:
+- ``collectives`` (default): mesh + cross-host sums through the multihost
+  helpers. Prints MULTIHOST_OK <sum>.
+- ``fedavg``: one REAL FedAvg SPMD round (make_spmd_round) over the global
+  mesh, each host feeding only its local client rows
+  (multihost.local_client_slice + host_local_to_global — the multi-host
+  data contract). Prints FEDAVG_OK <param_l2_norm> so the test can check
+  both hosts computed the identical replicated model.
 """
 
 import os
@@ -20,9 +29,58 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 
 
+def run_fedavg_round(multihost) -> None:
+    """One spmd FedAvg round with host-local data feeding."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.parallel.spmd import make_spmd_round
+    from fedml_tpu.trainer.functional import TrainConfig
+
+    mesh = multihost.global_client_mesh()
+    n_clients = mesh.shape["clients"]
+
+    # every host derives the SAME federation (seeded), feeds only its rows
+    ds = make_blob_federated(client_num=n_clients, dim=8, class_num=4,
+                             n_samples=32 * n_clients, seed=11)
+    model = LogisticRegression(num_classes=ds.class_num)
+    cfg = TrainConfig(epochs=1, batch_size=16, lr=0.1, shuffle=False)
+
+    lo, hi = multihost.local_client_slice(mesh, n_clients)
+    x, y, mask = ds.pack_clients(list(range(lo, hi)), cfg.batch_size)
+    weights = ds.client_weights(list(range(lo, hi)))[:, None]
+    xg, yg, mg, wg = multihost.host_local_to_global(
+        mesh, (x, y, mask, weights.astype(np.float32)), n_clients)
+
+    keys_local = np.stack([
+        np.asarray(jax.random.key_data(
+            jax.random.fold_in(jax.random.key(0), c)))
+        for c in range(lo, hi)])
+    kg = multihost.host_local_to_global(mesh, keys_local, n_clients)
+
+    variables = model.init(jax.random.key(1), jnp.zeros((1, 8)),
+                           train=False)
+    round_fn = make_spmd_round(model, "classification", cfg, mesh)
+    new_vars, stats = round_fn(
+        variables, xg, yg, mg,
+        jax.vmap(jax.random.wrap_key_data)(kg), wg[:, 0])
+    jax.block_until_ready(new_vars)
+    assert float(stats["count"]) > 0
+
+    norm = float(jnp.sqrt(sum(jnp.sum(a ** 2)
+                              for a in jax.tree.leaves(new_vars))))
+    # replicated output must agree across hosts
+    assert multihost.all_hosts_agree(int(norm * 1e6))
+    print(f"FEDAVG_OK {norm:.6f}", flush=True)
+
+
 def main() -> None:
     coordinator, num_procs, proc_id = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    mode = sys.argv[4] if len(sys.argv) > 4 else "collectives"
 
     # the axon plugin (sitecustomize) sets jax_platforms programmatically,
     # overriding the env var — force CPU via config before any backend init
@@ -37,6 +95,10 @@ def main() -> None:
         process_id=proc_id,
     )
     assert (pid, count) == (proc_id, num_procs), (pid, count)
+
+    if mode == "fedavg":
+        run_fedavg_round(multihost)
+        return
 
     import jax.numpy as jnp
     import numpy as np

@@ -50,3 +50,39 @@ def test_two_process_rendezvous():
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
         assert "MULTIHOST_OK 28.0" in out, out  # sum(range(8))
+
+
+@pytest.mark.slow
+def test_two_process_fedavg_round():
+    """A real FedAvg SPMD round across 2 processes x 4 devices: each host
+    feeds only its local client rows; the replicated result must be
+    identical on both hosts."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coordinator, "2", str(pid), "fedavg"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost fedavg round hung")
+
+    norms = []
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+        line = [ln for ln in out.splitlines() if ln.startswith("FEDAVG_OK")]
+        assert line, out
+        norms.append(line[0].split()[1])
+    assert norms[0] == norms[1], norms
