@@ -50,17 +50,19 @@ class ReLUConvBN(nn.Module):
     C_out: int
     kernel: int = 1
     stride: int = 1
+    affine: bool = False  # search cells: affine-free BN; eval nets: True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.relu(x)
         x = nn.Conv(self.C_out, (self.kernel, self.kernel),
                     strides=self.stride, use_bias=False)(x)
-        return _bn(train)(x)
+        return _bn(train, self.affine)(x)
 
 
 class FactorizedReduce(nn.Module):
     C_out: int
+    affine: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -68,13 +70,14 @@ class FactorizedReduce(nn.Module):
         a = nn.Conv(self.C_out // 2, (1, 1), strides=2, use_bias=False)(x)
         b = nn.Conv(self.C_out - self.C_out // 2, (1, 1), strides=2,
                     use_bias=False)(x[:, 1:, 1:, :])
-        return _bn(train)(jnp.concatenate([a, b], axis=-1))
+        return _bn(train, self.affine)(jnp.concatenate([a, b], axis=-1))
 
 
 class SepConv(nn.Module):
     C_out: int
     kernel: int
     stride: int
+    affine: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -84,11 +87,11 @@ class SepConv(nn.Module):
         x = nn.Conv(C_in, k, strides=self.stride, feature_group_count=C_in,
                     use_bias=False)(x)
         x = nn.Conv(C_in, (1, 1), use_bias=False)(x)
-        x = _bn(train)(x)
+        x = _bn(train, self.affine)(x)
         x = nn.relu(x)
         x = nn.Conv(C_in, k, feature_group_count=C_in, use_bias=False)(x)
         x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
-        return _bn(train)(x)
+        return _bn(train, self.affine)(x)
 
 
 class DilConv(nn.Module):
@@ -96,6 +99,7 @@ class DilConv(nn.Module):
     kernel: int
     stride: int
     dilation: int = 2
+    affine: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -105,7 +109,7 @@ class DilConv(nn.Module):
                     kernel_dilation=self.dilation, feature_group_count=C_in,
                     use_bias=False)(x)
         x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
-        return _bn(train)(x)
+        return _bn(train, self.affine)(x)
 
 
 def _pool(x, kind: str, stride: int):
@@ -300,9 +304,9 @@ def parse_genotype(alphas_normal: np.ndarray,
                 gene.append((PRIMITIVES[k_best], j))
             start += n
             n += 1
-        return gene
+        return tuple(gene)  # hashable: genotypes feed flax module fields
 
-    concat = list(range(2 + steps - multiplier, steps + 2))
+    concat = tuple(range(2 + steps - multiplier, steps + 2))
     return Genotype(normal=_parse(softmax(alphas_normal)),
                     normal_concat=concat,
                     reduce=_parse(softmax(alphas_reduce)),

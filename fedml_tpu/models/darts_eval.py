@@ -1,0 +1,169 @@
+"""DARTS evaluation network — train a *derived* genotype from scratch.
+
+Reference: fedml_api/model/cv/darts/model.py (Cell:8-61 compiles the
+discrete genotype into fixed ops with per-op drop-path,
+AuxiliaryHeadCIFAR:64-83, NetworkCIFAR:111-160) and utils.py drop_path.
+This is the second half of the NAS workflow: FedNAS searches with
+models/darts.DartsNetwork, ``parse_genotype`` discretizes the alphas, and
+this module retrains the winning architecture (affine BN, drop-path
+regularization, optional auxiliary head at 2/3 depth).
+
+TPU notes: the cell graph is static (op list fixed by the genotype), so the
+whole network jits into one program; drop-path is a per-sample bernoulli
+mask driven by a flax ``drop_path`` RNG collection.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.darts import (DilConv, FactorizedReduce, Genotype,
+                                    ReLUConvBN, SepConv, _bn, _pool)
+
+
+def drop_path(x, rate: float, rng) -> jnp.ndarray:
+    """Per-sample stochastic depth (reference utils.py drop_path): zero a
+    sample's whole residual branch with prob ``rate``, rescale survivors."""
+    keep = jax.random.bernoulli(rng, 1.0 - rate,
+                                (x.shape[0],) + (1,) * (x.ndim - 1))
+    return x * keep.astype(x.dtype) / (1.0 - rate)
+
+
+class _FixedOp(nn.Module):
+    """One discrete primitive with affine BN (reference OPS[name](C, stride,
+    affine=True), model.py:44-46)."""
+
+    prim: str
+    C: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.prim
+        if p == "max_pool_3x3":
+            return _bn(train, True)(_pool(x, "max", self.stride))
+        if p == "avg_pool_3x3":
+            return _bn(train, True)(_pool(x, "avg", self.stride))
+        if p == "skip_connect":
+            return (x if self.stride == 1
+                    else FactorizedReduce(self.C, affine=True)(x,
+                                                               train=train))
+        if p == "sep_conv_3x3":
+            return SepConv(self.C, 3, self.stride, affine=True)(x,
+                                                                train=train)
+        if p == "sep_conv_5x5":
+            return SepConv(self.C, 5, self.stride, affine=True)(x,
+                                                                train=train)
+        if p == "dil_conv_3x3":
+            return DilConv(self.C, 3, self.stride, affine=True)(x,
+                                                                train=train)
+        if p == "dil_conv_5x5":
+            return DilConv(self.C, 5, self.stride, affine=True)(x,
+                                                                train=train)
+        raise ValueError(f"primitive {self.prim!r} cannot appear in a "
+                         "derived genotype")
+
+
+class GenotypeCell(nn.Module):
+    """Fixed cell compiled from one genotype half (reference Cell._compile /
+    forward, model.py:28-61)."""
+
+    genotype: Genotype
+    C: int
+    reduction: bool
+    reduction_prev: bool
+    drop_path_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, s0, s1, train: bool = False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C, affine=True)(s0, train=train)
+        else:
+            s0 = ReLUConvBN(self.C, affine=True)(s0, train=train)
+        s1 = ReLUConvBN(self.C, affine=True)(s1, train=train)
+
+        gene = (self.genotype.reduce if self.reduction
+                else self.genotype.normal)
+        concat = (self.genotype.reduce_concat if self.reduction
+                  else self.genotype.normal_concat)
+        states = [s0, s1]
+        for i in range(len(gene) // 2):
+            h = None
+            for prim, j in gene[2 * i:2 * i + 2]:
+                stride = 2 if self.reduction and j < 2 else 1
+                out = _FixedOp(prim, self.C, stride)(states[j], train=train)
+                # drop-path skips identity ops (reference model.py:52-57)
+                is_identity = prim == "skip_connect" and stride == 1
+                if train and self.drop_path_rate > 0 and not is_identity:
+                    out = drop_path(out, self.drop_path_rate,
+                                    self.make_rng("drop_path"))
+                h = out if h is None else h + out
+            states.append(h)
+        return jnp.concatenate([states[i] for i in concat], axis=-1)
+
+
+class AuxiliaryHeadCIFAR(nn.Module):
+    """8x8 feature maps -> aux logits (reference model.py:64-83)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = nn.Conv(128, (1, 1), use_bias=False)(x)
+        x = nn.relu(_bn(train, True)(x))
+        x = nn.Conv(768, (2, 2), use_bias=False, padding="VALID")(x)
+        x = nn.relu(_bn(train, True)(x))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)
+
+
+class GenotypeNetwork(nn.Module):
+    """NetworkCIFAR (reference model.py:111-160): stem, ``layers`` fixed
+    cells with reductions at 1/3 and 2/3 depth, optional auxiliary head
+    after the second reduction, pool + classifier.
+
+    Returns logits, or (logits, aux_logits) when ``auxiliary`` and train.
+    """
+
+    genotype: Genotype
+    C: int = 36
+    num_classes: int = 10
+    layers: int = 20
+    auxiliary: bool = False
+    stem_multiplier: int = 3
+    drop_path_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        C_curr = self.stem_multiplier * self.C
+        x = nn.Conv(C_curr, (3, 3), padding=1, use_bias=False)(x)
+        x = _bn(train, True)(x)
+        s0 = s1 = x
+        C_curr = self.C
+        reduction_prev = False
+        aux_logits = None
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            s0, s1 = s1, GenotypeCell(
+                self.genotype, C_curr, reduction, reduction_prev,
+                drop_path_rate=self.drop_path_rate)(s0, s1, train=train)
+            reduction_prev = reduction
+            # create the head whenever auxiliary so the params exist in both
+            # modes (torch modules exist regardless of training state);
+            # only the train-mode return includes its logits
+            if i == 2 * self.layers // 3 and self.auxiliary:
+                aux_logits = AuxiliaryHeadCIFAR(self.num_classes)(
+                    s1, train=train)
+        out = jnp.mean(s1, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(out)
+        if self.auxiliary and train:
+            return logits, aux_logits
+        return logits

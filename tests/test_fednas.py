@@ -158,6 +158,65 @@ class TestVisualize:
         assert "node 0 <-" in txt
 
 
+class TestGenotypeNetwork:
+    """Evaluation network from a derived genotype (reference model.py)."""
+
+    def _genotype(self):
+        alphas = np.zeros((DartsNetwork.num_edges(2), len(PRIMITIVES)),
+                          np.float32)
+        alphas[:, PRIMITIVES.index("sep_conv_3x3")] = 1.0
+        alphas[2, PRIMITIVES.index("skip_connect")] = 2.0
+        return parse_genotype(alphas, alphas, steps=2, multiplier=2)
+
+    def test_forward_and_train_mode(self):
+        from fedml_tpu.models.darts_eval import GenotypeNetwork
+
+        g = self._genotype()
+        net = GenotypeNetwork(genotype=g, C=4, num_classes=5, layers=3,
+                              stem_multiplier=1)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 16, 3),
+                        jnp.float32)
+        variables = net.init(jax.random.key(0), x, train=False)
+        logits = net.apply(variables, x, train=False)
+        assert logits.shape == (2, 5)
+        # train mode mutates batch stats and is jittable
+        out, updates = net.apply(
+            variables, x, train=True, mutable=["batch_stats"],
+            rngs={"drop_path": jax.random.key(1)})
+        assert out.shape == (2, 5)
+        assert "batch_stats" in updates
+
+    def test_drop_path_zeroes_some_samples(self):
+        from fedml_tpu.models.darts_eval import drop_path
+
+        x = jnp.ones((64, 2, 2, 3))
+        y = drop_path(x, 0.5, jax.random.key(0))
+        per_sample = np.asarray(jnp.sum(jnp.abs(y), axis=(1, 2, 3)))
+        assert (per_sample == 0).any() and (per_sample > 0).any()
+        # survivors are rescaled by 1/keep_prob
+        np.testing.assert_allclose(per_sample[per_sample > 0], 2 * 12.0)
+
+    def test_auxiliary_head(self):
+        from fedml_tpu.models.darts_eval import GenotypeNetwork
+
+        g = self._genotype()
+        net = GenotypeNetwork(genotype=g, C=4, num_classes=5, layers=3,
+                              stem_multiplier=1, auxiliary=True,
+                              drop_path_rate=0.2)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = net.init(jax.random.key(0), x, train=False)
+        logits, aux = net.apply(
+            variables, x, train=True, mutable=["batch_stats"],
+            rngs={"drop_path": jax.random.key(1)})[0]
+        assert logits.shape == (2, 5) and aux.shape == (2, 5)
+        # eval mode: single output, no aux
+        assert net.apply(variables, x, train=False).shape == (2, 5)
+
+    def test_genotype_is_hashable_module_field(self):
+        g = self._genotype()
+        assert hash(g) == hash(self._genotype())
+
+
 class TestFedNAS:
     def test_search_round_updates_weights_and_alphas(self):
         ds = make_image_federation(client_num=2, n_per=32, hw=16)
