@@ -49,6 +49,13 @@ class FedNASConfig:
     variant: str = "darts"
     tau_max: float = 10.0       # GDAS temperature anneal bounds
     tau_min: float = 0.1
+    # second-order DARTS (reference --arch_unrolled, Architect.step
+    # architect.py:28-60): arch gradient at the ONE-STEP-LOOKAHEAD weights
+    # w' = w − lr·∇w L_train. The reference approximates the resulting
+    # hessian-vector product with finite differences (torch can't
+    # differentiate through the optimizer); jax.grad differentiates through
+    # the virtual step exactly.
+    arch_unrolled: bool = False
 
 
 class FedNASAPI:
@@ -136,11 +143,26 @@ class FedNASAPI:
                               jnp.take(y, idx_val, 0),
                               jnp.take(mask, idx_val, 0))
 
-                # (1) architecture step: d val_loss / d alphas (1st order)
+                # (1) architecture step: d val_loss / d alphas
                 def val_loss(a):
+                    w_eval_params = params
+                    if cfg.arch_unrolled:
+                        # virtual weight step, differentiable in a (exact
+                        # 2nd-order where the reference finite-differences)
+                        def inner_train_loss(p):
+                            wi, wri = mixing_weights(a, kw, tau)
+                            logits, _ = apply_w({"params": p, **colls},
+                                                wi, wri, xt, True,
+                                                mutable=True)
+                            return masked_ce(logits, yt, mt)
+
+                        gw = jax.grad(inner_train_loss)(params)
+                        w_eval_params = jax.tree.map(
+                            lambda p, g: p - cfg.lr * g, params, gw)
                     w, wr = mixing_weights(a, ka, tau)
-                    logits, _ = apply_w({"params": params, **colls}, w, wr,
-                                        xv, True, mutable=True)
+                    logits, _ = apply_w(
+                        {"params": w_eval_params, **colls}, w, wr,
+                        xv, True, mutable=True)
                     return masked_ce(logits, yv, mv)
 
                 ga = jax.grad(val_loss)(alphas)

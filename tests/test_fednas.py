@@ -158,6 +158,24 @@ class TestVisualize:
         assert "node 0 <-" in txt
 
 
+class TestUnrolledDarts:
+    def test_second_order_round_runs_and_differs_from_first_order(self):
+        ds = make_image_federation(client_num=2, n_per=16, hw=8)
+        kw = dict(comm_round=1, epochs=1, batch_size=8)
+        first = FedNASAPI(ds, tiny_net(ds.class_num),
+                          FedNASConfig(arch_unrolled=False, **kw))
+        second = FedNASAPI(ds, tiny_net(ds.class_num),
+                           FedNASConfig(arch_unrolled=True, **kw))
+        rec1 = first.run_round(0)
+        rec2 = second.run_round(0)
+        assert np.isfinite(rec1["search_loss"])
+        assert np.isfinite(rec2["search_loss"])
+        # the hessian-through-the-virtual-step term must change the alphas
+        d = sum(float(jnp.max(jnp.abs(a - b))) for a, b in zip(
+            jax.tree.leaves(first.alphas), jax.tree.leaves(second.alphas)))
+        assert d > 1e-7, d
+
+
 class TestGenotypeNetwork:
     """Evaluation network from a derived genotype (reference model.py)."""
 
