@@ -105,6 +105,24 @@ def make_spmd_round(module, task: str, cfg: TrainConfig, mesh: Mesh,
     ), donate_argnums=(0,) if donate else ())
 
 
+def make_sharded_eval(module, task: str, mesh: Mesh, axis="clients"):
+    """Evaluation sharded over the mesh: each device scores its slice of
+    the eval union, stat sums meet in one psum. The multi-chip analogue of
+    the reference's rank-0 test_on_server_for_all_clients
+    (FedAVGAggregator.py:109) — no device ever holds the whole eval set."""
+    ev = make_eval(module, task)
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+
+    def body(variables, x, y, mask):
+        stats = ev(variables, x, y, mask)  # this shard's sums
+        return jax.tree.map(lambda s: jax.lax.psum(s, axes), stats)
+
+    sharded = P(axes)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), sharded, sharded, sharded),
+        out_specs=P()))
+
+
 def make_hierarchical_spmd_round(module, task: str, cfg: TrainConfig,
                                  mesh: Mesh, group_comm_round: int = 1,
                                  donate: bool = False):
@@ -182,7 +200,7 @@ class DistributedFedAvgAPI:
         self.n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
         self._round_fn = make_spmd_round(module, task, self.config.train,
                                          self.mesh, donate=True)
-        self._eval_fn = jax.jit(make_eval(module, task))
+        self._eval_fn = make_sharded_eval(module, task, self.mesh)
         self._n_pad = dataset.padded_len(self.config.train.batch_size)
         self._base_key = jax.random.key(self.config.seed)
         self._data_sharding = NamedSharding(self.mesh, P("clients"))
@@ -194,6 +212,27 @@ class DistributedFedAvgAPI:
         # participation re-samples the identical set each round, so the
         # sharded x/y/mask/weights can stay resident across rounds
         self._pack_cache = None
+        # eval union: padded to a mesh multiple, sharded, device-resident
+        self._eval_cache = None
+
+    def _eval_global(self):
+        xt, yt = self.dataset.test_data_global
+        if not len(xt):
+            return None
+        if (self._eval_cache is None
+                or self._eval_cache[0] is not self.dataset):
+            n = len(xt)
+            n_pad = ((n + self.n_dev - 1) // self.n_dev) * self.n_dev
+            pad = n_pad - n
+            x = np.pad(np.asarray(xt), [(0, pad)] + [(0, 0)] * (xt.ndim - 1))
+            y = np.pad(np.asarray(yt), [(0, pad)] + [(0, 0)] * (yt.ndim - 1))
+            m = np.concatenate([np.ones(n, np.float32),
+                                np.zeros(pad, np.float32)])
+            put = lambda a: jax.device_put(jnp.asarray(a),
+                                           self._data_sharding)
+            self._eval_cache = (self.dataset, (put(x), put(y), put(m)))
+        x, y, m = self._eval_cache[1]
+        return self._eval_fn(self.variables, x, y, m)
 
     def _pad_round(self, idxs: np.ndarray):
         """Pad the sampled-client list to a mesh-size multiple with
@@ -255,14 +294,12 @@ class DistributedFedAvgAPI:
             _, stats = self.run_round(round_idx)
             last = round_idx == cfg.comm_round - 1
             if round_idx % cfg.frequency_of_the_test == 0 or last:
-                xt, yt = self.dataset.test_data_global
                 rec = {"round": round_idx,
                        "train_loss_local": float(stats["loss_sum"]) / max(
                            1.0, float(stats["count"]))}
-                if len(xt):
-                    rec.update(_normalized(self._eval_fn(
-                        self.variables, jnp.asarray(xt), jnp.asarray(yt),
-                        jnp.ones(len(xt), jnp.float32)), "test"))
+                test_stats = self._eval_global()
+                if test_stats is not None:
+                    rec.update(_normalized(test_stats, "test"))
                 self.history.append(rec)
             if checkpoint_mgr is not None:
                 checkpoint_mgr.save(round_idx + 1,

@@ -118,3 +118,33 @@ class TestHierarchicalRound:
                          jnp.asarray(ds.client_weights(np.arange(8))))
         assert np.isfinite(float(pt.tree_norm(hv)))
         assert float(stats["count"]) > 0
+
+
+class TestShardedEval:
+    def test_matches_single_device_eval(self):
+        from fedml_tpu.parallel.spmd import make_sharded_eval
+        from fedml_tpu.trainer.functional import make_eval
+
+        mesh = build_mesh({"clients": 8})
+        ds = make_blob_federated(client_num=8, seed=2)
+        model = LogisticRegression(num_classes=ds.class_num)
+        variables = model.init(
+            jax.random.key(0), jnp.asarray(ds.test_data_global[0][:1]),
+            train=False)
+        xt, yt = ds.test_data_global
+        n = len(xt)
+        n_pad = ((n + 7) // 8) * 8
+        x = np.pad(np.asarray(xt), [(0, n_pad - n)] + [(0, 0)] * (xt.ndim - 1))
+        y = np.pad(np.asarray(yt), [(0, n_pad - n)])
+        m = np.concatenate([np.ones(n, np.float32),
+                            np.zeros(n_pad - n, np.float32)])
+
+        sharded = make_sharded_eval(model, "classification", mesh)
+        ref = jax.jit(make_eval(model, "classification"))
+        got = sharded(variables, jnp.asarray(x), jnp.asarray(y),
+                      jnp.asarray(m))
+        want = ref(variables, jnp.asarray(xt), jnp.asarray(yt),
+                   jnp.ones(n, jnp.float32))
+        for k in want:
+            np.testing.assert_allclose(float(got[k]), float(want[k]),
+                                       rtol=1e-5, atol=1e-5)
