@@ -1,0 +1,77 @@
+"""Megatron-style tensor parallelism on the 8-virtual-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.models.transformer import TransformerLM
+from fedml_tpu.parallel.tensor import (build_tp_mesh, make_tp_train_step,
+                                       shard_transformer_tp,
+                                       transformer_tp_specs)
+
+
+def _model():
+    return TransformerLM(vocab_size=128, width=64, depth=2, num_heads=4,
+                         max_len=32)
+
+
+def _init(model):
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    return model.init(jax.random.key(0), tokens, train=False), tokens
+
+
+class TestSpecs:
+    def test_megatron_layout(self):
+        model = _model()
+        variables, _ = _init(model)
+        specs = transformer_tp_specs(variables)
+        blk = specs["params"]["TransformerBlock_0"]
+        assert blk["Dense_0"]["kernel"] == P(None, "tp")   # qkv column
+        assert blk["Dense_1"]["kernel"] == P("tp", None)   # attn-out row
+        assert blk["Dense_2"]["kernel"] == P(None, "tp")   # mlp-up column
+        assert blk["Dense_3"]["kernel"] == P("tp", None)   # mlp-down row
+        assert specs["params"]["Dense_0"]["kernel"] == P(None, "tp")  # head
+        assert specs["params"]["Embed_0"]["embedding"] == P()
+        ln = specs["params"]["TransformerBlock_0"]["LayerNorm_0"]
+        assert all(s == P() for s in jax.tree.leaves(
+            ln, is_leaf=lambda x: isinstance(x, P)))
+
+
+class TestTpExecution:
+    def test_sharded_forward_matches_single_device(self):
+        model = _model()
+        variables, _ = _init(model)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 128, (2, 16)), jnp.int32)
+        want = model.apply(variables, tokens, train=False)
+
+        mesh = build_tp_mesh(8)
+        sharded_vars = shard_transformer_tp(variables, mesh)
+        # params are actually distributed, not replicated
+        k = sharded_vars["params"]["TransformerBlock_0"]["Dense_0"]["kernel"]
+        assert len(k.sharding.device_set) == 8
+        assert k.addressable_shards[0].data.shape == (64, 3 * 64 // 8)
+
+        got = jax.jit(lambda v, t: model.apply(v, t, train=False))(
+            sharded_vars, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_tp_train_step_keeps_layout_and_learns(self):
+        model = _model()
+        variables, _ = _init(model)
+        mesh = build_tp_mesh(8)
+        sharded_vars = shard_transformer_tp(variables, mesh)
+        step = make_tp_train_step(model, mesh, lr=0.1)
+        tokens = jnp.asarray(
+            np.random.RandomState(1).randint(0, 128, (4, 16)), jnp.int32)
+        v1, l1 = step(sharded_vars, tokens)
+        losses = [float(l1)]
+        for _ in range(5):
+            v1, l = step(v1, tokens)
+            losses.append(float(l))
+        assert losses[-1] < losses[0], losses
+        k = v1["params"]["TransformerBlock_0"]["Dense_0"]["kernel"]
+        # the update must not have gathered the params to one device
+        assert len(k.sharding.device_set) == 8
