@@ -1,0 +1,82 @@
+"""Expert-parallel (MoE) FFN over an 8-device 'ep' mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fedml_tpu.parallel.expert import (expert_sharded_params,
+                                       init_moe_params, make_moe_step,
+                                       moe_ffn_local)
+from fedml_tpu.parallel.spmd import build_mesh
+
+WIDTH, HIDDEN, EXPERTS = 16, 32, 8
+
+
+def _setup(tokens=64, capacity=64, seed=0):
+    params = init_moe_params(jax.random.key(seed), EXPERTS, WIDTH, HIDDEN)
+    x = jnp.asarray(np.random.RandomState(seed).randn(tokens, WIDTH),
+                    jnp.float32)
+    return params, x, capacity
+
+
+class TestLocalOracle:
+    def test_output_shape_and_aux(self):
+        params, x, cap = _setup()
+        out, aux = jax.jit(lambda x, p: moe_ffn_local(x, p, cap))(x, params)
+        assert out.shape == x.shape
+        assert np.isfinite(float(aux)) and float(aux) > 0
+
+    def test_capacity_overflow_drops_tokens(self):
+        params, x, _ = _setup()
+        full, _ = moe_ffn_local(x, params, capacity=64)
+        tiny, _ = moe_ffn_local(x, params, capacity=1)
+        # overflowed tokens produce zero output rows (residual path)
+        norms = np.asarray(jnp.sum(jnp.abs(tiny), axis=-1))
+        assert (norms == 0).sum() > 0
+        assert not np.allclose(np.asarray(full), np.asarray(tiny))
+
+    def test_router_gets_gradients(self):
+        params, x, cap = _setup()
+
+        def loss(p):
+            out, aux = moe_ffn_local(x, p, cap)
+            return jnp.sum(out ** 2) + 0.01 * aux
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.max(jnp.abs(g["router"]))) > 0
+        assert float(jnp.max(jnp.abs(g["w_up"]))) > 0
+
+
+class TestExpertParallel:
+    def test_sharded_matches_local_oracle(self):
+        mesh = build_mesh({"ep": 8})
+        # local capacity C per shard => sharded run can hold 8*C per expert;
+        # give the oracle the same effective capacity and keep it un-hit
+        # (per-shard token counts differ from global, so only the
+        # no-overflow regime is exactly comparable)
+        params, x, _ = _setup(tokens=64, capacity=64)
+        cap_local = 64
+        out_local, aux_local = moe_ffn_local(x, params, capacity=512)
+        step = make_moe_step(mesh, EXPERTS, cap_local)
+        sharded_params = expert_sharded_params(params, mesh)
+        x_sharded = jax.device_put(x, NamedSharding(mesh, P("ep")))
+        out, aux = step(x_sharded, sharded_params)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_local),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(float(aux), float(aux_local), rtol=1e-4)
+
+    def test_expert_params_are_distributed(self):
+        mesh = build_mesh({"ep": 8})
+        params, _, _ = _setup()
+        sp = expert_sharded_params(params, mesh)
+        shard = sp["w_up"].addressable_shards[0].data
+        assert shard.shape == (EXPERTS // 8, WIDTH, HIDDEN)
+
+    def test_indivisible_experts_raise(self):
+        from fedml_tpu.parallel.expert import make_expert_parallel_ffn
+
+        mesh = build_mesh({"ep": 8})
+        import pytest
+        with pytest.raises(ValueError, match="divide"):
+            make_expert_parallel_ffn(mesh, n_experts=6, capacity=4)
