@@ -1,0 +1,77 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a ``pp`` axis.
+
+The reference's deepest model is ResNet-110 on one GPU; this gives the
+framework a real depth axis: a stack of identical transformer blocks is
+partitioned one-stage-per-device, activations flow stage-to-stage over ICI
+with ``ppermute``, and microbatching keeps every stage busy outside the
+fill/drain bubble (schedule length ``n_micro + n_stages − 1``).
+
+TPU-first shape: the whole schedule is one ``lax.scan`` inside one
+``shard_map`` program — no host round-trips between ticks; stage parameters
+are a stacked pytree sharded ``P('pp')`` on the leading axis, so each
+device holds exactly its stage's weights (parameter memory scales with the
+mesh, the point of pipelining). Embedding/head stay outside the pipelined
+region (replicated), as in practical GPipe deployments.
+
+Composes with the other axes: ('pp', 'tp') nests Megatron sharding inside
+each stage; ('clients', 'pp') pipelines each federated client's model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.parallel.spmd import _pvary
+
+
+def stack_stage_params(stage_params_list):
+    """[per-stage param trees] -> one stacked tree with leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
+
+
+def shard_stage_params(stacked, mesh: Mesh, axis: str = "pp"):
+    """Place the stacked stage params: leading (stage) axis over ``pp``."""
+    return jax.tree.map(
+        lambda v: jax.device_put(v, NamedSharding(
+            mesh, P(*((axis,) + (None,) * (v.ndim - 1))))), stacked)
+
+
+def make_pipeline(block_module, mesh: Mesh, n_micro: int, axis: str = "pp"):
+    """Public factory: returns (apply_fn, shard_fn)."""
+    n_stages = mesh.shape[axis]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def local(stage_params, x):
+        my_params = jax.tree.map(lambda v: v[0], stage_params)
+        stage = jax.lax.axis_index(axis)
+        mb = x.shape[0] // n_micro
+        # input is replicated; mark it device-varying so the scan carry
+        # (which becomes varying through ppermute) has a stable type
+        micro = _pvary(x.reshape((n_micro, mb) + x.shape[1:]), (axis,))
+
+        ticks = n_micro + n_stages - 1
+
+        def tick(buf, t):
+            idx = jnp.clip(t, 0, n_micro - 1)
+            inp = jnp.where(stage == 0, micro[idx], buf)
+            out = block_module.apply({"params": my_params}, inp)
+            nxt = jax.lax.ppermute(out, axis, perm)
+            return nxt, out
+
+        zero = _pvary(jnp.zeros((mb,) + x.shape[1:], x.dtype), (axis,))
+        _, outs = jax.lax.scan(tick, zero, jnp.arange(ticks))
+        finished = jax.lax.dynamic_slice_in_dim(outs, n_stages - 1, n_micro,
+                                                axis=0)
+        is_last = (stage == n_stages - 1).astype(finished.dtype)
+        full = jax.lax.psum(finished * is_last, axis)
+        return full.reshape(x.shape)
+
+    apply_fn = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P(axis), P()), out_specs=P()))
+
+    def shard_fn(stacked):
+        return shard_stage_params(stacked, mesh, axis)
+
+    return apply_fn, shard_fn
