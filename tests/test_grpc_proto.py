@@ -92,7 +92,15 @@ class TestProtoInterop:
     def test_reference_stub_roundtrip(self):
         """A reference-faithful stub sends to us; we send back to a
         reference-faithful servicer."""
-        addrs = {0: ("127.0.0.1", 58211), 1: ("127.0.0.1", 58212)}
+        import socket
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        p0, p1 = free_port(), free_port()
+        addrs = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
         server = ProtoGrpcCommManager(0, addrs)
         got = []
 
@@ -124,12 +132,12 @@ class TestProtoInterop:
         from concurrent import futures
         ref_server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
         ref_server.add_generic_rpc_handlers((handler,))
-        ref_server.add_insecure_port("127.0.0.1:58212")
+        ref_server.add_insecure_port(f"127.0.0.1:{p1}")
         ref_server.start()
 
         try:
             # 1) reference stub → our manager
-            ch = grpc.insecure_channel("127.0.0.1:58211")
+            ch = grpc.insecure_channel(f"127.0.0.1:{p0}")
             payload = message_to_json(
                 Message(type=2, sender_id=1, receiver_id=0)
                 .add("model_params", {"w": [1.0, 2.0]}))
