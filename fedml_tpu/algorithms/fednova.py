@@ -184,7 +184,8 @@ class FedNovaAPI:
             totals = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
             return {**new_colls, "params": new_params}, new_buf, totals
 
-        self._round_fn = jax.jit(round_fn)
+        # donate the dead global model + server momentum buffers
+        self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
         self._eval_fn = jax.jit(make_eval(module, task))
         self._n_pad = dataset.padded_len(cfg.train.batch_size)
         self._base_key = jax.random.key(cfg.seed)

@@ -86,7 +86,8 @@ class FedOptAPI(FedAvgAPI):
             new_vars = {**avg, "params": new_params}
             return new_vars, opt_state, totals
 
-        self._fedopt_round_fn = jax.jit(round_fn)
+        # donate the dead global model + opt state buffers (HBM reuse)
+        self._fedopt_round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
 
     def run_round(self, round_idx: int):
         idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)

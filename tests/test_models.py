@@ -122,7 +122,8 @@ class TestBatchNormThroughFedAvg:
         api = FedAvgAPI(ds, model, config=FedAvgConfig(
             comm_round=1, client_num_per_round=3, frequency_of_the_test=100,
             train=TrainConfig(epochs=1, batch_size=8, lr=0.01)))
-        before = api.variables["batch_stats"]
+        # snapshot by copy: the round donates the variables buffer
+        before = jax.tree.map(jnp.copy, api.variables["batch_stats"])
         api.run_round(0)
         after = api.variables["batch_stats"]
         changed = any(
