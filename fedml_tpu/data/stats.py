@@ -1,0 +1,90 @@
+"""Federation statistics CLI — the reference's per-dataset ``stats.py``.
+
+The reference ships a copy of stats.py per dataset directory (e.g.
+data/MNIST/stats.py: users, total samples, mean/std/skewness of per-client
+counts over the LEAF json). Here one tool works for every registered
+dataset via the loader registry:
+
+    python -m fedml_tpu.data.stats <dataset> [data_dir] [--clients N]
+
+and the same report is available programmatically for any
+:class:`FederatedDataset` (``federation_stats``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict
+
+import numpy as np
+
+from fedml_tpu.data.base import FederatedDataset
+
+
+def federation_stats(ds: FederatedDataset) -> Dict[str, float]:
+    counts = np.asarray([ds.train_data_local_num_dict[c]
+                         for c in sorted(ds.train_data_local_num_dict)],
+                        np.float64)
+    mean = float(counts.mean()) if len(counts) else 0.0
+    std = float(counts.std()) if len(counts) else 0.0
+    # Fisher-Pearson skewness without scipy (reference uses scipy.stats.skew)
+    if len(counts) and std > 0:
+        skew = float(np.mean(((counts - mean) / std) ** 3))
+    else:
+        skew = 0.0
+    out = {
+        "num_users": int(ds.client_num),
+        "num_samples_total": int(counts.sum()),
+        "num_samples_mean": mean,
+        "num_samples_std": std,
+        "num_samples_std_over_mean": std / mean if mean else 0.0,
+        "num_samples_skewness": skew,
+        "test_samples_total": int(ds.test_data_num),
+        "class_num": int(ds.class_num),
+    }
+    # per-class histogram over the train union (partition skew at a glance)
+    y = np.asarray(ds.train_data_global[1])
+    if y.ndim == 1 and np.issubdtype(y.dtype, np.integer):
+        hist = np.bincount(y, minlength=ds.class_num)
+        out["class_histogram"] = hist.tolist()
+    return out
+
+
+def format_stats(name: str, stats: Dict) -> str:
+    lines = [
+        "####################################",
+        f"DATASET: {name}",
+        f"{stats['num_users']} users",
+        f"{stats['num_samples_total']} samples (total)",
+        f"{stats['num_samples_mean']:.2f} samples per user (mean)",
+        f"num_samples (std): {stats['num_samples_std']:.2f}",
+        f"num_samples (std/mean): "
+        f"{stats['num_samples_std_over_mean']:.2f}",
+        f"num_samples (skewness): {stats['num_samples_skewness']:.2f}",
+        f"{stats['test_samples_total']} test samples",
+        f"{stats['class_num']} classes",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    from fedml_tpu.data.registry import LOADERS, load_data
+
+    parser = argparse.ArgumentParser("python -m fedml_tpu.data.stats")
+    parser.add_argument("dataset", choices=sorted(LOADERS))
+    parser.add_argument("data_dir", nargs="?", default="")
+    parser.add_argument("--clients", type=int, default=None,
+                        help="client_num_in_total for generated datasets")
+    args = parser.parse_args(argv)
+    kw = {}
+    if args.clients:
+        kw["client_num_in_total"] = args.clients
+        kw["client_limit"] = args.clients
+    ds = load_data(args.dataset, args.data_dir, **kw)
+    print(format_stats(args.dataset, federation_stats(ds)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

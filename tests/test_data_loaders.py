@@ -315,3 +315,21 @@ class TestSyntheticImageBlob:
             api.run_round(r)
         rec = api.evaluate(5)
         assert rec["test_acc"] > 0.8, rec
+
+
+class TestStats:
+    def test_federation_stats_and_cli_format(self):
+        from fedml_tpu.data.stats import federation_stats, format_stats
+        from fedml_tpu.data.synthetic import make_blob_federated
+
+        ds = make_blob_federated(client_num=6, class_num=4, n_samples=240,
+                                 seed=1)
+        stats = federation_stats(ds)
+        assert stats["num_users"] == 6
+        assert stats["num_samples_total"] == sum(
+            ds.train_data_local_num_dict.values())
+        assert stats["class_num"] == 4
+        assert len(stats["class_histogram"]) == 4
+        assert sum(stats["class_histogram"]) == stats["num_samples_total"]
+        text = format_stats("blob", stats)
+        assert "6 users" in text and "DATASET: blob" in text
