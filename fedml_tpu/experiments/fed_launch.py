@@ -62,8 +62,12 @@ def add_algo_args(parser: argparse.ArgumentParser):
                         choices=["DOL", "PUSHSUM"])
     parser.add_argument("--topology_neighbors_num_undirected", type=int,
                         default=4)
-    # fednas (main_fednas: --arch_learning_rate)
+    # fednas (main_fednas: --arch_learning_rate; --nas_variant gdas =
+    # gumbel-softmax single-path search; --arch_unrolled = 2nd order)
     parser.add_argument("--arch_lr", type=float, default=3e-4)
+    parser.add_argument("--nas_variant", type=str, default="darts",
+                        choices=["darts", "gdas"])
+    parser.add_argument("--arch_unrolled", action="store_true")
     # turboaggregate
     parser.add_argument("--frac_bits", type=int, default=16)
     # fedseg (reference SegmentationLosses / LR_Scheduler knobs)
@@ -184,7 +188,9 @@ def run_algo(args):
                         FedNASConfig(comm_round=args.comm_round,
                                      epochs=args.epochs,
                                      batch_size=args.batch_size, lr=args.lr,
-                                     arch_lr=args.arch_lr, seed=args.seed))
+                                     arch_lr=args.arch_lr, seed=args.seed,
+                                     variant=args.nas_variant,
+                                     arch_unrolled=args.arch_unrolled))
         # FedNASAPI has no train() wrapper: drive the search rounds here
         for r in range(args.comm_round):
             rec = api.run_round(r)
