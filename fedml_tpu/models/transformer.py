@@ -31,11 +31,42 @@ def _default_attention(q, k, v, causal: bool = True):
     return reference_attention(q, k, v, causal=causal)
 
 
+class MoeFFN(nn.Module):
+    """Switch-style MoE replacement for the block MLP (top-1 routing,
+    fixed capacity; parallel/expert.py holds the routing math and the
+    expert-parallel ``all_to_all`` version of the same computation). The
+    load-balancing aux loss is sowed under ``intermediates/moe_aux``."""
+
+    n_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        from fedml_tpu.parallel.expert import moe_ffn_local
+
+        b, s, w = x.shape
+        tokens = x.reshape(b * s, w)
+        hidden = self.mlp_ratio * w
+        init = nn.initializers.lecun_normal()
+        params = {
+            "router": self.param("router", init, (w, self.n_experts)),
+            "w_up": self.param("w_up", init, (self.n_experts, w, hidden)),
+            "w_dn": self.param("w_dn", init, (self.n_experts, hidden, w)),
+        }
+        capacity = max(1, int(self.capacity_factor * b * s
+                              / self.n_experts))
+        out, aux = moe_ffn_local(tokens, params, capacity)
+        self.sow("intermediates", "moe_aux", aux)
+        return out.reshape(b, s, w)
+
+
 class TransformerBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dropout: float = 0.0
     attn_fn: Optional[AttnFn] = None
+    moe_experts: int = 0  # >0: Switch MoE FFN instead of the dense MLP
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -54,9 +85,12 @@ class TransformerBlock(nn.Module):
         x = x + out
 
         h = nn.LayerNorm()(x)
-        h = nn.Dense(self.mlp_ratio * width)(h)
-        h = nn.gelu(h)
-        h = nn.Dense(width)(h)
+        if self.moe_experts > 0:
+            h = MoeFFN(self.moe_experts, self.mlp_ratio)(h)
+        else:
+            h = nn.Dense(self.mlp_ratio * width)(h)
+            h = nn.gelu(h)
+            h = nn.Dense(width)(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
 
@@ -72,6 +106,8 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dropout: float = 0.0
     attn_fn: Optional[AttnFn] = None
+    moe_experts: int = 0   # >0: every `moe_every`-th block is a Switch MoE
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, pos_offset=0):
@@ -88,8 +124,13 @@ class TransformerLM(nn.Module):
         pos = nn.Embed(self.max_len, self.width,
                        name="pos_embed")(jnp.arange(s) + pos_offset)
         x = x + pos[None]
-        for _ in range(self.depth):
+        for i in range(self.depth):
+            is_moe = (self.moe_experts > 0
+                      and (i + 1) % self.moe_every == 0)
             x = TransformerBlock(self.num_heads, dropout=self.dropout,
-                                 attn_fn=self.attn_fn)(x, train=train)
+                                 attn_fn=self.attn_fn,
+                                 moe_experts=(self.moe_experts
+                                              if is_moe else 0))(
+                x, train=train)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size)(x)

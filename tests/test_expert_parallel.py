@@ -73,6 +73,28 @@ class TestExpertParallel:
         shard = sp["w_up"].addressable_shards[0].data
         assert shard.shape == (EXPERTS // 8, WIDTH, HIDDEN)
 
+    def test_moe_transformer_lm(self):
+        """TransformerLM with Switch MoE blocks: params include experts,
+        forward works, aux loss is exposed via intermediates."""
+        from fedml_tpu.models.transformer import TransformerLM
+
+        lm = TransformerLM(vocab_size=64, width=16, depth=2, num_heads=2,
+                           max_len=16, moe_experts=4, moe_every=2)
+        tokens = jnp.asarray(np.random.RandomState(0)
+                             .randint(0, 64, (2, 16)), jnp.int32)
+        variables = lm.init(jax.random.key(0), tokens, train=False)
+        # block 1 (the 2nd) carries the MoE FFN
+        blk = variables["params"]["TransformerBlock_1"]
+        assert "MoeFFN_0" in blk
+        assert blk["MoeFFN_0"]["w_up"].shape == (4, 16, 64)
+        assert "MoeFFN_0" not in variables["params"]["TransformerBlock_0"]
+
+        logits, state = lm.apply(variables, tokens, train=False,
+                                 mutable=["intermediates"])
+        assert logits.shape == (2, 16, 64)
+        aux = jax.tree.leaves(state["intermediates"])
+        assert aux and float(aux[0]) > 0
+
     def test_indivisible_experts_raise(self):
         from fedml_tpu.parallel.expert import make_expert_parallel_ffn
 
