@@ -14,9 +14,6 @@ mask driven by a flax ``drop_path`` RNG collection.
 """
 
 from __future__ import annotations
-
-from typing import Tuple
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

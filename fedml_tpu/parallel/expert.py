@@ -19,10 +19,6 @@ client an expert-parallel sub-mesh).
 """
 
 from __future__ import annotations
-
-import functools
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np
