@@ -14,6 +14,7 @@ mask driven by a flax ``drop_path`` RNG collection.
 """
 
 from __future__ import annotations
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

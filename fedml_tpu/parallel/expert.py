@@ -19,6 +19,7 @@ client an expert-parallel sub-mesh).
 """
 
 from __future__ import annotations
+
 import jax
 import jax.numpy as jnp
 import numpy as np
