@@ -29,7 +29,7 @@ jax.config.update("jax_platforms", "cpu")
 
 # -- fast/slow split --------------------------------------------------------
 # `pytest -m "not slow"` is the CI lane (< 5 min on a 2023 laptop-class box);
-# the full suite runs ~25 min. Measured with --durations; regenerate the
+# the full suite runs ~30 min. Measured with --durations; regenerate the
 # lists when a module's compile load changes (threshold: ~5 s per test).
 
 SLOW_MODULES = {
