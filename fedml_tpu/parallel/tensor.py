@@ -72,6 +72,54 @@ def build_tp_mesh(n_devices: int, axis: str = "tp",
     return Mesh(np.asarray(devs), (axis,))
 
 
+def make_tp_federated_round(model, task: str, cfg, mesh: Mesh,
+                            clients_axis: str = "clients",
+                            tp_axis: str = "tp"):
+    """FedAvg round over a ('clients', 'tp') mesh: sampled clients are
+    data-parallel on one axis while EVERY client's transformer is Megatron-
+    sharded over the other — federated training of a model bigger than one
+    chip. Pure GSPMD: the vmapped round program (the same body the
+    single-axis path runs) is jitted with parameter shardings over ``tp``
+    and client-batch shardings over ``clients``; XLA inserts the per-layer
+    all-reduces inside each client's sub-mesh and the cross-client psum for
+    the weighted aggregate.
+
+    Returns (round_fn, shard_params): ``round_fn(variables, x, y, mask,
+    keys, weights)`` with x [P, n_pad, S] int tokens.
+    """
+    from fedml_tpu.algorithms.fedavg import make_vmapped_body
+    from fedml_tpu.core import pytree as pt
+    from fedml_tpu.trainer.functional import make_local_train
+
+    body = make_vmapped_body(make_local_train(model, task, cfg))
+
+    def round_fn(variables, x, y, mask, keys, weights):
+        stacked, totals = body(variables, x, y, mask, keys)
+        return pt.tree_weighted_mean(stacked, weights), totals
+
+    def to_sharding(tree):
+        specs = transformer_tp_specs(tree, tp_axis)
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda s: isinstance(s, P))
+
+    def shard_params(variables):
+        specs = transformer_tp_specs(variables, tp_axis)
+        return jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+            variables, specs, is_leaf=lambda s: isinstance(s, P))
+
+    def jitted(variables, x, y, mask, keys, weights):
+        data = NamedSharding(mesh, P(clients_axis))
+        fn = jax.jit(
+            round_fn,
+            in_shardings=(to_sharding(variables), data, data, data, data,
+                          data),
+            out_shardings=(to_sharding(variables), None))
+        return fn(variables, x, y, mask, keys, weights)
+
+    return jitted, shard_params
+
+
 def make_tp_train_step(model, mesh: Mesh, lr: float = 1e-3,
                        axis: str = "tp"):
     """One SGD step on the TP-sharded LM. Inputs replicated, params stay in

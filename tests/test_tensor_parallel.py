@@ -38,6 +38,61 @@ class TestSpecs:
             ln, is_leaf=lambda x: isinstance(x, P)))
 
 
+class TestTpFederatedRound:
+    def test_clients_x_tp_round_matches_single_device(self):
+        """FedAvg round on a ('clients', 'tp') 4x2 mesh == the same round
+        unsharded: federated training of a TP-sharded transformer."""
+        from jax.sharding import Mesh
+
+        from fedml_tpu.parallel.tensor import make_tp_federated_round
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        model = TransformerLM(vocab_size=64, width=32, depth=2, num_heads=2,
+                              max_len=8)
+        cfg = TrainConfig(epochs=1, batch_size=4, lr=0.1, shuffle=False)
+        P_clients, n_pad, S = 4, 8, 8
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 64, (P_clients, n_pad, S)).astype(np.int32)
+        y = np.roll(x, -1, axis=-1).astype(np.int32)
+        mask = np.ones((P_clients, n_pad), np.float32)
+        weights = np.full((P_clients,), float(n_pad), np.float32)
+        keys = jax.random.split(jax.random.key(0), P_clients)
+        variables = model.init(jax.random.key(1),
+                               jnp.asarray(x[0, :1]), train=False)
+
+        # single-device oracle
+        from fedml_tpu.algorithms.fedavg import make_vmapped_body
+        from fedml_tpu.core import pytree as pt
+        from fedml_tpu.trainer.functional import make_local_train
+        body = make_vmapped_body(make_local_train(model, "nwp", cfg))
+
+        def oracle(v, x, y, m, k, w):
+            stacked, totals = body(v, x, y, m, k)
+            return pt.tree_weighted_mean(stacked, w), totals
+
+        want, want_stats = jax.jit(oracle)(
+            variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+            keys, jnp.asarray(weights))
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2),
+                    ("clients", "tp"))
+        round_fn, shard_params = make_tp_federated_round(
+            model, "nwp", cfg, mesh)
+        sharded_vars = shard_params(variables)
+        got, got_stats = round_fn(
+            sharded_vars, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+            keys, jnp.asarray(weights))
+
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+        np.testing.assert_allclose(float(got_stats["count"]),
+                                   float(want_stats["count"]))
+        # the aggregated model is still TP-sharded (2 devices per row x 4)
+        k = got["params"]["TransformerBlock_0"]["Dense_0"]["kernel"]
+        assert len(k.sharding.device_set) == 8
+
+
 class TestTpExecution:
     def test_sharded_forward_matches_single_device(self):
         model = _model()
