@@ -177,3 +177,71 @@ def make_sequence_parallel_attention(
     return jax.jit(jax.shard_map(sharded, mesh=mesh,
                                  in_specs=(spec, spec, spec),
                                  out_specs=spec))
+
+
+class _SeqShardedLM:
+    """Adapter giving the trainer's ``module.apply(variables, x, train=...)``
+    contract for a TransformerLM whose sequence axis is sharded: positions
+    are offset by this shard's location on the ``seq`` axis."""
+
+    def __init__(self, lm, seq_axis: str = "seq"):
+        self._lm = lm
+        self._seq_axis = seq_axis
+
+    def apply(self, variables, x, train: bool = False, **kw):
+        offset = jax.lax.axis_index(self._seq_axis) * x.shape[-1]
+        return self._lm.apply(variables, x, train=train, pos_offset=offset,
+                              **kw)
+
+    def init(self, *a, **kw):
+        return self._lm.init(*a, **kw)
+
+
+def make_seq_federated_round(lm, cfg, mesh: Mesh,
+                             clients_axis: str = "clients",
+                             seq_axis: str = "seq", task: str = "nwp"):
+    """FedAvg round over a ('clients', 'seq') mesh: sampled clients are
+    data-parallel on one axis while every client's long sequences are
+    sharded over the other — federated long-context training. The LM must
+    take an ``attn_fn`` spanning the seq axis (ring/ulysses above); the
+    local trainer syncs loss terms and gradients over ``seq`` each step
+    (trainer.functional.make_local_train ``grad_sync_axes``), so all of a
+    client's shards take the identical optimizer step and the round equals
+    its single-device counterpart exactly.
+
+    Inputs: x, y [P, n_pad, S] (token ids, S = GLOBAL length), mask
+    [P, n_pad], keys [P], weights [P]. Returns (replicated new variables,
+    psum'd stats).
+    """
+    from fedml_tpu.parallel.spmd import (_pvary, _weighted_psum_mean)
+    from fedml_tpu.trainer.functional import make_local_train
+
+    module = _SeqShardedLM(lm, seq_axis)
+    local_train = make_local_train(module, task, cfg,
+                                   grad_sync_axes=(seq_axis,))
+
+    def body(variables, x, y, mask, keys, weights):
+        variables = _pvary(variables, (clients_axis, seq_axis))
+        weights = _pvary(weights, (seq_axis,))  # psum'able over both axes
+        stacked, stats = jax.vmap(
+            local_train, in_axes=(None, 0, 0, 0, 0))(variables, x, y, mask,
+                                                     keys)
+        # every seq shard holds the identical client model (grads psum'd per
+        # step), so the weighted mean over BOTH axes equals the mean over
+        # clients — and clears the device-varying type for the replicated
+        # output (psum over seq divides out: n_seq cancels top and bottom)
+        new_vars = _weighted_psum_mean(stacked, weights,
+                                       (clients_axis, seq_axis))
+        # stats were already psum'd over seq inside the loss; only the
+        # client axis remains
+        totals = jax.tree.map(
+            lambda s: jax.lax.psum(jnp.sum(s, axis=0), clients_axis), stats)
+        return new_vars, totals
+
+    seq_data = P(clients_axis, None, seq_axis)
+    flat = P(clients_axis)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), seq_data, seq_data, flat, flat, flat),
+        out_specs=(P(), P()),
+    ))

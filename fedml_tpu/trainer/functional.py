@@ -104,13 +104,19 @@ def make_batch_schedule(n_pad: int, epochs: int, bsz: int, shuffle: bool,
     return batch_idx, step_keys
 
 
-def make_local_train(module, task: str, cfg: TrainConfig):
+def make_local_train(module, task: str, cfg: TrainConfig,
+                     grad_sync_axes: tuple = ()):
     """Build ``local_train(variables, x, y, mask, rng) -> (variables, stats)``.
 
     One call = the reference's ``ModelTrainer.train`` for one client: fresh
     optimizer (the reference constructs a new torch optimizer every call, so
     client momentum never crosses rounds), ``cfg.epochs`` passes with per-epoch
     reshuffling, mask-weighted per-batch mean loss.
+
+    ``grad_sync_axes``: mesh axis names this client's model is itself
+    sharded over inside a ``shard_map`` (e.g. ('seq',) for sequence-parallel
+    clients): per-step loss terms and gradients are psum'd over them so
+    every shard takes the identical optimizer step.
     """
     head: TaskHead = TASK_HEADS[task]
     forward = make_forward(module)
@@ -159,10 +165,21 @@ def make_local_train(module, task: str, cfg: TrainConfig):
                     out, new_vars = forward({"params": p, **colls}, xb,
                                             True, key)
                 stats = head(out, yb, mb)
+                if grad_sync_axes:
+                    # the client's loss is over ALL shards' tokens; summing
+                    # the stat sums here makes the step (and its gradient,
+                    # via the psum transpose) globally correct
+                    stats = jax.tree.map(
+                        lambda s: jax.lax.psum(s, grad_sync_axes), stats)
                 loss = stats["loss_sum"] / jnp.maximum(stats["count"], 1.0)
                 return loss, (new_vars, stats)
 
             grads, (new_vars, stats) = jax.grad(loss_fn, has_aux=True)(params)
+            if grad_sync_axes:
+                # each shard's backward holds only its tokens' terms of
+                # d[psum(loss_sum)/psum(count)]/dθ; the psum completes the
+                # exact full-sequence gradient on every shard
+                grads = jax.lax.psum(grads, grad_sync_axes)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             # padding-only batches (small client, dataset-wide n_pad) must be
