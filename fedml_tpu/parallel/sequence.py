@@ -23,8 +23,11 @@ Two standard schemes, both as ``shard_map``-ready collectives:
   heads >= devices and ICI all-to-all bandwidth is plentiful.
 
 Both are pure functions of per-shard arrays and compose with the
-``clients`` axis (a 2-D ('clients', 'seq') mesh gives every federated
-client a sequence-parallel sub-mesh).
+``clients`` axis: :func:`make_seq_federated_round` runs the FULL FedAvg
+round on a ('clients', 'seq') mesh — every federated client trains over
+ring-attended long sequences on its own sub-mesh, with per-step gradient
+sync over ``seq`` — and matches the single-device round exactly
+(tests/test_seq_federated.py).
 """
 
 from __future__ import annotations
