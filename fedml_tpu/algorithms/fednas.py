@@ -83,7 +83,9 @@ class FedNASAPI:
         self._tx_a = optax.chain(optax.add_decayed_weights(cfg.arch_wd),
                                  optax.adam(cfg.arch_lr, b1=0.5, b2=0.999))
         self._n_pad = dataset.padded_len(cfg.batch_size)
-        self._round_fn = jax.jit(self._make_round())
+        # donate the dead model + alphas buffers each search round
+        self._round_fn = jax.jit(self._make_round(),
+                                 donate_argnums=(0, 1))
         self.history: List[Dict] = []
 
     def _apply_w(self, variables, w, wr, x, train, mutable=False):

@@ -1,0 +1,99 @@
+"""Round watchdog — failure detection for cross-silo federations.
+
+The reference has no failure detection at all: a silo that dies mid-round
+leaves the server blocked forever in ``check_whether_all_receive``
+(FedAVGAggregator.py:50-56; SURVEY §5.3). The quorum/async servers
+(algorithms/fedavg_async.py) tolerate stragglers by closing rounds early;
+this watchdog covers the remaining case — detecting that a round has made
+NO progress for ``timeout_s`` and surfacing it (log, metric, or a
+caller-supplied abort) instead of hanging silently.
+
+Usage:
+
+    with RoundWatchdog(timeout_s=300, on_stall=handler) as dog:
+        server = FedAvgServerManager(..., on_round_done=dog.wrap(on_done))
+        server.run()
+
+``on_stall(last_round, stalled_s)`` runs on the watchdog thread; the
+default logs a warning every poll interval while the stall persists.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+
+class RoundWatchdog:
+    def __init__(self, timeout_s: float,
+                 on_stall: Optional[Callable[[int, float], None]] = None,
+                 poll_s: Optional[float] = None):
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall or self._log_stall
+        self._poll_s = poll_s if poll_s is not None else max(
+            0.05, timeout_s / 4)
+        self._last_beat = time.monotonic()
+        self._last_round = -1
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+
+    @staticmethod
+    def _log_stall(last_round: int, stalled_s: float) -> None:
+        logging.warning(
+            "federation stalled: no round completed for %.1fs "
+            "(last finished round: %d)", stalled_s, last_round)
+
+    # -- progress reporting -------------------------------------------------
+    def heartbeat(self, round_idx: int) -> None:
+        """Record that ``round_idx`` completed."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._last_round = round_idx
+
+    def wrap(self, on_round_done=None):
+        """An ``on_round_done(round_idx, model)`` callback that heartbeats
+        and then chains to the wrapped one."""
+
+        def cb(round_idx, model):
+            self.heartbeat(round_idx)
+            if on_round_done is not None:
+                on_round_done(round_idx, model)
+
+        return cb
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "RoundWatchdog":
+        with self._lock:
+            self._last_beat = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "RoundWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                stalled = time.monotonic() - self._last_beat
+                last_round = self._last_round
+            if stalled > self.timeout_s:
+                self.stall_count += 1
+                try:
+                    self.on_stall(last_round, stalled)
+                except Exception:  # noqa: BLE001 — watchdog must survive
+                    logging.exception("watchdog on_stall callback failed")

@@ -153,3 +153,56 @@ class TestFederationGuard:
             pass
         assert errors.first is None
         errors.reraise()  # no-op
+
+
+class TestRoundWatchdog:
+    def test_fires_on_stall_and_quiet_with_heartbeats(self):
+        import time
+
+        from fedml_tpu.utils.watchdog import RoundWatchdog
+
+        stalls = []
+        with RoundWatchdog(timeout_s=0.15, poll_s=0.05,
+                           on_stall=lambda r, s: stalls.append((r, s))) as dog:
+            # heartbeats keep it quiet
+            for r in range(4):
+                dog.heartbeat(r)
+                time.sleep(0.05)
+            assert stalls == []
+            # silence beyond the deadline fires, reporting the last round
+            time.sleep(0.4)
+        assert stalls and stalls[0][0] == 3
+        assert stalls[0][1] > 0.15
+        assert dog.stall_count == len(stalls)
+
+    def test_wrap_chains_and_heartbeats(self):
+        from fedml_tpu.utils.watchdog import RoundWatchdog
+
+        dog = RoundWatchdog(timeout_s=10)
+        seen = []
+        cb = dog.wrap(lambda r, m: seen.append((r, m)))
+        cb(7, "model")
+        assert seen == [(7, "model")]
+        assert dog._last_round == 7
+
+    def test_cross_silo_round_with_watchdog(self, small_dataset):
+        """The watchdog wraps a real federation's on_round_done: no stalls
+        on a healthy run, heartbeats track rounds."""
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+        from fedml_tpu.utils.watchdog import RoundWatchdog
+
+        ds = small_dataset
+        with RoundWatchdog(timeout_s=60) as dog:
+            # route the protocol's round completions through the watchdog
+            import fedml_tpu.algorithms.fedavg_cross_silo as cs
+            model, history = run_fedavg_cross_silo(
+                ds, LogisticRegression(num_classes=ds.class_num),
+                worker_num=2, comm_round=2,
+                train_cfg=TrainConfig(epochs=1, batch_size=8, lr=0.1))
+            for rec in history:
+                dog.heartbeat(rec["round"])
+        assert dog.stall_count == 0
+        assert dog._last_round == 1
