@@ -13,7 +13,7 @@ server update — runs inside the one jitted round program.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Optional
 
 import jax
 import optax

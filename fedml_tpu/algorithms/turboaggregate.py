@@ -22,7 +22,7 @@ round-off per client); the protocol itself is exact, which the tests pin.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Optional
 
 import jax
 import numpy as np

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Dict, Optional
+from typing import Dict
 
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.message import Message

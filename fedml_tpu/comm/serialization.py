@@ -16,7 +16,7 @@ Scalars, strings, bools and None ride in the header itself.
 from __future__ import annotations
 
 import struct
-from typing import Any, List, Tuple
+from typing import Any, List
 
 import msgpack
 import numpy as np

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import Iterator, List, Tuple
+from typing import Iterator, Tuple
 
 import numpy as np
 

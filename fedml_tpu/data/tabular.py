@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 

@@ -21,7 +21,6 @@ TPU-first deltas:
 from __future__ import annotations
 
 from collections import namedtuple
-from typing import Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -14,7 +14,7 @@ it like the reference averages the full state_dict.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

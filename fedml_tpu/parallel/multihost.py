@@ -20,7 +20,7 @@ process_count == 1 (which is also how unit tests cover the logic).
 from __future__ import annotations
 
 import logging
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
