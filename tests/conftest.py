@@ -69,6 +69,8 @@ SLOW_TESTS = {
     "test_checkpoint_resume.py::TestSpmdResume::test_resume_is_bit_identical",
     "test_checkpoint_resume.py::TestCrossSiloResume::"
     "test_resume_is_bit_identical",
+    "test_checkpoint_resume.py::TestKillMidRun::"
+    "test_sigkill_then_resume_completes",
     "test_algorithms.py::TestHierarchical::test_grouped_training_learns",
     "test_utils.py::TestCheckpoint::test_resume_continues_identically",
     "test_torch_import.py::test_fedgkt_warm_start",

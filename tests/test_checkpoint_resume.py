@@ -61,6 +61,63 @@ class TestSpmdResume:
         assert mgr.latest_round() == 1
 
 
+class TestKillMidRun:
+    def test_sigkill_then_resume_completes(self, tmp_path):
+        """Hard-kill a checkpointing cross-silo run mid-flight (SIGKILL, no
+        cleanup), then rerun with --resume: the federation finishes from the
+        last complete checkpoint (atomic tmp+rename writes guarantee no torn
+        state)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ckdir = str(tmp_path / "ck")
+        flags = ["--dataset", "blob", "--model", "lr", "--backend", "inproc",
+                 "--client_num_in_total", "4", "--client_num_per_round", "2",
+                 "--comm_round", "40", "--epochs", "1", "--batch_size", "8",
+                 "--checkpoint_dir", ckdir,
+                 "--run_dir", str(tmp_path / "runs")]
+        # force the CPU platform at config level (env plugins may override
+        # JAX_PLATFORMS programmatically — same trick as conftest.py)
+        code = ("import jax; jax.config.update('jax_platforms', 'cpu');"
+                "import sys;"
+                "from fedml_tpu.experiments.main_fedavg import main;"
+                "main(sys.argv[1:])")
+        args = [sys.executable, "-c", code] + flags
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+        proc = subprocess.Popen(args, cwd=repo, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        # wait until at least one round checkpointed, then SIGKILL
+        deadline = time.time() + 120
+        mgr = CheckpointManager(ckdir)
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("run finished before it could be killed; "
+                            "raise comm_round")
+            if (mgr.latest_round() or 0) >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            proc.kill()
+            pytest.fail("no checkpoint appeared within 120s")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        killed_at = mgr.latest_round()
+        assert killed_at is not None and killed_at < 40
+
+        out = subprocess.run(args + ["--resume"], cwd=repo, env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert mgr.latest_round() == 40
+
+
 class TestCrossSiloResume:
     def _run(self, ds, comm_round, checkpoint_dir=None, resume=False):
         from fedml_tpu.algorithms.fedavg_cross_silo import (
