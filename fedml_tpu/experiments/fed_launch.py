@@ -68,6 +68,10 @@ def add_algo_args(parser: argparse.ArgumentParser):
     parser.add_argument("--nas_variant", type=str, default="darts",
                         choices=["darts", "gdas"])
     parser.add_argument("--arch_unrolled", action="store_true")
+    parser.add_argument("--nas_retrain_rounds", type=int, default=0,
+                        help="after the search, FedAvg-train the derived "
+                             "genotype network for N rounds (reference "
+                             "search->train workflow)")
     # turboaggregate
     parser.add_argument("--frac_bits", type=int, default=16)
     # fedseg (reference SegmentationLosses / LR_Scheduler knobs)
@@ -198,7 +202,30 @@ def run_algo(args):
                      step=r)
             logging.info("round %d: search_loss=%.4f", r, rec["search_loss"])
         final = {**api.evaluate(), "genotype": str(api.history[-1]["genotype"])}
-        sink.log(final)
+        if args.nas_retrain_rounds > 0:
+            # the second half of the NAS workflow (reference model.py /
+            # train.py): freeze the searched genotype into a fixed
+            # evaluation network and train it federated from scratch
+            from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+            from fedml_tpu.models.darts_eval import GenotypeNetwork
+
+            eval_net = GenotypeNetwork(
+                genotype=api.genotype(), C=8, num_classes=ds.class_num,
+                layers=3, stem_multiplier=1)
+            retrain = FedAvgAPI(
+                ds, eval_net,
+                config=FedAvgConfig(
+                    comm_round=args.nas_retrain_rounds,
+                    client_num_per_round=args.client_num_per_round,
+                    frequency_of_the_test=args.frequency_of_the_test,
+                    seed=args.seed, train=tcfg))
+            retrain_final = retrain.train()
+            for rec in retrain.history:
+                sink.log({f"retrain_{k}": v for k, v in rec.items()},
+                         step=rec.get("round"))
+            final.update({f"retrain_{k}": v
+                          for k, v in retrain_final.items()})
+        sink.log({k: v for k, v in final.items() if k != "genotype"})
         sink.finish()
         logging.info("final: %s", final)
         return final

@@ -59,6 +59,8 @@ SLOW_TESTS = {
     "test_experiments.py::TestFedAvgMain::"
     "test_resume_matches_uninterrupted_run",
     "test_experiments.py::TestFedAvgMain::test_spmd_backend",
+    "test_experiments.py::TestNasRetrain::"
+    "test_search_then_retrain_via_launcher",
     "test_split_vertical.py::TestVerticalFL::"
     "test_party_gradient_matches_global_autograd",
     "test_contribution.py::TestLeaveOneOut::"

@@ -127,3 +127,20 @@ class TestFedLaunch:
         import pytest
         with pytest.raises(SystemExit, match="per-pixel"):
             fed_launch.main(self._common(tmp_path, "fedseg"))
+
+
+class TestNasRetrain:
+    def test_search_then_retrain_via_launcher(self, tmp_path):
+        """The full NAS workflow: 2 search rounds derive a genotype, then
+        the fixed evaluation network FedAvg-trains for 2 rounds."""
+        from fedml_tpu.experiments.fed_launch import main as launch_main
+
+        final = launch_main([
+            "--algo", "fednas", "--dataset", "img_blob",
+            "--client_num_in_total", "2", "--client_num_per_round", "2",
+            "--comm_round", "2", "--epochs", "1", "--batch_size", "8",
+            "--nas_retrain_rounds", "2", "--frequency_of_the_test", "1",
+            "--run_dir", str(tmp_path)])
+        assert "genotype" in final
+        assert "retrain_test_acc" in final
+        assert 0.0 <= final["retrain_test_acc"] <= 1.0
