@@ -35,28 +35,44 @@ class MoeFFN(nn.Module):
     """Switch-style MoE replacement for the block MLP (top-1 routing,
     fixed capacity; parallel/expert.py holds the routing math and the
     expert-parallel ``all_to_all`` version of the same computation). The
-    load-balancing aux loss is sowed under ``intermediates/moe_aux``."""
+    load-balancing aux loss is sowed under ``intermediates/moe_aux``.
+
+    ``ep_axis``: when the module runs inside ``shard_map`` over an expert-
+    parallel mesh axis, set it (and pass ``n_shards``) to dispatch tokens
+    with one all_to_all each way; the expert params must then be sharded
+    [E/N, ...] on that axis (parallel/expert.expert_sharded_params spec).
+    """
 
     n_experts: int
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
+    n_shards: int = 1
 
     @nn.compact
     def __call__(self, x):
-        from fedml_tpu.parallel.expert import moe_ffn_local
+        from fedml_tpu.parallel.expert import (expert_parallel_ffn,
+                                               moe_ffn_local)
 
         b, s, w = x.shape
         tokens = x.reshape(b * s, w)
         hidden = self.mlp_ratio * w
         init = nn.initializers.lecun_normal()
+        e_local = (self.n_experts // self.n_shards
+                   if self.ep_axis else self.n_experts)
         params = {
             "router": self.param("router", init, (w, self.n_experts)),
-            "w_up": self.param("w_up", init, (self.n_experts, w, hidden)),
-            "w_dn": self.param("w_dn", init, (self.n_experts, hidden, w)),
+            "w_up": self.param("w_up", init, (e_local, w, hidden)),
+            "w_dn": self.param("w_dn", init, (e_local, hidden, w)),
         }
         capacity = max(1, int(self.capacity_factor * b * s
                               / self.n_experts))
-        out, aux = moe_ffn_local(tokens, params, capacity)
+        if self.ep_axis:
+            out, aux = expert_parallel_ffn(tokens, params, self.n_experts,
+                                           capacity, self.n_shards,
+                                           self.ep_axis)
+        else:
+            out, aux = moe_ffn_local(tokens, params, capacity)
         self.sow("intermediates", "moe_aux", aux)
         return out.reshape(b, s, w)
 
@@ -67,6 +83,9 @@ class TransformerBlock(nn.Module):
     dropout: float = 0.0
     attn_fn: Optional[AttnFn] = None
     moe_experts: int = 0  # >0: Switch MoE FFN instead of the dense MLP
+    moe_ep_axis: Optional[str] = None  # expert-parallel mesh axis
+    moe_n_shards: int = 1
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -86,7 +105,10 @@ class TransformerBlock(nn.Module):
 
         h = nn.LayerNorm()(x)
         if self.moe_experts > 0:
-            h = MoeFFN(self.moe_experts, self.mlp_ratio)(h)
+            h = MoeFFN(self.moe_experts, self.mlp_ratio,
+                       capacity_factor=self.moe_capacity_factor,
+                       ep_axis=self.moe_ep_axis,
+                       n_shards=self.moe_n_shards)(h)
         else:
             h = nn.Dense(self.mlp_ratio * width)(h)
             h = nn.gelu(h)
@@ -108,6 +130,9 @@ class TransformerLM(nn.Module):
     attn_fn: Optional[AttnFn] = None
     moe_experts: int = 0   # >0: every `moe_every`-th block is a Switch MoE
     moe_every: int = 2
+    moe_ep_axis: Optional[str] = None  # run MoE FFNs expert-parallel
+    moe_n_shards: int = 1
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, pos_offset=0):
@@ -130,7 +155,11 @@ class TransformerLM(nn.Module):
             x = TransformerBlock(self.num_heads, dropout=self.dropout,
                                  attn_fn=self.attn_fn,
                                  moe_experts=(self.moe_experts
-                                              if is_moe else 0))(
+                                              if is_moe else 0),
+                                 moe_ep_axis=self.moe_ep_axis,
+                                 moe_n_shards=self.moe_n_shards,
+                                 moe_capacity_factor=(
+                                     self.moe_capacity_factor))(
                 x, train=train)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size)(x)

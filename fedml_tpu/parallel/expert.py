@@ -84,46 +84,53 @@ def moe_ffn_local(x, params, capacity: int):
     return out, aux
 
 
+def expert_parallel_ffn(x, params, n_experts: int, capacity: int,
+                        n_shards: int, axis: str = "ep"):
+    """The collective MoE FFN body (call inside shard_map): x [T_local, w]
+    tokens sharded on the batch axis, params sharded with router replicated
+    and w_up/w_dn [E_local, ...] on the same ``axis``; one all_to_all each
+    way."""
+    dispatch, combine, (frac, mean_prob) = _route_top1(
+        x, params["router"], n_experts, capacity)
+    # globalize the statistics BEFORE the product so the sharded aux
+    # equals the single-device aux exactly (the loss is nonlinear)
+    aux = _aux_loss(jax.lax.pmean(frac, axis),
+                    jax.lax.pmean(mean_prob, axis))
+    buffers = jnp.einsum("tec,tw->ecw", dispatch, x)  # [E, C, w]
+    # exchange: every shard sends each expert-group its buffers;
+    # arrives as [E_local, N*C, w] after re-gluing the shard axis
+    buffers = buffers.reshape(n_shards, n_experts // n_shards,
+                              capacity, x.shape[-1])
+    recv = jax.lax.all_to_all(buffers, axis, split_axis=0,
+                              concat_axis=0, tiled=False)
+    # recv: [N, E_local, C, w] — N source shards' queues per local expert
+    e_loc = n_experts // n_shards
+    recv = recv.transpose(1, 0, 2, 3).reshape(
+        e_loc, n_shards * capacity, x.shape[-1])
+    h = jax.nn.gelu(jnp.einsum("ecw,ewh->ech", recv, params["w_up"]))
+    out_buf = jnp.einsum("ech,ehw->ecw", h, params["w_dn"])
+    # return trip: split back per source shard and all_to_all home
+    out_buf = out_buf.reshape(e_loc, n_shards, capacity,
+                              x.shape[-1]).transpose(1, 0, 2, 3)
+    back = jax.lax.all_to_all(out_buf, axis, split_axis=0,
+                              concat_axis=0, tiled=False)
+    back = back.reshape(n_experts, capacity, x.shape[-1])
+    out = jnp.einsum("tec,ecw->tw", combine, back)
+    return out, aux
+
+
 def make_expert_parallel_ffn(mesh: Mesh, n_experts: int, capacity: int,
                              axis: str = "ep"):
     """Build ``ffn(x_local, params_sharded) -> (out_local, aux)`` to run
-    under shard_map: tokens sharded on the batch axis, experts sharded on
-    the same ``ep`` axis, one all_to_all each way."""
+    under shard_map (see :func:`expert_parallel_ffn`)."""
     n_shards = mesh.shape[axis]
     if n_experts % n_shards:
         raise ValueError(f"n_experts={n_experts} must divide over "
                          f"{axis}={n_shards}")
 
     def ffn(x, params):
-        # x: [T_local, w]; params sharded: router replicated,
-        # w_up/w_dn [E_local, ...]
-        dispatch, combine, (frac, mean_prob) = _route_top1(
-            x, params["router"], n_experts, capacity)
-        # globalize the statistics BEFORE the product so the sharded aux
-        # equals the single-device aux exactly (the loss is nonlinear)
-        aux = _aux_loss(jax.lax.pmean(frac, axis),
-                        jax.lax.pmean(mean_prob, axis))
-        buffers = jnp.einsum("tec,tw->ecw", dispatch, x)  # [E, C, w]
-        # exchange: every shard sends each expert-group its buffers;
-        # arrives as [E_local, N*C, w] after re-gluing the shard axis
-        buffers = buffers.reshape(n_shards, n_experts // n_shards,
-                                  capacity, x.shape[-1])
-        recv = jax.lax.all_to_all(buffers, axis, split_axis=0,
-                                  concat_axis=0, tiled=False)
-        # recv: [N, E_local, C, w] — N source shards' queues per local expert
-        e_loc = n_experts // n_shards
-        recv = recv.transpose(1, 0, 2, 3).reshape(
-            e_loc, n_shards * capacity, x.shape[-1])
-        h = jax.nn.gelu(jnp.einsum("ecw,ewh->ech", recv, params["w_up"]))
-        out_buf = jnp.einsum("ech,ehw->ecw", h, params["w_dn"])
-        # return trip: split back per source shard and all_to_all home
-        out_buf = out_buf.reshape(e_loc, n_shards, capacity,
-                                  x.shape[-1]).transpose(1, 0, 2, 3)
-        back = jax.lax.all_to_all(out_buf, axis, split_axis=0,
-                                  concat_axis=0, tiled=False)
-        back = back.reshape(n_experts, capacity, x.shape[-1])
-        out = jnp.einsum("tec,ecw->tw", combine, back)
-        return out, aux
+        return expert_parallel_ffn(x, params, n_experts, capacity, n_shards,
+                                   axis)
 
     return ffn
 

@@ -95,6 +95,45 @@ class TestExpertParallel:
         aux = jax.tree.leaves(state["intermediates"])
         assert aux and float(aux[0]) > 0
 
+    def test_moe_lm_runs_expert_parallel_under_shard_map(self):
+        """The SAME MoE LM weights run expert-parallel: tokens sharded on
+        batch, expert FFN weights sharded [E/N,...] over 'ep', one
+        all_to_all each way — output equals the single-device MoE LM
+        (capacity set so nothing overflows on either path)."""
+        from jax.sharding import PartitionSpec as P
+
+        from fedml_tpu.models.transformer import TransformerLM
+
+        # capacity factor high enough that no token overflows on either
+        # path (different per-shard vs global queues otherwise diverge)
+        kw = dict(vocab_size=64, width=16, depth=2, num_heads=2, max_len=16,
+                  moe_experts=8, moe_every=2, moe_capacity_factor=8.0)
+        lm_local = TransformerLM(**kw)
+        lm_ep = TransformerLM(moe_ep_axis="ep", moe_n_shards=8, **kw)
+
+        tokens = jnp.asarray(np.random.RandomState(3)
+                             .randint(0, 64, (8, 16)), jnp.int32)
+        variables = lm_local.init(jax.random.key(0), tokens, train=False)
+        want = lm_local.apply(variables, tokens, train=False)
+
+        def specs(tree):
+            def leaf_spec(path, leaf):
+                names = [getattr(p, "key", "") for p in path]
+                if any(n.startswith("MoeFFN") for n in names) and \
+                        names[-1] in ("w_up", "w_dn"):
+                    return P("ep")
+                return P()
+            return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+        mesh = build_mesh({"ep": 8})
+        fwd = jax.jit(jax.shard_map(
+            lambda v, t: lm_ep.apply(v, t, train=False),
+            mesh=mesh, in_specs=(specs(variables), P("ep")),
+            out_specs=P("ep")))
+        got = fwd(variables, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-4, atol=3e-5)
+
     def test_indivisible_experts_raise(self):
         from fedml_tpu.parallel.expert import make_expert_parallel_ffn
 
