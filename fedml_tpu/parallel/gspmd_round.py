@@ -1,0 +1,67 @@
+"""Shared factory for federated rounds over a ('clients', <model>) mesh.
+
+Both Megatron-TP (parallel/tensor.py) and ZeRO-FSDP (parallel/fsdp.py)
+federated rounds are the same program — the vmapped FedAvg body (local SGD
+scan + weighted aggregation, the semantics of the reference's
+FedAVGAggregator.py:58-87 round) jitted with *parameter* shardings over the
+model axis and *client-batch* shardings over the clients axis; only the
+parameter-spec rule differs. This factory holds the single copy of the
+round body, the sharding wiring, and the one-compile jit cache.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_sharded_federated_round(model, task: str, cfg, mesh: Mesh,
+                                 param_specs_fn: Callable,
+                                 clients_axis: str = "clients"):
+    """FedAvg round with model params sharded per ``param_specs_fn``.
+
+    ``param_specs_fn(variables) -> PartitionSpec tree`` decides the model
+    layout (Megatron column/row, ZeRO largest-axis, ...). Client batches
+    shard over ``clients_axis``; XLA inserts the intra-client collectives
+    the param layout implies plus the cross-client weighted-mean reduce.
+
+    Returns ``(round_fn, shard_params)``: ``round_fn(variables, x, y,
+    mask, keys, weights)``; ``shard_params`` places a replicated variables
+    tree into the model layout.
+    """
+    from fedml_tpu.algorithms.fedavg import make_vmapped_body
+    from fedml_tpu.core import pytree as pt
+    from fedml_tpu.trainer.functional import make_local_train
+
+    body = make_vmapped_body(make_local_train(model, task, cfg))
+
+    def round_fn(variables, x, y, mask, keys, weights):
+        stacked, totals = body(variables, x, y, mask, keys)
+        return pt.tree_weighted_mean(stacked, weights), totals
+
+    def to_sharding(tree):
+        return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            param_specs_fn(tree),
+                            is_leaf=lambda s: isinstance(s, P))
+
+    def shard_params(variables):
+        return jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+            variables, param_specs_fn(variables),
+            is_leaf=lambda s: isinstance(s, P))
+
+    _jit = {}  # one compile across rounds
+
+    def jitted(variables, x, y, mask, keys, weights):
+        if "fn" not in _jit:
+            data = NamedSharding(mesh, P(clients_axis))
+            _jit["fn"] = jax.jit(
+                round_fn,
+                in_shardings=(to_sharding(variables), data, data, data,
+                              data, data),
+                out_shardings=(to_sharding(variables), None))
+        return _jit["fn"](variables, x, y, mask, keys, weights)
+
+    return jitted, shard_params

@@ -87,37 +87,12 @@ def make_tp_federated_round(model, task: str, cfg, mesh: Mesh,
     Returns (round_fn, shard_params): ``round_fn(variables, x, y, mask,
     keys, weights)`` with x [P, n_pad, S] int tokens.
     """
-    from fedml_tpu.algorithms.fedavg import make_vmapped_body
-    from fedml_tpu.core import pytree as pt
-    from fedml_tpu.trainer.functional import make_local_train
+    from fedml_tpu.parallel.gspmd_round import make_sharded_federated_round
 
-    body = make_vmapped_body(make_local_train(model, task, cfg))
-
-    def round_fn(variables, x, y, mask, keys, weights):
-        stacked, totals = body(variables, x, y, mask, keys)
-        return pt.tree_weighted_mean(stacked, weights), totals
-
-    def to_sharding(tree):
-        specs = transformer_tp_specs(tree, tp_axis)
-        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                            is_leaf=lambda s: isinstance(s, P))
-
-    def shard_params(variables):
-        specs = transformer_tp_specs(variables, tp_axis)
-        return jax.tree.map(
-            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
-            variables, specs, is_leaf=lambda s: isinstance(s, P))
-
-    def jitted(variables, x, y, mask, keys, weights):
-        data = NamedSharding(mesh, P(clients_axis))
-        fn = jax.jit(
-            round_fn,
-            in_shardings=(to_sharding(variables), data, data, data, data,
-                          data),
-            out_shardings=(to_sharding(variables), None))
-        return fn(variables, x, y, mask, keys, weights)
-
-    return jitted, shard_params
+    return make_sharded_federated_round(
+        model, task, cfg, mesh,
+        lambda tree: transformer_tp_specs(tree, tp_axis),
+        clients_axis=clients_axis)
 
 
 def make_tp_train_step(model, mesh: Mesh, lr: float = 1e-3,

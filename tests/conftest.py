@@ -76,6 +76,9 @@ SLOW_TESTS = {
     "test_algorithms.py::TestHierarchical::test_grouped_training_learns",
     "test_utils.py::TestCheckpoint::test_resume_continues_identically",
     "test_torch_import.py::test_fedgkt_warm_start",
+    "test_fsdp.py::TestTrainStep::test_fsdp_step_matches_single_device",
+    "test_fsdp.py::TestFsdpFederatedRound::"
+    "test_clients_x_fsdp_round_matches_single_device",
 }
 
 
