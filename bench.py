@@ -334,28 +334,132 @@ def bench_torch_baseline() -> float:
     return BASELINE_ROUNDS / (time.perf_counter() - t0)
 
 
-def _run(name, fn):
-    """Isolate workloads: one failing stage reports an error string instead
-    of zeroing the whole bench."""
+class _StageTimeout(BaseException):
+    # BaseException so broad `except Exception` blocks inside a stage
+    # (e.g. _round_flops' cost-model fallback) cannot swallow the timeout
+    pass
+
+
+def _run(name, fn, timeout_s: int = 420):
+    """Isolate workloads: one failing OR HUNG stage reports an error string
+    instead of zeroing the whole bench. The alarm guards against a stalled
+    device tunnel (observed: a wedged chip blocks the first dispatch
+    forever); a stage that trips it is reported and the suite moves on."""
+    import signal
+
+    timeout_s = int(os.environ.get("FEDML_BENCH_STAGE_TIMEOUT_S", timeout_s))
+
+    def on_alarm(signum, frame):
+        raise _StageTimeout(f"{name} exceeded {timeout_s}s")
+
     _log(f"start {name}")
+    prev = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(timeout_s)
     try:
         out = fn()
         _log(f"done  {name}: {out}")
         return out
+    except _StageTimeout as exc:
+        _log(f"TIMEOUT {name}: {exc}")
+        return {"error": f"stage timeout after {timeout_s}s "
+                         "(device tunnel stalled?)"}
     except Exception as exc:  # noqa: BLE001 — survive and report
         _log(f"FAIL  {name}: {exc!r}")
         return {"error": repr(exc)}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def _emit(line: dict) -> None:
+    """Print the driver contract line AND persist it to
+    runs/bench_details.json (also on failure paths, so a stale success
+    file can never shadow the latest outcome)."""
+    os.makedirs("runs", exist_ok=True)
+    with open(os.path.join("runs", "bench_details.json"), "w") as f:
+        json.dump(line, f, indent=2)
+    print(json.dumps(line), flush=True)
+
+
+def _arm_global_watchdog(deadline_s: int, partial: dict) -> None:
+    """Last line of defense: a daemon thread that force-exits the process
+    if the whole suite overruns. SIGALRM cannot interrupt a main thread
+    wedged inside the native device client (observed live), but a sibling
+    thread still runs — it emits the contract line with whatever stages
+    completed, then hard-exits."""
+    import threading
+
+    def fire():
+        _log(f"GLOBAL TIMEOUT after {deadline_s}s — emitting partial line")
+        flagship = partial.get("fedavg_femnist_cnn") or {}
+        _emit({
+            "metric": "fedavg_rounds_per_sec_femnist_cnn",
+            "value": flagship.get("rounds_per_sec", 0.0),
+            "unit": "rounds/s",
+            "vs_baseline": None,
+            "extra": {**partial,
+                      "error": f"global bench timeout after {deadline_s}s "
+                               "(device stalled mid-suite)"},
+        })
+        os._exit(1)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
+
+
+def _probe_device(timeout_s: int = 180):
+    """Check the device is reachable from a SUBPROCESS with a hard timeout.
+
+    A wedged device tunnel hangs inside native client init where Python
+    signal handlers never run (observed live: SIGALRM undelivered for
+    minutes), so an in-process guard cannot save the bench — probe in a
+    child, and only initialize the backend here once the child succeeds."""
+    import subprocess
+
+    code = ("import json, jax; print(json.dumps("
+            "{'backend': jax.default_backend(),"
+            " 'device': jax.devices()[0].device_kind}))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"device probe hung for {timeout_s}s "
+                         "(tunnel stalled)"}
+    if proc.returncode != 0:
+        return {"error": "device probe failed: " + proc.stderr[-500:]}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {"error": "device probe unparseable: " + proc.stdout[-500:]}
 
 
 def main():
-    import jax
-    _log(f"backend={jax.default_backend()} "
-         f"device={jax.devices()[0].device_kind!r}")
-    flagship = _run("fedavg_femnist_cnn", bench_fedavg_cnn)
-    flagship_bf16 = _run("fedavg_femnist_cnn_bf16", bench_fedavg_cnn_bf16)
-    resnet = _run("resnet18_gn", bench_resnet18_gn)
-    transformer = _run("transformer_flash", bench_transformer_flash)
-    tta = _run("time_to_target", bench_time_to_target)
+    timeout_s = int(os.environ.get("FEDML_BENCH_PROBE_TIMEOUT_S", 180))
+    info = _probe_device(timeout_s)
+    if "error" in info:
+        # device unreachable: still print the contract line so the driver
+        # records an explicit failure instead of hanging
+        _log(f"device probe failed: {info['error']}")
+        _emit({"metric": "fedavg_rounds_per_sec_femnist_cnn", "value": 0.0,
+               "unit": "rounds/s", "vs_baseline": None,
+               "extra": {"error": info["error"]}})
+        return 0
+    _log(f"backend={info['backend']} device={info['device']!r}")
+    partial: dict = {}
+    _arm_global_watchdog(
+        int(os.environ.get("FEDML_BENCH_TOTAL_TIMEOUT_S", 2400)), partial)
+    flagship = partial["fedavg_femnist_cnn"] = _run(
+        "fedavg_femnist_cnn", bench_fedavg_cnn)
+    flagship_bf16 = partial["fedavg_femnist_cnn_bf16"] = _run(
+        "fedavg_femnist_cnn_bf16", bench_fedavg_cnn_bf16)
+    resnet = partial["resnet18_gn_fedcifar100"] = _run(
+        "resnet18_gn", bench_resnet18_gn)
+    transformer = partial["transformer_flash_s2048"] = _run(
+        "transformer_flash", bench_transformer_flash)
+    tta = partial["time_to_target_acc"] = _run(
+        "time_to_target", bench_time_to_target)
     base_out = _run("torch_baseline", lambda: {"rps": bench_torch_baseline()})
     base = base_out.get("rps", float("nan"))
 
@@ -382,10 +486,7 @@ def main():
                         else None),
         "extra": extra,
     }
-    os.makedirs("runs", exist_ok=True)
-    with open(os.path.join("runs", "bench_details.json"), "w") as f:
-        json.dump(line, f, indent=2)
-    print(json.dumps(line))
+    _emit(line)
 
 
 if __name__ == "__main__":

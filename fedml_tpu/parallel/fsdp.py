@@ -55,11 +55,10 @@ def fsdp_specs(tree: Dict[str, Any], n_shard: int, axis: str = "fsdp",
 def shard_params_fsdp(tree, mesh: Mesh, axis: str = "fsdp",
                       min_size: int = 1024):
     """Place a pytree with FSDP shardings over ``mesh``'s ``axis``."""
-    n_shard = mesh.shape[axis]
-    specs = fsdp_specs(tree, n_shard, axis, min_size)
-    return jax.tree.map(
-        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
-        tree, specs, is_leaf=lambda x: isinstance(x, P))
+    from fedml_tpu.parallel.gspmd_round import place
+
+    return place(tree, mesh, fsdp_specs(tree, mesh.shape[axis], axis,
+                                        min_size))
 
 
 def build_fsdp_mesh(n_devices: int, axis: str = "fsdp", devices=None) -> Mesh:
@@ -79,13 +78,14 @@ def make_fsdp_train_step(model, mesh: Mesh, lr: float = 1e-3,
     ``(init_state, step)`` factories: ``state = init_state(variables)``;
     ``state, loss = step(state, tokens)`` with tokens ``[B, S+1]`` int.
     """
+    from fedml_tpu.parallel.gspmd_round import tree_shardings
+
     n_shard = mesh.shape[axis]
     tx = optax.sgd(lr, momentum=momentum)
 
     def to_sharding(tree):
-        specs = fsdp_specs(tree, n_shard, axis, min_size)
-        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                            is_leaf=lambda s: isinstance(s, P))
+        return tree_shardings(mesh, fsdp_specs(tree, n_shard, axis,
+                                               min_size))
 
     def init_state(variables):
         params = shard_params_fsdp(variables["params"], mesh, axis, min_size)

@@ -17,6 +17,19 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def tree_shardings(mesh: Mesh, spec_tree):
+    """PartitionSpec tree -> NamedSharding tree over ``mesh``."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                        is_leaf=lambda s: isinstance(s, P))
+
+
+def place(tree, mesh: Mesh, spec_tree):
+    """device_put every leaf of ``tree`` per the matching PartitionSpec."""
+    return jax.tree.map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        tree, spec_tree, is_leaf=lambda s: isinstance(s, P))
+
+
 def make_sharded_federated_round(model, task: str, cfg, mesh: Mesh,
                                  param_specs_fn: Callable,
                                  clients_axis: str = "clients"):
@@ -42,15 +55,10 @@ def make_sharded_federated_round(model, task: str, cfg, mesh: Mesh,
         return pt.tree_weighted_mean(stacked, weights), totals
 
     def to_sharding(tree):
-        return jax.tree.map(lambda s: NamedSharding(mesh, s),
-                            param_specs_fn(tree),
-                            is_leaf=lambda s: isinstance(s, P))
+        return tree_shardings(mesh, param_specs_fn(tree))
 
     def shard_params(variables):
-        return jax.tree.map(
-            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
-            variables, param_specs_fn(variables),
-            is_leaf=lambda s: isinstance(s, P))
+        return place(variables, mesh, param_specs_fn(variables))
 
     _jit = {}  # one compile across rounds
 

@@ -29,7 +29,7 @@ from typing import Any, Dict
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 COLUMN_PARALLEL = ("Dense_0", "Dense_2")  # qkv + mlp-up inside a block
 ROW_PARALLEL = ("Dense_1", "Dense_3")     # attn-out + mlp-down
@@ -60,10 +60,9 @@ def transformer_tp_specs(variables: Dict[str, Any],
 
 def shard_transformer_tp(variables, mesh: Mesh, axis: str = "tp"):
     """Place a TransformerLM variables tree with Megatron TP shardings."""
-    specs = transformer_tp_specs(variables, axis)
-    return jax.tree.map(
-        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
-        variables, specs, is_leaf=lambda x: isinstance(x, P))
+    from fedml_tpu.parallel.gspmd_round import place
+
+    return place(variables, mesh, transformer_tp_specs(variables, axis))
 
 
 def build_tp_mesh(n_devices: int, axis: str = "tp",
