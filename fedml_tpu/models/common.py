@@ -2,12 +2,22 @@
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 
 
-def bn(train: bool) -> nn.BatchNorm:
+def bn(train: bool, sync_axis: Optional[str] = None) -> nn.BatchNorm:
     """The zoo-wide BatchNorm configuration (torch defaults: momentum 0.1 ->
     flax momentum 0.9, eps 1e-5), running stats in the ``batch_stats``
-    collection, frozen in eval mode."""
+    collection, frozen in eval mode.
+
+    ``sync_axis``: a mesh axis name to synchronize batch statistics over —
+    the TPU re-expression of the reference's SynchronizedBatchNorm
+    (fedml_api/model/cv/batchnorm_utils.py, the DataParallel cross-GPU
+    stats shim). Inside ``shard_map``/``vmap`` over that named axis, flax
+    psums the mean/var so every shard normalizes with the *global* batch
+    statistics; no extra machinery needed (tests/test_sync_bn.py proves
+    shard==global parity)."""
     return nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                        epsilon=1e-5)
+                        epsilon=1e-5, axis_name=sync_axis)
