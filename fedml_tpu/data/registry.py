@@ -114,6 +114,12 @@ def _img_blob(data_dir, **kw):
         partition_alpha=kw.get("partition_alpha", 0.5))
 
 
+def _token_blob(data_dir, **kw):
+    from fedml_tpu.data.synthetic import make_token_federated
+    return make_token_federated(
+        client_num=kw.get("client_num_in_total", 8))
+
+
 def _imagenet_tree(data_dir, **kw):
     from fedml_tpu.data.imagefolder import load_partition_data_imagenet_tree
     return load_partition_data_imagenet_tree(
@@ -158,6 +164,7 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "blob": _blob,                      # test/bench workhorse
     "seg_shapes": _seg_shapes,          # synthetic segmentation (fedseg)
     "img_blob": _img_blob,              # synthetic NHWC image classification
+    "token_blob": _token_blob,          # synthetic token sequences (nwp)
     # reference --dataset names for the ImageNet/Landmarks family
     "ILSVRC2012": _imagenet_tree,       # raw ImageFolder tree
     "ILSVRC2012_hdf5": _imagenet_hdf5,  # streaming hdf5 pack
@@ -182,6 +189,7 @@ DEFAULT_MODEL_AND_TASK = {
     "blob": ("lr", "classification"),
     "seg_shapes": ("segnet", "segmentation"),
     "img_blob": ("resnet56", "classification"),
+    "token_blob": ("transformer", "nwp"),
 }
 
 

@@ -41,6 +41,14 @@ def add_federated_args(parser: argparse.ArgumentParser):
                         choices=[None, "bfloat16", "float32"],
                         help="mixed precision: forward/backward dtype "
                              "(masters stay f32)")
+    parser.add_argument("--model_parallel", type=str, default=None,
+                        choices=[None, "tp", "fsdp"],
+                        help="spmd backend: shard the model over a second "
+                             "mesh axis inside each client slot — tp "
+                             "(Megatron, transformer models) or fsdp "
+                             "(ZeRO-3, any model)")
+    parser.add_argument("--mp_size", type=int, default=1,
+                        help="devices per client slot for --model_parallel")
     parser.add_argument("--eval_train_subsample", type=int, default=None,
                         help="evaluate train metrics on a fixed seeded "
                              "subsample of the train union (None = full)")

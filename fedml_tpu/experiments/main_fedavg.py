@@ -70,6 +70,8 @@ def run_spmd(args, ds, model, task, sink):
         comm_round=args.comm_round,
         client_num_per_round=args.client_num_per_round,
         frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
+        model_parallel=getattr(args, "model_parallel", None),
+        mp_size=getattr(args, "mp_size", 1),
         train=make_train_config(args))
     api = DistributedFedAvgAPI(ds, model, task=task, config=cfg)
     mgr = (CheckpointManager(args.checkpoint_dir)

@@ -52,6 +52,14 @@ def fsdp_specs(tree: Dict[str, Any], n_shard: int, axis: str = "fsdp",
         lambda leaf: leaf_fsdp_spec(leaf, n_shard, axis, min_size), tree)
 
 
+def fsdp_param_specs(n_shard: int, axis: str = "fsdp",
+                     min_size: int = 1024):
+    """The single copy of the ZeRO spec rule as a specs_fn (consumed by
+    make_fsdp_federated_round, the SPMD driver's --model_parallel fsdp
+    path, and gspmd_round.make_gspmd_eval)."""
+    return lambda tree: fsdp_specs(tree, n_shard, axis, min_size)
+
+
 def shard_params_fsdp(tree, mesh: Mesh, axis: str = "fsdp",
                       min_size: int = 1024):
     """Place a pytree with FSDP shardings over ``mesh``'s ``axis``."""
@@ -124,7 +132,8 @@ def make_fsdp_train_step(model, mesh: Mesh, lr: float = 1e-3,
 def make_fsdp_federated_round(model, task: str, cfg, mesh: Mesh,
                               clients_axis: str = "clients",
                               fsdp_axis: str = "fsdp",
-                              min_size: int = 1024):
+                              min_size: int = 1024,
+                              donate: bool = False):
     """FedAvg round over a ('clients', 'fsdp') mesh: sampled clients are
     data-parallel on one axis while the global model's parameters are
     ZeRO-sharded over the other — so a federation can train a model whose
@@ -139,8 +148,7 @@ def make_fsdp_federated_round(model, task: str, cfg, mesh: Mesh,
     """
     from fedml_tpu.parallel.gspmd_round import make_sharded_federated_round
 
-    n_shard = mesh.shape[fsdp_axis]
     return make_sharded_federated_round(
         model, task, cfg, mesh,
-        lambda tree: fsdp_specs(tree, n_shard, fsdp_axis, min_size),
-        clients_axis=clients_axis)
+        fsdp_param_specs(mesh.shape[fsdp_axis], fsdp_axis, min_size),
+        clients_axis=clients_axis, donate=donate)

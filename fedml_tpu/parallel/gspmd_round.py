@@ -32,7 +32,8 @@ def place(tree, mesh: Mesh, spec_tree):
 
 def make_sharded_federated_round(model, task: str, cfg, mesh: Mesh,
                                  param_specs_fn: Callable,
-                                 clients_axis: str = "clients"):
+                                 clients_axis: str = "clients",
+                                 donate: bool = False):
     """FedAvg round with model params sharded per ``param_specs_fn``.
 
     ``param_specs_fn(variables) -> PartitionSpec tree`` decides the model
@@ -69,7 +70,36 @@ def make_sharded_federated_round(model, task: str, cfg, mesh: Mesh,
                 round_fn,
                 in_shardings=(to_sharding(variables), data, data, data,
                               data, data),
-                out_shardings=(to_sharding(variables), None))
+                out_shardings=(to_sharding(variables), None),
+                donate_argnums=(0,) if donate else ())
         return _jit["fn"](variables, x, y, mask, keys, weights)
 
     return jitted, shard_params
+
+
+def make_gspmd_eval(module, task: str, mesh: Mesh,
+                    param_specs_fn: Callable,
+                    clients_axis: str = "clients"):
+    """Sharded evaluation with model-parallel params: the eval union rides
+    the ``clients`` axis, the params keep their TP/FSDP layout, and XLA
+    partitions the stat-sum program (no explicit psum — the replicated
+    output forces the reduce). Counterpart of spmd.make_sharded_eval for
+    2-D ('clients', <model>) meshes, where shard_map's replicated-params
+    contract doesn't hold."""
+    from fedml_tpu.trainer.functional import make_eval
+
+    ev = make_eval(module, task)
+    _jit = {}
+
+    def jitted(variables, x, y, mask):
+        if "fn" not in _jit:
+            data = NamedSharding(mesh, P(clients_axis))
+            _jit["fn"] = jax.jit(
+                ev,
+                in_shardings=(tree_shardings(mesh,
+                                             param_specs_fn(variables)),
+                              data, data, data),
+                out_shardings=None)
+        return _jit["fn"](variables, x, y, mask)
+
+    return jitted

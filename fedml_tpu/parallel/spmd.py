@@ -179,6 +179,11 @@ class DistributedFedAvgConfig:
     frequency_of_the_test: int = 5
     seed: int = 0
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    # model parallelism INSIDE each client slot: shard the model over a
+    # second mesh axis — "tp" (Megatron, transformer models) or "fsdp"
+    # (ZeRO-3, any model) with mp_size devices per client
+    model_parallel: Optional[str] = None
+    mp_size: int = 1
 
 
 class DistributedFedAvgAPI:
@@ -196,17 +201,52 @@ class DistributedFedAvgAPI:
         self.dataset = dataset
         self.module = module
         self.config = config or DistributedFedAvgConfig()
+        mp = self.config.model_parallel
+        if mp and mp not in ("tp", "fsdp"):
+            raise ValueError(f"unknown model_parallel: {mp!r}")
+        if mesh is None and mp:
+            devs = jax.devices()
+            k = self.config.mp_size
+            if len(devs) % k != 0:
+                raise ValueError(
+                    f"mp_size {k} must divide device count {len(devs)}")
+            mesh = Mesh(np.asarray(devs).reshape(len(devs) // k, k),
+                        ("clients", mp))
         self.mesh = mesh or build_mesh({"clients": len(jax.devices())})
-        self.n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
-        self._round_fn = make_spmd_round(module, task, self.config.train,
-                                         self.mesh, donate=True)
-        self._eval_fn = make_sharded_eval(module, task, self.mesh)
+        if mp and mp not in self.mesh.axis_names:
+            raise ValueError(
+                f"model_parallel={mp!r} needs a mesh axis named {mp!r}; "
+                f"got axes {self.mesh.axis_names}")
+        # round/eval slots pad to the CLIENTS axis (== all devices when 1-D)
+        self.n_dev = int(self.mesh.shape["clients"])
+        if mp:
+            from fedml_tpu.parallel.gspmd_round import (
+                make_gspmd_eval, make_sharded_federated_round)
+            if mp == "tp":
+                from fedml_tpu.parallel.tensor import tp_param_specs
+                specs_fn = tp_param_specs()
+            else:
+                from fedml_tpu.parallel.fsdp import fsdp_param_specs
+                specs_fn = fsdp_param_specs(int(self.mesh.shape["fsdp"]))
+            self._round_fn, self._shard_params = \
+                make_sharded_federated_round(module, task, self.config.train,
+                                             self.mesh, specs_fn,
+                                             donate=True)
+            self._eval_fn = make_gspmd_eval(module, task, self.mesh,
+                                            specs_fn)
+        else:
+            self._shard_params = None
+            self._round_fn = make_spmd_round(module, task, self.config.train,
+                                             self.mesh, donate=True)
+            self._eval_fn = make_sharded_eval(module, task, self.mesh)
         self._n_pad = dataset.padded_len(self.config.train.batch_size)
         self._base_key = jax.random.key(self.config.seed)
         self._data_sharding = NamedSharding(self.mesh, P("clients"))
         sample_x = dataset.train_data_global[0][:1]
         self.variables = module.init(jax.random.key(self.config.seed),
                                      jnp.asarray(sample_x), train=False)
+        if self._shard_params is not None:  # place into the TP/FSDP layout
+            self.variables = self._shard_params(self.variables)
         self.history: List[Dict] = []
         # same-cohort device cache as FedAvgAPI._pack_cache: full
         # participation re-samples the identical set each round, so the

@@ -65,6 +65,13 @@ def shard_transformer_tp(variables, mesh: Mesh, axis: str = "tp"):
     return place(variables, mesh, transformer_tp_specs(variables, axis))
 
 
+def tp_param_specs(axis: str = "tp"):
+    """The single copy of the Megatron spec rule as a specs_fn (consumed by
+    make_tp_federated_round, the SPMD driver's --model_parallel tp path,
+    and gspmd_round.make_gspmd_eval)."""
+    return lambda tree: transformer_tp_specs(tree, axis)
+
+
 def build_tp_mesh(n_devices: int, axis: str = "tp",
                   devices=None) -> Mesh:
     devs = (devices if devices is not None else jax.devices())[:n_devices]
@@ -73,7 +80,7 @@ def build_tp_mesh(n_devices: int, axis: str = "tp",
 
 def make_tp_federated_round(model, task: str, cfg, mesh: Mesh,
                             clients_axis: str = "clients",
-                            tp_axis: str = "tp"):
+                            tp_axis: str = "tp", donate: bool = False):
     """FedAvg round over a ('clients', 'tp') mesh: sampled clients are
     data-parallel on one axis while EVERY client's transformer is Megatron-
     sharded over the other — federated training of a model bigger than one
@@ -89,9 +96,8 @@ def make_tp_federated_round(model, task: str, cfg, mesh: Mesh,
     from fedml_tpu.parallel.gspmd_round import make_sharded_federated_round
 
     return make_sharded_federated_round(
-        model, task, cfg, mesh,
-        lambda tree: transformer_tp_specs(tree, tp_axis),
-        clients_axis=clients_axis)
+        model, task, cfg, mesh, tp_param_specs(tp_axis),
+        clients_axis=clients_axis, donate=donate)
 
 
 def make_tp_train_step(model, mesh: Mesh, lr: float = 1e-3,

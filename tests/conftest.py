@@ -77,6 +77,7 @@ SLOW_TESTS = {
     "test_utils.py::TestCheckpoint::test_resume_continues_identically",
     "test_torch_import.py::test_fedgkt_warm_start",
     "test_fsdp.py::TestTrainStep::test_fsdp_step_matches_single_device",
+    "test_tensor_parallel.py::TestTpCli::test_cli_spmd_tp_smoke",
     "test_fsdp.py::TestFsdpFederatedRound::"
     "test_clients_x_fsdp_round_matches_single_device",
 }

@@ -130,3 +130,22 @@ class TestTpExecution:
         k = v1["params"]["TransformerBlock_0"]["Dense_0"]["kernel"]
         # the update must not have gathered the params to one device
         assert len(k.sharding.device_set) == 8
+
+
+class TestTpCli:
+    def test_cli_spmd_tp_smoke(self):
+        """--backend spmd --model_parallel tp runs from the CLI on the
+        synthetic token federation (transformer + nwp, Megatron-sharded
+        inside every client slot)."""
+        import tempfile
+
+        from fedml_tpu.experiments.main_fedavg import main
+
+        with tempfile.TemporaryDirectory() as d:
+            final = main(["--dataset", "token_blob", "--backend", "spmd",
+                          "--model_parallel", "tp", "--mp_size", "2",
+                          "--client_num_in_total", "4",
+                          "--client_num_per_round", "4",
+                          "--comm_round", "2", "--frequency_of_the_test",
+                          "1", "--batch_size", "8", "--run_dir", d])
+        assert final and "test_acc" in final
