@@ -16,14 +16,12 @@ from fedml_tpu.experiments.args import (add_federated_args,
 from fedml_tpu.experiments.main_fedavg import make_train_config
 from fedml_tpu.utils.metrics import MetricsSink
 
-# algorithms this launcher can dispatch end-to-end from the generic
-# dataset/model flags; split_nn and vertical_fl need a model-split /
-# feature-split spec and live in their own APIs (algorithms/split_nn.py,
-# algorithms/vertical_fl.py)
-WIRED_ALGOS = ["fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
-               "decentralized", "centralized", "fednas", "fedgkt",
-               "turboaggregate", "fedseg"]
-ALGOS = WIRED_ALGOS + ["split_nn", "vertical_fl"]
+# every algorithm family dispatches end-to-end from the generic flags;
+# split_nn uses a dense bottom/top cut and vertical_fl an even feature-column
+# split across --party_num parties (their APIs take arbitrary splits)
+ALGOS = ["fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
+         "decentralized", "centralized", "fednas", "fedgkt",
+         "turboaggregate", "fedseg", "split_nn", "vertical_fl"]
 
 
 def add_algo_args(parser: argparse.ArgumentParser):
@@ -74,6 +72,8 @@ def add_algo_args(parser: argparse.ArgumentParser):
                              "search->train workflow)")
     # turboaggregate
     parser.add_argument("--frac_bits", type=int, default=16)
+    # vertical_fl (guest = party 0 with labels + first feature block)
+    parser.add_argument("--party_num", type=int, default=3)
     # fedseg (reference SegmentationLosses / LR_Scheduler knobs)
     parser.add_argument("--seg_loss", type=str, default="ce",
                         choices=["ce", "focal"])
@@ -268,7 +268,65 @@ def run_algo(args):
                                      seed=args.seed,
                                      pretrained_client_path=(
                                          args.pretrained_path)))
-    else:  # pragma: no cover - main() rejects unwired algos up front
+    elif args.algo == "split_nn":
+        from fedml_tpu.algorithms.split_nn import SplitNNAPI, SplitNNConfig
+        from fedml_tpu.models.vfl import VFLDenseModel, VFLFeatureExtractor
+        if ds.train_data_global[0].ndim != 2:
+            raise SystemExit(
+                "split_nn's generic wiring uses a dense bottom/top split "
+                "over flat features (e.g. --dataset blob); "
+                f"{args.dataset!r} samples have shape "
+                f"{ds.train_data_global[0].shape[1:]}")
+        bottom = VFLFeatureExtractor(hidden_dims=(64, 32))
+        top = VFLDenseModel(output_dim=ds.class_num, use_bias=True)
+        api = SplitNNAPI(ds, bottom, top, cut_input_shape=(32,),
+                         config=SplitNNConfig(
+                             epochs_per_node=args.epochs,
+                             batch_size=args.batch_size,
+                             lr=args.lr, wd=args.wd, seed=args.seed))
+        for r in range(args.comm_round):
+            rec = api.train_one_rotation(r)
+            sink.log(rec, step=r)
+        sink.finish()
+        final = api.history[-1]
+        logging.info("final: %s", final)
+        return final
+    elif args.algo == "vertical_fl":
+        import numpy as np
+        from fedml_tpu.algorithms.vertical_fl import VFLConfig, build_vfl
+        if ds.train_data_global[0].ndim != 2:
+            raise SystemExit(
+                "vertical_fl's generic wiring splits flat feature columns "
+                "across parties (e.g. --dataset blob); "
+                f"{args.dataset!r} samples have shape "
+                f"{ds.train_data_global[0].shape[1:]}")
+        xg, yg = ds.train_data_global
+        xt, yt = ds.test_data_global
+        x_train = np.asarray(xg, np.float32)
+        x_test = np.asarray(xt, np.float32)
+        # guest holds the labels (binarized: the reference VFL task is
+        # binary logistic regression, party_models.py) and the first
+        # feature block; hosts hold the rest
+        y_train = (np.asarray(yg).reshape(-1) % 2).astype(np.float32)
+        y_test = (np.asarray(yt).reshape(-1) % 2).astype(np.float32)
+        if not 0 < args.party_num <= x_train.shape[1]:
+            raise SystemExit(
+                f"--party_num {args.party_num} must be in [1, "
+                f"{x_train.shape[1]}] (the feature dimension of "
+                f"{args.dataset!r})")
+        cuts = np.array_split(np.arange(x_train.shape[1]), args.party_num)
+        fixture = build_vfl([len(c) for c in cuts],
+                            VFLConfig(epochs=args.comm_round,
+                                      batch_size=args.batch_size,
+                                      lr=args.lr, seed=args.seed))
+        final = fixture.fit([x_train[:, c] for c in cuts], y_train,
+                            [x_test[:, c] for c in cuts], y_test)
+        for rec in fixture.history:
+            sink.log(rec, step=rec["epoch"])
+        sink.finish()
+        logging.info("final: %s", final)
+        return final
+    else:  # pragma: no cover - argparse choices rejects unknown algos
         raise SystemExit(f"--algo {args.algo} is not wired in fed_launch")
 
     return _log_history(api, sink)
@@ -282,15 +340,6 @@ def main(argv=None):
     add_federated_args(parser)
     add_algo_args(parser)
     args = apply_ci_truncation(parser.parse_args(argv))
-    if args.algo not in WIRED_ALGOS:
-        # reject BEFORE any dataset download / wandb run is opened
-        why = {"split_nn": "needs a model-split (bottom/top) spec",
-               "vertical_fl": "needs a per-party feature-split spec"}
-        reason = why.get(args.algo, "not dispatchable from generic flags")
-        raise SystemExit(
-            f"--algo {args.algo}: {reason}; use its API "
-            f"(fedml_tpu.algorithms.{args.algo}). Launcher wires: "
-            f"{WIRED_ALGOS}")
     logging.basicConfig(level=logging.INFO)
     return run_algo(args)
 

@@ -105,10 +105,10 @@ class TestFedLaunch:
                                  "--topology_neighbors_num_undirected", "2"])
         assert final["regret"] > 0
 
-    def test_unwired_algo_rejected_before_load(self, tmp_path):
+    def test_unknown_algo_rejected_by_argparse(self, tmp_path):
         import pytest
-        with pytest.raises(SystemExit, match="split_nn"):
-            fed_launch.main(self._common(tmp_path, "split_nn"))
+        with pytest.raises(SystemExit):
+            fed_launch.main(self._common(tmp_path, "no_such_algo"))
 
     def test_fedseg_via_launcher(self, tmp_path):
         final = fed_launch.main(
@@ -144,3 +144,32 @@ class TestNasRetrain:
         assert "genotype" in final
         assert "retrain_test_acc" in final
         assert 0.0 <= final["retrain_test_acc"] <= 1.0
+
+
+class TestSplitVerticalViaLauncher:
+    def test_split_nn(self):
+        """split_nn dispatches from generic flags: dense bottom/top cut,
+        ring rotations, accuracy above chance on blobs."""
+        import tempfile
+
+        from fedml_tpu.experiments.fed_launch import main
+
+        with tempfile.TemporaryDirectory() as d:
+            final = main(["--algo", "split_nn", "--dataset", "blob",
+                          "--partition_method", "homo",
+                          "--comm_round", "5", "--lr", "0.01",
+                          "--run_dir", d])
+        assert final["test_acc"] > 0.9
+
+    def test_vertical_fl(self):
+        """vertical_fl dispatches from generic flags: feature columns split
+        over --party_num parties, binary task learns."""
+        import tempfile
+
+        from fedml_tpu.experiments.fed_launch import main
+
+        with tempfile.TemporaryDirectory() as d:
+            final = main(["--algo", "vertical_fl", "--dataset", "blob",
+                          "--party_num", "3", "--comm_round", "5",
+                          "--lr", "0.05", "--run_dir", d])
+        assert final["test_acc"] > 0.55
