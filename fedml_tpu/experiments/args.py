@@ -41,6 +41,10 @@ def add_federated_args(parser: argparse.ArgumentParser):
                         choices=[None, "bfloat16", "float32"],
                         help="mixed precision: forward/backward dtype "
                              "(masters stay f32)")
+    parser.add_argument("--accum_steps", type=int, default=1,
+                        help="average grads over k micro-batches per "
+                             "optimizer step (effective batch = "
+                             "k * batch_size, one micro-batch of HBM)")
     parser.add_argument("--model_parallel", type=str, default=None,
                         choices=[None, "tp", "fsdp"],
                         help="spmd backend: shard the model over a second "

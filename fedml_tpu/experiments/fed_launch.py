@@ -88,8 +88,35 @@ def _log_history(api, sink):
     return final
 
 
+# algorithms whose inner loop does not consume TrainConfig's optimizer
+# factory — flags like --accum_steps don't reach them
+_CUSTOM_LOOP_ALGOS = {"fednova", "decentralized", "split_nn", "vertical_fl",
+                      "fednas", "fedgkt"}
+
+
+def _validate_before_sink(args, ds):
+    """Shape/flag checks that should reject BEFORE a metrics run (possibly
+    wandb) is opened."""
+    if args.algo in ("split_nn", "vertical_fl"):
+        if ds.train_data_global[0].ndim != 2:
+            raise SystemExit(
+                f"{args.algo}'s generic wiring needs flat features "
+                f"(e.g. --dataset blob); {args.dataset!r} samples have "
+                f"shape {ds.train_data_global[0].shape[1:]}")
+    if args.algo == "vertical_fl":
+        dim = ds.train_data_global[0].shape[1]
+        if not 0 < args.party_num <= dim:
+            raise SystemExit(
+                f"--party_num {args.party_num} must be in [1, {dim}] "
+                f"(the feature dimension of {args.dataset!r})")
+    if args.accum_steps > 1 and args.algo in _CUSTOM_LOOP_ALGOS:
+        logging.warning("--accum_steps is only wired for TrainConfig-based "
+                        "algorithms; ignoring for %r", args.algo)
+
+
 def run_algo(args):
     ds, model, task = build_dataset_and_model(args)
+    _validate_before_sink(args, ds)
     sink = MetricsSink(args.run_dir, config=vars(args),
                        use_wandb=args.use_wandb)
     tcfg = make_train_config(args)
@@ -271,15 +298,10 @@ def run_algo(args):
     elif args.algo == "split_nn":
         from fedml_tpu.algorithms.split_nn import SplitNNAPI, SplitNNConfig
         from fedml_tpu.models.vfl import VFLDenseModel, VFLFeatureExtractor
-        if ds.train_data_global[0].ndim != 2:
-            raise SystemExit(
-                "split_nn's generic wiring uses a dense bottom/top split "
-                "over flat features (e.g. --dataset blob); "
-                f"{args.dataset!r} samples have shape "
-                f"{ds.train_data_global[0].shape[1:]}")
         bottom = VFLFeatureExtractor(hidden_dims=(64, 32))
         top = VFLDenseModel(output_dim=ds.class_num, use_bias=True)
-        api = SplitNNAPI(ds, bottom, top, cut_input_shape=(32,),
+        api = SplitNNAPI(ds, bottom, top,
+                         cut_input_shape=(bottom.hidden_dims[-1],),
                          config=SplitNNConfig(
                              epochs_per_node=args.epochs,
                              batch_size=args.batch_size,
@@ -294,12 +316,6 @@ def run_algo(args):
     elif args.algo == "vertical_fl":
         import numpy as np
         from fedml_tpu.algorithms.vertical_fl import VFLConfig, build_vfl
-        if ds.train_data_global[0].ndim != 2:
-            raise SystemExit(
-                "vertical_fl's generic wiring splits flat feature columns "
-                "across parties (e.g. --dataset blob); "
-                f"{args.dataset!r} samples have shape "
-                f"{ds.train_data_global[0].shape[1:]}")
         xg, yg = ds.train_data_global
         xt, yt = ds.test_data_global
         x_train = np.asarray(xg, np.float32)
@@ -309,11 +325,6 @@ def run_algo(args):
         # feature block; hosts hold the rest
         y_train = (np.asarray(yg).reshape(-1) % 2).astype(np.float32)
         y_test = (np.asarray(yt).reshape(-1) % 2).astype(np.float32)
-        if not 0 < args.party_num <= x_train.shape[1]:
-            raise SystemExit(
-                f"--party_num {args.party_num} must be in [1, "
-                f"{x_train.shape[1]}] (the feature dimension of "
-                f"{args.dataset!r})")
         cuts = np.array_split(np.arange(x_train.shape[1]), args.party_num)
         fixture = build_vfl([len(c) for c in cuts],
                             VFLConfig(epochs=args.comm_round,

@@ -27,7 +27,8 @@ def make_train_config(args) -> TrainConfig:
     return TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                        lr=args.lr, client_optimizer=args.client_optimizer,
                        wd=args.wd,
-                       compute_dtype=getattr(args, "compute_dtype", None))
+                       compute_dtype=getattr(args, "compute_dtype", None),
+                       accum_steps=getattr(args, "accum_steps", 1))
 
 
 def run_simulation(args, ds, model, task, sink):

@@ -133,6 +133,10 @@ class TransformerLM(nn.Module):
     moe_ep_axis: Optional[str] = None  # run MoE FFNs expert-parallel
     moe_n_shards: int = 1
     moe_capacity_factor: float = 1.25
+    # rematerialize each block on the backward pass (jax.checkpoint):
+    # activation memory drops from O(depth * S * width) to O(S * width)
+    # at ~1/3 extra FLOPs — the standard long-context training trade
+    remat: bool = False
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, pos_offset=0):
@@ -149,17 +153,25 @@ class TransformerLM(nn.Module):
         pos = nn.Embed(self.max_len, self.width,
                        name="pos_embed")(jnp.arange(s) + pos_offset)
         x = x + pos[None]
+        # nn.remat numbers args with the module instance at index 0, so in
+        # __call__(self, x, train) the train flag is argnum 2; it must stay
+        # static (it picks dropout branches)
+        block_cls = (nn.remat(TransformerBlock, static_argnums=(2,))
+                     if self.remat else TransformerBlock)
         for i in range(self.depth):
             is_moe = (self.moe_experts > 0
                       and (i + 1) % self.moe_every == 0)
-            x = TransformerBlock(self.num_heads, dropout=self.dropout,
-                                 attn_fn=self.attn_fn,
-                                 moe_experts=(self.moe_experts
-                                              if is_moe else 0),
-                                 moe_ep_axis=self.moe_ep_axis,
-                                 moe_n_shards=self.moe_n_shards,
-                                 moe_capacity_factor=(
-                                     self.moe_capacity_factor))(
-                x, train=train)
+            # explicit name: nn.remat would otherwise prefix the module
+            # ("CheckpointTransformerBlock_i"), breaking param-tree
+            # compatibility with the non-remat model and the TP specs
+            x = block_cls(self.num_heads, dropout=self.dropout,
+                          attn_fn=self.attn_fn,
+                          moe_experts=(self.moe_experts
+                                       if is_moe else 0),
+                          moe_ep_axis=self.moe_ep_axis,
+                          moe_n_shards=self.moe_n_shards,
+                          moe_capacity_factor=(
+                              self.moe_capacity_factor),
+                          name=f"TransformerBlock_{i}")(x, train)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size)(x)

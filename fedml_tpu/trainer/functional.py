@@ -43,22 +43,31 @@ class TrainConfig:
     # — the MXU's native input type) while master params, optimizer state,
     # loss, and metrics stay float32. None = pure f32 (parity tests).
     compute_dtype: Optional[str] = None
+    # gradient accumulation: average grads over k consecutive micro-batches
+    # before each optimizer step (effective batch = k * batch_size at the
+    # HBM footprint of one micro-batch)
+    accum_steps: int = 1
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """Client optimizer factory, matching the reference's two choices
     (MyModelTrainer.py:26-31): plain SGD, or Adam(amsgrad) with L2-style
     weight decay folded into the gradient like torch's ``weight_decay``."""
+    def wrap(tx: optax.GradientTransformation):
+        if cfg.accum_steps > 1:
+            return optax.MultiSteps(tx, every_k_schedule=cfg.accum_steps)
+        return tx
+
     if cfg.client_optimizer == "sgd":
         if cfg.momentum:
-            return optax.sgd(cfg.lr, momentum=cfg.momentum)
-        return optax.sgd(cfg.lr)
+            return wrap(optax.sgd(cfg.lr, momentum=cfg.momentum))
+        return wrap(optax.sgd(cfg.lr))
     if cfg.client_optimizer == "adam":
         steps = []
         if cfg.wd:
             steps.append(optax.add_decayed_weights(cfg.wd))
         steps.append(optax.amsgrad(cfg.lr))
-        return optax.chain(*steps)
+        return wrap(optax.chain(*steps))
     raise ValueError(f"unknown client_optimizer: {cfg.client_optimizer!r}")
 
 
@@ -136,6 +145,17 @@ def make_local_train(module, task: str, cfg: TrainConfig,
     def local_train(variables, x, y, mask, rng):
         n_pad = x.shape[0]
         bsz = cfg.batch_size or n_pad
+        if cfg.accum_steps > 1:
+            total_steps = cfg.epochs * (n_pad // bsz)
+            if total_steps % cfg.accum_steps != 0:
+                # MultiSteps emits updates only on every k-th micro-batch;
+                # a partial tail window would be silently dropped (worst
+                # case: zero optimizer steps in the whole call)
+                raise ValueError(
+                    f"accum_steps={cfg.accum_steps} must divide "
+                    f"epochs*num_batches={total_steps} "
+                    f"(epochs={cfg.epochs}, {n_pad // bsz} batches of "
+                    f"{bsz}); trailing micro-batches would be dropped")
         batch_idx, step_keys = make_batch_schedule(n_pad, cfg.epochs, bsz,
                                                    cfg.shuffle, rng)
         params = variables["params"]

@@ -221,3 +221,52 @@ class TestFlaxModelTrainerProtocol:
         params = tr.get_model_params()
         tr.set_model_params(params)
         assert tr.test(ds.test_data_global) == after
+
+
+class TestGradAccumulation:
+    def test_accum_2_matches_double_batch(self):
+        """accum_steps=2 at batch B == one step at batch 2B (mean-of-means
+        == mean over the union for equal micro-batches, shuffle off)."""
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import (TrainConfig,
+                                                  make_local_train)
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 12).astype(np.float32)
+        y = rng.randint(0, 4, 64).astype(np.int32)
+        mask = np.ones(64, np.float32)
+        model = LogisticRegression(num_classes=4)
+        variables = model.init(jax.random.key(0), jnp.asarray(x[:1]))
+
+        def run(bsz, accum):
+            cfg = TrainConfig(epochs=2, batch_size=bsz, lr=0.1,
+                              shuffle=False, accum_steps=accum)
+            lt = make_local_train(model, "classification", cfg)
+            out, _ = jax.jit(lt)(variables, jnp.asarray(x), jnp.asarray(y),
+                                 jnp.asarray(mask), jax.random.key(1))
+            return out
+
+        small = run(16, 2)
+        big = run(32, 1)
+        for a, b in zip(jax.tree.leaves(small), jax.tree.leaves(big)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_partial_accumulation_window_rejected(self):
+        """A tail window MultiSteps would silently drop (worst case: zero
+        optimizer steps) is a hard error, not a no-op."""
+        import pytest
+
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import (TrainConfig,
+                                                  make_local_train)
+
+        model = LogisticRegression(num_classes=4)
+        x = np.zeros((32, 12), np.float32)
+        variables = model.init(jax.random.key(0), jnp.asarray(x[:1]))
+        cfg = TrainConfig(epochs=1, batch_size=None, accum_steps=2)
+        lt = make_local_train(model, "classification", cfg)
+        with pytest.raises(ValueError, match="accum_steps"):
+            lt(variables, jnp.asarray(x),
+               jnp.zeros(32, jnp.int32), jnp.ones(32, jnp.float32),
+               jax.random.key(1))
