@@ -34,19 +34,19 @@ class NativeUnavailable(RuntimeError):
     """The native library could not be built or loaded."""
 
 
-def build_lib(force: bool = False) -> Path:
-    """Compile native/router.cpp into a shared library (cached by mtime)."""
+def _build(src: Path, lib: Path, force: bool = False) -> Path:
+    """Compile one native source into a shared library (cached by mtime)."""
     with _build_lock:
-        if not _SRC.exists():
-            if _LIB.exists():  # prebuilt library shipped without sources
-                return _LIB
-            raise NativeUnavailable(f"native source missing: {_SRC}")
-        if (not force and _LIB.exists()
-                and _LIB.stat().st_mtime >= _SRC.stat().st_mtime):
-            return _LIB
+        if not src.exists():
+            if lib.exists():  # prebuilt library shipped without sources
+                return lib
+            raise NativeUnavailable(f"native source missing: {src}")
+        if (not force and lib.exists()
+                and lib.stat().st_mtime >= src.stat().st_mtime):
+            return lib
         _BUILD_DIR.mkdir(parents=True, exist_ok=True)
         cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-               "-shared", "-o", str(_LIB), str(_SRC)]
+               "-shared", "-o", str(lib), str(src)]
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=300)
@@ -55,10 +55,85 @@ def build_lib(force: bool = False) -> Path:
         if proc.returncode != 0:
             raise NativeUnavailable(
                 f"native build failed:\n{proc.stderr[-4000:]}")
-        return _LIB
+        return lib
+
+
+def build_lib(force: bool = False) -> Path:
+    """Compile native/router.cpp into a shared library (cached by mtime)."""
+    return _build(_SRC, _LIB, force)
 
 
 _lib_handle: Optional[ctypes.CDLL] = None
+
+_PACKER_SRC = _REPO_ROOT / "native" / "packer.cpp"
+_PACKER_LIB = _BUILD_DIR / "libfedml_packer.so"
+# CDLL once loaded, NativeUnavailable after a failed build (negative cache)
+_packer_handle = None
+
+
+def load_packer() -> ctypes.CDLL:
+    global _packer_handle
+    if isinstance(_packer_handle, NativeUnavailable):
+        raise _packer_handle  # negative cache: don't re-run g++ per round
+    if _packer_handle is not None:
+        return _packer_handle
+    try:
+        path = _build(_PACKER_SRC, _PACKER_LIB)
+    except NativeUnavailable as exc:
+        _packer_handle = exc
+        raise
+    lib = ctypes.CDLL(str(path))
+    lib.fedml_pack_clients.restype = ctypes.c_int
+    lib.fedml_pack_clients.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),   # src_ptrs
+        ctypes.POINTER(ctypes.c_int64),    # counts
+        ctypes.c_int64, ctypes.c_int64,    # P, n_pad
+        ctypes.c_int64,                    # row_bytes
+        ctypes.c_void_p,                   # dst
+        ctypes.c_void_p,                   # mask (nullable)
+        ctypes.c_int,                      # n_threads
+    ]
+    _packer_handle = lib
+    return lib
+
+
+def pack_arrays_native(srcs, dst, mask=None,
+                       n_threads: Optional[int] = None) -> None:
+    """Gather ragged per-client arrays into ``dst [P, n_pad, ...]`` with
+    parallel memcpy (native/packer.cpp); zero-pads the tail and writes the
+    validity ``mask [P, n_pad]`` when given.
+
+    ``srcs``: list of P C-contiguous arrays shaped [n_i, ...] with the same
+    trailing shape/dtype as ``dst``. Raises :class:`NativeUnavailable` if
+    the toolchain is missing (callers fall back to the numpy loop)."""
+    import numpy as np
+
+    lib = load_packer()
+    P, n_pad = dst.shape[0], dst.shape[1]
+    if len(srcs) != P or not dst.flags.c_contiguous:
+        raise ValueError("dst must be C-contiguous [P, n_pad, ...] with "
+                         "one src per client")
+    row_bytes = dst.nbytes // max(1, P * n_pad)
+    ptrs = (ctypes.c_void_p * P)()
+    counts = (ctypes.c_int64 * P)()
+    for i, s in enumerate(srcs):
+        s = np.ascontiguousarray(s)
+        if s.dtype != dst.dtype or s.shape[1:] != dst.shape[2:]:
+            # memcpy trusts row_bytes — a dtype/shape mismatch would read
+            # out of bounds or silently corrupt rows
+            raise ValueError(
+                f"client {i}: {s.dtype}{s.shape[1:]} does not match dst "
+                f"{dst.dtype}{dst.shape[2:]}")
+        srcs[i] = s  # keep alive / contiguous for the call
+        ptrs[i] = s.ctypes.data if len(s) else None
+        counts[i] = len(s)
+    rc = lib.fedml_pack_clients(
+        ptrs, counts, P, n_pad, row_bytes,
+        dst.ctypes.data_as(ctypes.c_void_p),
+        mask.ctypes.data_as(ctypes.c_void_p) if mask is not None else None,
+        n_threads or min(16, os.cpu_count() or 1))
+    if rc != 0:
+        raise ValueError("a client has more samples than n_pad")
 
 
 def load_lib() -> ctypes.CDLL:
