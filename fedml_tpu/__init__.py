@@ -30,3 +30,39 @@ Layer map (mirrors reference SURVEY §1):
 """
 
 __version__ = "0.1.0"
+
+# Lazy top-level API: the entry points a reference user reaches for
+# (FedML_init / FedML_FedAvg_distributed / FedAvgAPI / load_data /
+# create_model) without importing jax at package-import time.
+_EXPORTS = {
+    "FedAvgAPI": "fedml_tpu.algorithms.fedavg",
+    "FedAvgConfig": "fedml_tpu.algorithms.fedavg",
+    "FedOptAPI": "fedml_tpu.algorithms.fedopt",
+    "FedNovaAPI": "fedml_tpu.algorithms.fednova",
+    "CentralizedTrainer": "fedml_tpu.algorithms.centralized",
+    "run_fedavg_cross_silo": "fedml_tpu.algorithms.fedavg_cross_silo",
+    "DistributedFedAvgAPI": "fedml_tpu.parallel.spmd",
+    "DistributedFedAvgConfig": "fedml_tpu.parallel.spmd",
+    "build_mesh": "fedml_tpu.parallel.spmd",
+    "TrainConfig": "fedml_tpu.trainer.functional",
+    "FlaxModelTrainer": "fedml_tpu.trainer.flax_trainer",
+    "FederatedDataset": "fedml_tpu.data.base",
+    "load_data": "fedml_tpu.data.registry",
+    "create_model": "fedml_tpu.models",
+    "CheckpointManager": "fedml_tpu.utils.checkpoint",
+    "MetricsSink": "fedml_tpu.utils.metrics",
+}
+
+__all__ = sorted(_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module 'fedml_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

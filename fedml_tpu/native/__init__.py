@@ -79,10 +79,23 @@ def load_packer() -> ctypes.CDLL:
         return _packer_handle
     try:
         path = _build(_PACKER_SRC, _PACKER_LIB)
+        lib = ctypes.CDLL(str(path))
+        lib.fedml_pack_clients  # noqa: B018 — probe the symbol now
     except NativeUnavailable as exc:
         _packer_handle = exc
         raise
-    lib = ctypes.CDLL(str(path))
+    except (OSError, AttributeError) as exc:
+        # corrupt/truncated .so (e.g. a g++ killed mid-link whose output
+        # the mtime cache would keep returning): rebuild once from
+        # scratch, then negative-cache a persistent failure
+        try:
+            path = _build(_PACKER_SRC, _PACKER_LIB, force=True)
+            lib = ctypes.CDLL(str(path))
+            lib.fedml_pack_clients  # noqa: B018
+        except Exception as exc2:  # noqa: BLE001
+            err = NativeUnavailable(f"packer library unusable: {exc2!r}")
+            _packer_handle = err
+            raise err from exc
     lib.fedml_pack_clients.restype = ctypes.c_int
     lib.fedml_pack_clients.argtypes = [
         ctypes.POINTER(ctypes.c_void_p),   # src_ptrs
@@ -113,6 +126,13 @@ def pack_arrays_native(srcs, dst, mask=None,
     if len(srcs) != P or not dst.flags.c_contiguous:
         raise ValueError("dst must be C-contiguous [P, n_pad, ...] with "
                          "one src per client")
+    if mask is not None and (mask.dtype != np.float32
+                             or mask.shape != (P, n_pad)
+                             or not mask.flags.c_contiguous):
+        # the C side writes P*n_pad float32s straight through the pointer
+        raise ValueError(
+            f"mask must be C-contiguous float32 [{P}, {n_pad}]; got "
+            f"{mask.dtype}{mask.shape}")
     row_bytes = dst.nbytes // max(1, P * n_pad)
     ptrs = (ctypes.c_void_p * P)()
     counts = (ctypes.c_int64 * P)()

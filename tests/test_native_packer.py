@@ -91,3 +91,27 @@ class TestPacker:
         np.testing.assert_array_equal(x, want_x)
         np.testing.assert_array_equal(y, want_y)
         np.testing.assert_array_equal(mask, want_m)
+
+    def test_bad_mask_layout_rejected(self):
+        from fedml_tpu.native import pack_arrays_native
+
+        srcs = [np.ones((2, 3), np.float32)]
+        dst = np.empty((1, 4, 3), np.float32)
+        try:
+            with pytest.raises(ValueError, match="mask"):
+                pack_arrays_native(srcs, dst, np.empty((1, 4)))  # float64
+        except NativeUnavailable:
+            pytest.skip("no toolchain")
+
+    def test_corrupt_library_falls_back(self, tmp_path, monkeypatch):
+        """A truncated .so (g++ killed mid-link) must not wedge
+        pack_clients: load_packer rebuilds once, then negative-caches."""
+        import fedml_tpu.native as native
+
+        monkeypatch.setattr(native, "_packer_handle", None)
+        bad = tmp_path / "libfedml_packer.so"
+        bad.write_bytes(b"not an elf")
+        monkeypatch.setattr(native, "_PACKER_LIB", bad)
+        # rebuild path: force=True writes a good library over the bad one
+        lib = native.load_packer()
+        assert lib.fedml_pack_clients is not None

@@ -206,3 +206,20 @@ class TestRoundWatchdog:
                 dog.heartbeat(rec["round"])
         assert dog.stall_count == 0
         assert dog._last_round == 1
+
+
+class TestTopLevelApi:
+    def test_lazy_exports_resolve(self):
+        import fedml_tpu
+
+        for name in fedml_tpu._EXPORTS:
+            assert getattr(fedml_tpu, name) is not None
+        assert "FedAvgAPI" in dir(fedml_tpu)
+
+    def test_unknown_attribute_raises(self):
+        import pytest
+
+        import fedml_tpu
+
+        with pytest.raises(AttributeError):
+            fedml_tpu.NoSuchThing
