@@ -113,5 +113,8 @@ class TestPacker:
         bad.write_bytes(b"not an elf")
         monkeypatch.setattr(native, "_PACKER_LIB", bad)
         # rebuild path: force=True writes a good library over the bad one
-        lib = native.load_packer()
+        try:
+            lib = native.load_packer()
+        except NativeUnavailable:
+            pytest.skip("no toolchain")
         assert lib.fedml_pack_clients is not None
