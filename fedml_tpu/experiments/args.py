@@ -58,6 +58,9 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "subsample of the train union (None = full)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--run_dir", type=str, default="./runs/latest")
+    parser.add_argument("--profile_dir", type=str, default=None,
+                        help="write a TensorBoard-loadable jax.profiler "
+                             "trace of the training loop here")
     parser.add_argument("--use_wandb", action="store_true")
     parser.add_argument("--checkpoint_dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")

@@ -352,7 +352,9 @@ def main(argv=None):
     add_algo_args(parser)
     args = apply_ci_truncation(parser.parse_args(argv))
     logging.basicConfig(level=logging.INFO)
-    return run_algo(args)
+    from fedml_tpu.utils.tracing import profile
+    with profile(getattr(args, "profile_dir", None)):
+        return run_algo(args)
 
 
 if __name__ == "__main__":

@@ -129,7 +129,9 @@ def main(argv=None):
     ds, model, task = build_dataset_and_model(args)
     sink = MetricsSink(args.run_dir, config=vars(args),
                        use_wandb=args.use_wandb)
-    final = BACKEND_RUNNERS[args.backend](args, ds, model, task, sink)
+    from fedml_tpu.utils.tracing import profile
+    with profile(getattr(args, "profile_dir", None)):
+        final = BACKEND_RUNNERS[args.backend](args, ds, model, task, sink)
     sink.finish()
     logging.info("final: %s", final)
     return final

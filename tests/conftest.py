@@ -73,6 +73,8 @@ SLOW_TESTS = {
     "test_resume_is_bit_identical",
     "test_checkpoint_resume.py::TestKillMidRun::"
     "test_sigkill_then_resume_completes",
+    "test_checkpoint_resume.py::TestModelParallelResume::"
+    "test_fsdp_spmd_resume_is_bit_identical",
     "test_algorithms.py::TestHierarchical::test_grouped_training_learns",
     "test_utils.py::TestCheckpoint::test_resume_continues_identically",
     "test_torch_import.py::test_fedgkt_warm_start",
