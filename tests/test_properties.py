@@ -166,3 +166,80 @@ class TestCompressionLaws:
         transposed = {"w": base["w"].T.copy()}  # same count, wrong shape
         with pytest.raises(ValueError):
             decompress_delta(payload, transposed, interpret=True)
+
+
+class TestSamplingLaws:
+    @settings(**COMMON)
+    @given(st.integers(0, 10**6), st.integers(1, 100), st.integers(1, 100))
+    def test_sample_is_valid_and_deterministic(self, round_idx, total,
+                                               per_round):
+        from fedml_tpu.core.sampling import sample_clients
+
+        a = sample_clients(round_idx, total, per_round)
+        b = sample_clients(round_idx, total, per_round)
+        assert np.array_equal(a, b)                     # (round, seed)-pure
+        assert len(a) == min(per_round, total)
+        assert len(np.unique(a)) == len(a)              # without replacement
+        assert a.min() >= 0 and a.max() < total
+
+    @settings(**COMMON)
+    @given(st.integers(0, 1000), st.integers(2, 50))
+    def test_leave_one_out_excludes_client(self, round_idx, total):
+        from fedml_tpu.core.sampling import sample_clients
+
+        drop = round_idx % total
+        a = sample_clients(round_idx, total, max(1, total // 2),
+                           delete_client=drop)
+        assert drop not in set(a.tolist())
+
+
+class TestTopologyLaws:
+    @settings(**COMMON)
+    @given(st.integers(4, 24), st.integers(2, 6))
+    def test_symmetric_rows_stochastic_and_symmetric_support(self, n, k):
+        from fedml_tpu.core.topology import SymmetricTopologyManager
+
+        W = SymmetricTopologyManager(n, k).generate_topology()
+        np.testing.assert_allclose(W.sum(1), 1.0, rtol=1e-5)
+        assert ((W > 0) == (W > 0).T).all()             # undirected support
+        assert (np.diag(W) > 0).all()                   # self-loops
+
+    @settings(**COMMON)
+    @given(st.integers(5, 20), st.integers(0, 2**31 - 1))
+    def test_asymmetric_rows_stochastic(self, n, seed):
+        from fedml_tpu.core.topology import AsymmetricTopologyManager
+
+        np.random.seed(seed)
+        mgr = AsymmetricTopologyManager(n, 3, 2)
+        W = mgr.generate_topology()
+        np.testing.assert_allclose(W.sum(1), 1.0, rtol=1e-5)
+
+
+class TestSerializationLaws:
+    @settings(**COMMON)
+    @given(st.integers(0, 2**31 - 1), st.integers(1, 4))
+    def test_pytree_codec_roundtrip(self, seed, depth):
+        from fedml_tpu.comm.serialization import dumps, loads
+
+        rng = np.random.RandomState(seed)
+
+        def make(d):
+            if d == 0:
+                return rng.randn(*rng.randint(1, 5, rng.randint(1, 3))
+                                 ).astype(rng.choice(
+                                     [np.float32, np.float64, np.int32]))
+            return {f"k{i}": make(d - 1) for i in range(rng.randint(1, 3))}
+
+        tree = make(depth)
+        out = loads(dumps(tree))
+        import jax
+        assert (jax.tree.structure(tree) == jax.tree.structure(out))
+        for a, b in zip(jax_leaves(tree), jax_leaves(out)):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
