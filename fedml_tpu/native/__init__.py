@@ -24,8 +24,20 @@ from pathlib import Path
 from typing import Optional
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
-_SRC = _REPO_ROOT / "native" / "router.cpp"
-_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+_PKG_DIR = Path(__file__).resolve().parent
+
+
+def _find_src(name: str) -> Path:
+    """Native source lookup: repo checkout first, then the in-package copy
+    setup.py's build hook ships into wheels (fedml_tpu/native/_src/)."""
+    for base in (_REPO_ROOT / "native", _PKG_DIR / "_src"):
+        if (base / name).exists():
+            return base / name
+    return _REPO_ROOT / "native" / name  # canonical path for the error msg
+
+
+_SRC = _find_src("router.cpp")
+_BUILD_DIR = _PKG_DIR / "_build"
 _LIB = _BUILD_DIR / "libfedml_router.so"
 _build_lock = threading.Lock()
 
@@ -65,7 +77,7 @@ def build_lib(force: bool = False) -> Path:
 
 _lib_handle: Optional[ctypes.CDLL] = None
 
-_PACKER_SRC = _REPO_ROOT / "native" / "packer.cpp"
+_PACKER_SRC = _find_src("packer.cpp")
 _PACKER_LIB = _BUILD_DIR / "libfedml_packer.so"
 # CDLL once loaded, NativeUnavailable after a failed build (negative cache)
 _packer_handle = None

@@ -1,0 +1,30 @@
+"""Build hook: copy the repo-root native/ sources into the package.
+
+The C++ sources live at the repo root (native/router.cpp, native/packer.cpp)
+so they are a first-class part of the tree, but an installed wheel only
+ships the fedml_tpu package — this hook copies them into
+``fedml_tpu/native/_src/`` at build time, where
+``fedml_tpu/native/__init__.py`` finds them as its fallback search path and
+the lazy g++ build keeps working on installed deployments.
+"""
+
+import pathlib
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithNativeSources(build_py):
+    def run(self):
+        super().run()
+        root = pathlib.Path(__file__).resolve().parent
+        dest = pathlib.Path(self.build_lib) / "fedml_tpu" / "native" / "_src"
+        dest.mkdir(parents=True, exist_ok=True)
+        for name in ("router.cpp", "packer.cpp", "Makefile"):
+            src = root / "native" / name
+            if src.exists():
+                shutil.copy2(src, dest / name)
+
+
+setup(cmdclass={"build_py": BuildPyWithNativeSources})
