@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# The reference's golden invariant as a standalone gate
+# (CI-script-fedavg.sh:44-49): full participation + full batch + 1 local
+# epoch => FedAvg == centralized training accuracy. Runs the pytest
+# expression that asserts it to three decimals in f32.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest -q \
+  "tests/test_fedavg.py::TestCentralizedEquivalence" "$@"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# CI full lane (nightly): the whole pyramid incl. compile-heavy model-zoo,
+# NAS search, multihost rendezvous, SIGKILL-resume. ~40 min on a
+# laptop-class box.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q "$@"

@@ -46,28 +46,64 @@ class NativeUnavailable(RuntimeError):
     """The native library could not be built or loaded."""
 
 
+def _fallback_build_dir() -> Path:
+    """Writable cache for read-only installs (system site-packages)."""
+    import tempfile
+
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    for cand in (Path(base) / "fedml_tpu" / "native",
+                 Path(tempfile.gettempdir()) /
+                 f"fedml_tpu_native_{os.getuid()}"):
+        try:
+            cand.mkdir(parents=True, exist_ok=True)
+            return cand
+        except OSError:
+            continue
+    raise NativeUnavailable("no writable build directory for native libs")
+
+
 def _build(src: Path, lib: Path, force: bool = False) -> Path:
-    """Compile one native source into a shared library (cached by mtime)."""
+    """Compile one native source into a shared library (cached by mtime).
+
+    Raises :class:`NativeUnavailable` for EVERY failure mode (missing
+    toolchain, compile error, read-only install) so callers can always
+    fall back to pure Python; a read-only package dir is retried in a
+    per-user cache dir."""
     with _build_lock:
         if not src.exists():
             if lib.exists():  # prebuilt library shipped without sources
                 return lib
             raise NativeUnavailable(f"native source missing: {src}")
-        if (not force and lib.exists()
-                and lib.stat().st_mtime >= src.stat().st_mtime):
-            return lib
-        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-               "-shared", "-o", str(lib), str(src)]
-        try:
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=300)
-        except (OSError, subprocess.TimeoutExpired) as exc:
-            raise NativeUnavailable(f"g++ unavailable: {exc}") from exc
-        if proc.returncode != 0:
-            raise NativeUnavailable(
-                f"native build failed:\n{proc.stderr[-4000:]}")
-        return lib
+        candidates = [lib, _fallback_build_dir() / lib.name]
+        if not force:
+            for cand in candidates:
+                if (cand.exists()
+                        and cand.stat().st_mtime >= src.stat().st_mtime):
+                    return cand
+        last_err: Exception | None = None
+        for cand in candidates:
+            try:
+                cand.parent.mkdir(parents=True, exist_ok=True)
+                # probe writability before paying the compile
+                cand.parent.joinpath(".write_probe").touch()
+                cand.parent.joinpath(".write_probe").unlink()
+            except OSError as exc:  # read-only install: try next dir
+                last_err = exc
+                continue
+            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall",
+                   "-pthread", "-shared", "-o", str(cand), str(src)]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=300)
+            except (OSError, subprocess.TimeoutExpired) as exc:
+                raise NativeUnavailable(f"g++ unavailable: {exc}") from exc
+            if proc.returncode != 0:
+                raise NativeUnavailable(
+                    f"native build failed:\n{proc.stderr[-4000:]}")
+            return cand
+        raise NativeUnavailable(
+            f"no writable build directory for native libs: {last_err}")
 
 
 def build_lib(force: bool = False) -> Path:

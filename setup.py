@@ -21,10 +21,22 @@ class BuildPyWithNativeSources(build_py):
         root = pathlib.Path(__file__).resolve().parent
         dest = pathlib.Path(self.build_lib) / "fedml_tpu" / "native" / "_src"
         dest.mkdir(parents=True, exist_ok=True)
+        missing = []
         for name in ("router.cpp", "packer.cpp", "Makefile"):
             src = root / "native" / name
+            alt = root / "fedml_tpu" / "native" / "_src" / name
             if src.exists():
                 shutil.copy2(src, dest / name)
+            elif alt.exists():  # building from an installed/_src tree
+                shutil.copy2(alt, dest / name)
+            else:
+                missing.append(name)
+        if missing:
+            # fail loudly: a wheel silently missing the native sources is
+            # exactly the degradation this hook exists to prevent
+            raise RuntimeError(
+                f"native sources missing from build tree: {missing} — "
+                "sdist must graft native/ (MANIFEST.in)")
 
 
 setup(cmdclass={"build_py": BuildPyWithNativeSources})

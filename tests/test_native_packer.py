@@ -121,3 +121,21 @@ class TestPacker:
         # the regression this test exists to catch)
         lib = native.load_packer()
         assert lib.fedml_pack_clients is not None
+
+    def test_readonly_install_builds_into_cache_dir(self, tmp_path,
+                                                    monkeypatch):
+        """When the package dir is unwritable (system site-packages), the
+        build lands in the per-user cache dir instead of raising through
+        the numpy-fallback contract."""
+        import shutil as _sh
+
+        import fedml_tpu.native as native
+
+        if _sh.which("g++") is None:
+            pytest.skip("no toolchain")
+        blocker = tmp_path / "blocker"
+        blocker.write_text("x")  # mkdir below this raises NotADirectoryError
+        out = native._build(native._PACKER_SRC,
+                            blocker / "sub" / "libfedml_packer.so",
+                            force=True)
+        assert out.exists() and "blocker" not in str(out)
