@@ -65,9 +65,24 @@ def non_iid_partition_with_dirichlet_distribution(
     Retries whole partitions until every client has >= 10 samples.
     """
     N = len(label_list) if task == "segmentation" else label_list.shape[0]
+    if N < MIN_SAMPLES_PER_CLIENT * client_num:
+        # the reference's retry loop would spin forever here; fail loudly
+        # with the actual constraint instead
+        raise ValueError(
+            f"cannot give {client_num} clients >= "
+            f"{MIN_SAMPLES_PER_CLIENT} samples each from {N} total; "
+            "reduce client_num, add data, or use partition_method='homo'")
     min_size = 0
+    retries = 0
     idx_batch: List[List[int]] = []
     while min_size < MIN_SAMPLES_PER_CLIENT:
+        retries += 1
+        if retries > 1000:
+            raise ValueError(
+                f"LDA partition failed to give every one of {client_num} "
+                f"clients >= {MIN_SAMPLES_PER_CLIENT} of {N} samples after "
+                f"{retries - 1} retries (alpha={alpha} too small for this "
+                "federation?); use partition_method='homo' or raise alpha")
         idx_batch = [[] for _ in range(client_num)]
         if task == "segmentation":
             for c, cat in enumerate(classes):

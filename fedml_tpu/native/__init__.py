@@ -63,47 +63,62 @@ def _fallback_build_dir() -> Path:
     raise NativeUnavailable("no writable build directory for native libs")
 
 
+def _compile_into(src: Path, cand: Path) -> Path:
+    """mkdir + writability-probe + g++ into ``cand``. Raises OSError for
+    unwritable directories (caller may fall back) and NativeUnavailable
+    for toolchain/compile failures (terminal)."""
+    import tempfile
+
+    cand.parent.mkdir(parents=True, exist_ok=True)
+    # unique probe name: a fixed name races across processes
+    fd, probe = tempfile.mkstemp(dir=cand.parent)
+    os.close(fd)
+    os.unlink(probe)
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+           "-shared", "-o", str(cand), str(src)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        raise NativeUnavailable(f"g++ unavailable: {exc}") from exc
+    if proc.returncode != 0:
+        raise NativeUnavailable(
+            f"native build failed:\n{proc.stderr[-4000:]}")
+    return cand
+
+
 def _build(src: Path, lib: Path, force: bool = False) -> Path:
     """Compile one native source into a shared library (cached by mtime).
 
     Raises :class:`NativeUnavailable` for EVERY failure mode (missing
     toolchain, compile error, read-only install) so callers can always
-    fall back to pure Python; a read-only package dir is retried in a
-    per-user cache dir."""
+    fall back to pure Python. A read-only package dir falls back to a
+    per-user cache whose filename is keyed by the source hash, so two
+    installs with different sources can never load each other's ABI."""
     with _build_lock:
         if not src.exists():
             if lib.exists():  # prebuilt library shipped without sources
                 return lib
             raise NativeUnavailable(f"native source missing: {src}")
-        candidates = [lib, _fallback_build_dir() / lib.name]
-        if not force:
-            for cand in candidates:
-                if (cand.exists()
-                        and cand.stat().st_mtime >= src.stat().st_mtime):
-                    return cand
-        last_err: Exception | None = None
-        for cand in candidates:
+        if (not force and lib.exists()
+                and lib.stat().st_mtime >= src.stat().st_mtime):
+            return lib
+        try:
+            return _compile_into(src, lib)
+        except OSError:
+            # read-only install: content-addressed lib in the user cache
+            import hashlib
+
+            tag = hashlib.sha256(src.read_bytes()).hexdigest()[:12]
+            fb = _fallback_build_dir() / f"{lib.stem}_{tag}{lib.suffix}"
+            if not force and fb.exists():
+                return fb
             try:
-                cand.parent.mkdir(parents=True, exist_ok=True)
-                # probe writability before paying the compile
-                cand.parent.joinpath(".write_probe").touch()
-                cand.parent.joinpath(".write_probe").unlink()
-            except OSError as exc:  # read-only install: try next dir
-                last_err = exc
-                continue
-            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall",
-                   "-pthread", "-shared", "-o", str(cand), str(src)]
-            try:
-                proc = subprocess.run(cmd, capture_output=True, text=True,
-                                      timeout=300)
-            except (OSError, subprocess.TimeoutExpired) as exc:
-                raise NativeUnavailable(f"g++ unavailable: {exc}") from exc
-            if proc.returncode != 0:
+                return _compile_into(src, fb)
+            except OSError as exc:
                 raise NativeUnavailable(
-                    f"native build failed:\n{proc.stderr[-4000:]}")
-            return cand
-        raise NativeUnavailable(
-            f"no writable build directory for native libs: {last_err}")
+                    f"no writable build directory for native libs: "
+                    f"{exc}") from exc
 
 
 def build_lib(force: bool = False) -> Path:

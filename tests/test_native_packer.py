@@ -133,9 +133,12 @@ class TestPacker:
 
         if _sh.which("g++") is None:
             pytest.skip("no toolchain")
+        monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "cache"))
         blocker = tmp_path / "blocker"
         blocker.write_text("x")  # mkdir below this raises NotADirectoryError
         out = native._build(native._PACKER_SRC,
                             blocker / "sub" / "libfedml_packer.so",
                             force=True)
-        assert out.exists() and "blocker" not in str(out)
+        assert out.exists() and str(tmp_path / "cache") in str(out)
+        # content-addressed: the filename carries the source hash
+        assert "libfedml_packer_" in out.name

@@ -243,3 +243,21 @@ def jax_leaves(tree):
     import jax
 
     return jax.tree.leaves(tree)
+
+
+class TestPartitionGuards:
+    def test_impossible_partition_rejected_fast(self):
+        """N < 10*clients can never satisfy the min-size loop: hard error
+        instead of the reference's infinite retry."""
+        y = np.zeros(50, np.int64)
+        with pytest.raises(ValueError, match="cannot give"):
+            non_iid_partition_with_dirichlet_distribution(y, 10, 1, 0.5)
+
+    def test_unlucky_partition_gives_actionable_error(self):
+        """Feasible-in-principle but astronomically unlikely configs stop
+        after the retry cap with guidance (100 clients x ~20 samples)."""
+        rng = np.random.RandomState(0)
+        y = rng.randint(0, 5, 2000)
+        np.random.seed(0)
+        with pytest.raises(ValueError, match="retries"):
+            non_iid_partition_with_dirichlet_distribution(y, 100, 5, 0.5)
