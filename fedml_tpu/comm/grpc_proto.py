@@ -137,14 +137,43 @@ def _jsonify(value: Any) -> Any:
     return value
 
 
-def message_to_json(msg: Message) -> str:
-    return json.dumps(_jsonify(msg.get_params()))
+def _restore_tensors(value: Any) -> Any:
+    """Nested lists → ndarrays inside a MODEL-PARAMS payload — the
+    reference's receive-side convention
+    (fedml_api/distributed/fedavg/utils.py:6 transform_list_to_tensor,
+    applied to JSON payloads on the mobile/MQTT path, and like the
+    reference scoped to the model payload only: other params keep their
+    Python types). float64 drops to float32 exactly as the reference's
+    ``.float()`` does. A zero-size leaf comes back as float32 [0] — the
+    JSON wire cannot carry its original shape/dtype (use the binary
+    backends for models with empty params)."""
+    if isinstance(value, dict):
+        return {k: _restore_tensors(v) for k, v in value.items()}
+    if isinstance(value, list):
+        try:
+            arr = np.asarray(value)
+        except (ValueError, TypeError):
+            return [_restore_tensors(v) for v in value]
+        if arr.dtype.kind not in "fiu":
+            return [_restore_tensors(v) for v in value]
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        return arr
+    return value
 
 
 def message_from_json(payload: str) -> Message:
     msg = Message()
-    msg.msg_params = json.loads(payload)
+    params = json.loads(payload)
+    key = Message.MSG_ARG_KEY_MODEL_PARAMS
+    if isinstance(params, dict) and key in params:
+        params[key] = _restore_tensors(params[key])
+    msg.msg_params = params
     return msg
+
+
+def message_to_json(msg: Message) -> str:
+    return json.dumps(_jsonify(msg.get_params()))
 
 
 class ProtoGrpcCommManager(BaseCommunicationManager):

@@ -149,7 +149,11 @@ class TestProtoInterop:
                 threading.Event().wait(0.05)
             assert got, "our manager never received the reference message"
             assert got[0].get_type() == 2
-            assert got[0].get("model_params")["w"] == [1.0, 2.0]
+            # the JSON wire carries nested lists; receive restores arrays
+            # (reference transform_list_to_tensor role)
+            np.testing.assert_array_equal(
+                got[0].get("model_params")["w"],
+                np.asarray([1.0, 2.0], np.float32))
 
             # 2) our manager → reference servicer
             server.send_message(Message(type=3, sender_id=0, receiver_id=1)
@@ -163,3 +167,25 @@ class TestProtoInterop:
             server.stop_receive_message()
             ref_server.stop(grace=None)
             t.join(timeout=5)
+
+
+class TestJsonArrayRestoration:
+    def test_arrays_survive_the_json_wire(self):
+        """to_json -> from_json restores ndarray leaves (the reference's
+        transform_tensor_to_list / transform_list_to_tensor pair,
+        fedavg/utils.py:6,12) — without it every downstream tree op sees
+        scalar leaves and federated training breaks on MQTT/GRPC_PROTO."""
+        import numpy as np
+
+        msg = Message()
+        msg.add("model_params", {"kernel": np.arange(6, dtype=np.float32
+                                                     ).reshape(2, 3),
+                                 "bias": np.zeros(3, np.float32)})
+        msg.add("round_idx", 4)
+        msg.add("names", ["a", "b"])  # structural list stays a list
+        out = message_from_json(message_to_json(msg))
+        k = out.get("model_params")["kernel"]
+        assert isinstance(k, np.ndarray) and k.shape == (2, 3)
+        assert k.dtype == np.float32
+        assert out.get("round_idx") == 4
+        assert out.get("names") == ["a", "b"]
