@@ -80,6 +80,12 @@ class FedAvgConfig:
     # subsamples evaluation the same way for its largest federation,
     # fedavg_api.py:115 _generate_validation_set). None = full union.
     eval_train_subsample: Optional[int] = None
+    # padding policy for the per-round client pack: "cohort" pads to the
+    # sampled cohort's pow-2 bucket (data/base.py cohort_padded_len — big
+    # FLOP win on power-law federations, a few extra compiles), "global"
+    # pads every round to the dataset-wide max (one compile ever). Full
+    # participation produces identical shapes either way.
+    pack: str = "cohort"
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 
 
@@ -123,11 +129,17 @@ class FedAvgAPI:
             new_vars = hook(variables, stacked, weights, agg_key)
             return new_vars, totals
 
+        # unjitted round body, shared with FusedRounds so the fused and
+        # host paths cannot diverge semantically
+        self._round_fn_py = round_fn
+
         # donate the variables buffer: the old global model is dead the
         # moment the round closes, so XLA reuses its HBM for the new one
         # instead of holding both live (free bandwidth on big models)
         self._round_fn = jax.jit(round_fn, donate_argnums=(0,))
         self._eval_fn = jax.jit(make_eval(module, task))
+        if self.config.pack not in ("cohort", "global"):
+            raise ValueError(f"unknown pack policy: {self.config.pack!r}")
         self._n_pad = dataset.padded_len(cfg.batch_size)
         self._base_key = jax.random.key(self.config.seed)
 
@@ -166,9 +178,12 @@ class FedAvgAPI:
             xd, yd, maskd, wd = self._pack_cache[2]
         else:
             self._pack_cache = None  # free the old buffers before packing
+            n_pad = (self.dataset.cohort_padded_len(idxs,
+                                                    cfg.train.batch_size)
+                     if cfg.pack == "cohort" else self._n_pad)
             x, y, mask = self.dataset.pack_clients(idxs,
                                                    cfg.train.batch_size,
-                                                   n_pad=self._n_pad)
+                                                   n_pad=n_pad)
             weights = self.dataset.client_weights(idxs)
             xd, yd, maskd, wd = (jnp.asarray(x), jnp.asarray(y),
                                  jnp.asarray(mask), jnp.asarray(weights))
@@ -245,3 +260,106 @@ class FedAvgAPI:
             rec.update(_normalized(self._eval_fn(self.variables, *test),
                                    "test"))
         return rec
+
+
+class FusedRounds:
+    """Multi-round on-device driver: R FedAvg rounds under ONE ``lax.scan``,
+    so the host syncs once per R rounds instead of once per round (SURVEY §7
+    "keep the entire round on-device"). Two modes:
+
+    - **full participation** (``client_num_per_round == client_num``): data
+      is packed and uploaded once; per-round/per-client RNG keys are derived
+      *inside* the scan by the same ``fold_in`` chain the host loop uses
+      (FedAvgAPI._prepare_round), so the fused trajectory is equal to the
+      host loop's round for round.
+    - **device-side sampling** (``device_sampling=True``): the WHOLE
+      federation is packed once as ``[client_num, n_pad, ...]`` device
+      arrays; each scanned round draws ``client_num_per_round`` indices
+      without replacement with ``jax.random.choice`` and gathers its cohort
+      on device. This is the throughput mode for the reference's
+      10-of-1000 sampling regime — zero host work per round — but its
+      sampling stream is jax-native, NOT the host loop's
+      ``np.random.seed(round_idx)`` contract (core/sampling.py), so use the
+      host loop when reference-sampling parity matters. HBM holds the full
+      federation (global-max padding; the gather needs one static shape).
+
+    Stats come back stacked ``[R, ...]`` per scan, so per-round local-loss
+    trajectories survive fusion.
+    """
+
+    def __init__(self, api: FedAvgAPI, device_sampling: bool = False):
+        self.api = api
+        cfg = api.config
+        ds = api.dataset
+        self.k = cfg.client_num_per_round
+        self.N = ds.client_num
+        self.device_sampling = device_sampling
+        if not device_sampling and self.k != self.N:
+            raise ValueError(
+                "fused rounds without device_sampling require full "
+                f"participation (got {self.k}/{self.N} clients); pass "
+                "device_sampling=True for the sampled-cohort throughput mode")
+        if api.delete_client is not None:
+            raise ValueError(
+                "FusedRounds does not honor delete_client (the in-scan "
+                "cohort covers all clients); use the host loop for "
+                "leave-one-out measurements")
+        bsz = cfg.train.batch_size
+        pool = np.arange(self.N)
+        x, y, mask = ds.pack_clients(pool, bsz, n_pad=api._n_pad)
+        self._data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+                      jnp.asarray(ds.client_weights(pool)))
+        round_fn = api._round_fn_py
+        base_key = api._base_key
+        k, N = self.k, self.N
+
+        def one_round(variables, r, x, y, mask, weights):
+            round_key = jax.random.fold_in(base_key, r)
+            if device_sampling and k != N:
+                # draw key is a sentinel OUTSIDE the client-id range (like
+                # agg_key): fold_in(round_key, 0) is client 0's training key
+                idx = jax.random.choice(
+                    jax.random.fold_in(round_key, 2**31 - 2),
+                    N, (k,), replace=False)
+                x, y, mask, weights = (jnp.take(a, idx, axis=0)
+                                       for a in (x, y, mask, weights))
+                ids = idx.astype(jnp.uint32)
+            else:
+                ids = jnp.arange(N, dtype=jnp.uint32)
+            keys = jax.vmap(
+                lambda c: jax.random.fold_in(round_key, c))(ids)
+            agg_key = jax.random.fold_in(round_key, 2**31 - 1)
+            return round_fn(variables, x, y, mask, keys, weights, agg_key)
+
+        def run(variables, x, y, mask, weights, r0, rounds):
+            return jax.lax.scan(
+                lambda v, r: one_round(v, r, x, y, mask, weights),
+                variables, r0 + jnp.arange(rounds))
+
+        self._run = jax.jit(run, static_argnums=(6,), donate_argnums=(0,))
+
+    def run_rounds(self, r0: int, rounds: int):
+        """Advance the api's model by ``rounds`` fused rounds starting at
+        round index ``r0``; returns stacked per-round stat totals."""
+        self.api.variables, stats = self._run(
+            self.api.variables, *self._data, jnp.uint32(r0), rounds)
+        return stats
+
+    def train(self) -> Dict:
+        """The FedAvgAPI.train loop with the scan chunked at eval points:
+        one device dispatch per test interval instead of per round."""
+        api, cfg = self.api, self.api.config
+        t0 = time.time()
+        r = 0
+        while r < cfg.comm_round:
+            chunk = min(cfg.frequency_of_the_test, cfg.comm_round - r)
+            stats = self.run_rounds(r, chunk)
+            r += chunk
+            rec = api.evaluate(r - 1)
+            rec["train_loss_local"] = (
+                float(stats["loss_sum"][-1])
+                / max(1.0, float(stats["count"][-1])))
+            rec["wall_s"] = time.time() - t0
+            api.history.append(rec)
+            logging.info("fused round %d: %s", r - 1, rec)
+        return api.history[-1] if api.history else {}

@@ -2,4 +2,5 @@ from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.data.synthetic import (
     make_synthetic_federated,
     make_blob_federated,
+    make_powerlaw_blob_federated,
 )

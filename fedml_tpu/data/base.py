@@ -79,6 +79,24 @@ class FederatedDataset:
             return n
         return ((n + batch_size - 1) // batch_size) * batch_size
 
+    def cohort_padded_len(self, client_idxs,
+                          batch_size: Optional[int]) -> int:
+        """Cohort-shaped padded length: the *sampled cohort's* max client
+        size rounded to a batch multiple, then snapped UP to a power-of-2
+        batch count so the number of distinct compiled round shapes stays
+        O(log2(max batches)), capped at the dataset-wide ``padded_len``.
+
+        On power-law federations (reference MNIST: max client ≫ median,
+        fedml_api/data_preprocessing/MNIST/data_loader.py:88) padding every
+        sampled client to the dataset-wide max makes masked padding rows the
+        majority of per-round FLOPs; padding to the cohort's bucket removes
+        that waste while the pow-2 snap bounds recompiles."""
+        n = max(self.train_data_local_num_dict[int(c)] for c in client_idxs)
+        b = batch_size or 1
+        nb = (n + b - 1) // b
+        bucket = 1 << max(0, (nb - 1).bit_length())
+        return min(bucket * b, self.padded_len(batch_size))
+
     def pack_clients(self, client_idxs, batch_size: Optional[int] = None,
                      n_pad: Optional[int] = None):
         """Gather sampled clients into [P, n_pad, ...] x / [P, n_pad, ...] y /

@@ -178,6 +178,10 @@ class DistributedFedAvgConfig:
     client_num_per_round: int = 8
     frequency_of_the_test: int = 5
     seed: int = 0
+    # padding policy, mirroring FedAvgConfig.pack: "cohort" (pow-2 bucket of
+    # the sampled cohort's max — mesh-padded duplicate slots never raise the
+    # max) or "global" (dataset-wide static shape)
+    pack: str = "cohort"
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     # model parallelism INSIDE each client slot: shard the model over a
     # second mesh axis — "tp" (Megatron, transformer models) or "fsdp"
@@ -204,6 +208,8 @@ class DistributedFedAvgAPI:
         mp = self.config.model_parallel
         if mp and mp not in ("tp", "fsdp"):
             raise ValueError(f"unknown model_parallel: {mp!r}")
+        if self.config.pack not in ("cohort", "global"):
+            raise ValueError(f"unknown pack policy: {self.config.pack!r}")
         if mesh is None and mp:
             devs = jax.devices()
             k = self.config.mp_size
@@ -298,8 +304,11 @@ class DistributedFedAvgAPI:
         else:
             self._pack_cache = None
             padded, alive = self._pad_round(np.asarray(idxs))
+            n_pad = (self.dataset.cohort_padded_len(padded,
+                                                    cfg.train.batch_size)
+                     if cfg.pack == "cohort" else self._n_pad)
             x, y, mask = self.dataset.pack_clients(
-                padded, cfg.train.batch_size, n_pad=self._n_pad)
+                padded, cfg.train.batch_size, n_pad=n_pad)
             mask = mask * alive[:, None]
             weights = self.dataset.client_weights(padded) * alive
             xd, yd, maskd, wd = (put(jnp.asarray(x)), put(jnp.asarray(y)),

@@ -1,0 +1,122 @@
+"""FusedRounds: R FedAvg rounds under one lax.scan (throughput mode).
+
+Contract points: (1) full-participation fusion reproduces the host loop's
+trajectory (the in-scan fold_in chain equals FedAvgAPI._prepare_round's),
+(2) the chunked train() loop learns and records history, (3) device-side
+sampling trains a sampled cohort per scanned round with zero host work,
+(4) the sampled mode must be requested explicitly.
+"""
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig, FusedRounds
+from fedml_tpu.core import pytree as pt
+from fedml_tpu.data.synthetic import make_blob_federated
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.trainer.functional import TrainConfig
+
+
+def _api(ds, **kw):
+    model = LogisticRegression(num_classes=ds.class_num)
+    cfg = dict(comm_round=6, client_num_per_round=ds.client_num,
+               frequency_of_the_test=100,
+               train=TrainConfig(epochs=2, batch_size=16, lr=0.1))
+    cfg.update(kw)
+    return FedAvgAPI(ds, model, config=FedAvgConfig(**cfg))
+
+
+class TestFusedFullParticipation:
+    def test_matches_host_loop_trajectory(self):
+        ds = make_blob_federated(client_num=6, partition_method="hetero",
+                                 seed=0)
+        host = _api(ds)
+        fused_api = _api(ds)
+        fused = FusedRounds(fused_api)
+        for r in range(6):
+            host.run_round(r)
+        fused.run_rounds(0, 6)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused_api.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+
+    def test_resuming_mid_stream_matches(self):
+        # two scans of 3 == one scan of 6 (r0 threads the round index)
+        ds = make_blob_federated(client_num=4, seed=1)
+        a, b = _api(ds), _api(ds)
+        fa, fb = FusedRounds(a), FusedRounds(b)
+        fa.run_rounds(0, 6)
+        fb.run_rounds(0, 3)
+        fb.run_rounds(3, 3)
+        diff = float(pt.tree_norm(pt.tree_sub(a.variables, b.variables)))
+        assert diff < 1e-6, diff
+
+    def test_chunked_train_learns(self):
+        ds = make_blob_federated(client_num=8, seed=2)
+        api = _api(ds, comm_round=12, frequency_of_the_test=4)
+        final = FusedRounds(api).train()
+        assert final["test_acc"] > 0.9, final
+        assert len(api.history) == 3
+        assert np.isfinite(final["train_loss_local"])
+
+    def test_stats_stacked_per_round(self):
+        ds = make_blob_federated(client_num=4, seed=3)
+        api = _api(ds)
+        stats = FusedRounds(api).run_rounds(0, 5)
+        assert stats["loss_sum"].shape == (5,)
+        assert float(stats["count"][0]) > 0
+
+
+class TestFusedDeviceSampling:
+    def test_partial_requires_explicit_mode(self):
+        ds = make_blob_federated(client_num=12, seed=4)
+        api = _api(ds, client_num_per_round=4)
+        try:
+            FusedRounds(api)
+        except ValueError as e:
+            assert "device_sampling" in str(e)
+        else:
+            raise AssertionError("partial cohort accepted without opt-in")
+
+    def test_delete_client_rejected(self):
+        # leave-one-out semantics can't be honored in-scan; must refuse
+        from fedml_tpu.models.lr import LogisticRegression as LR
+        ds = make_blob_federated(client_num=6, seed=4)
+        api = FedAvgAPI(ds, LR(num_classes=ds.class_num),
+                        delete_client=2,
+                        config=FedAvgConfig(
+                            client_num_per_round=6,
+                            train=TrainConfig(batch_size=16)))
+        try:
+            FusedRounds(api)
+        except ValueError as e:
+            assert "delete_client" in str(e)
+        else:
+            raise AssertionError("delete_client silently ignored")
+
+    def test_sampled_rounds_learn(self):
+        ds = make_blob_federated(client_num=16, seed=5, n_samples=3000)
+        api = _api(ds, comm_round=20, client_num_per_round=4,
+                   frequency_of_the_test=10)
+        fused = FusedRounds(api, device_sampling=True)
+        final = fused.train()
+        assert final["test_acc"] > 0.85, final
+
+    def test_sampled_cohorts_vary_across_rounds(self):
+        # the per-round choice key is a sentinel fold (2**31-2, outside the
+        # client-id range so no training key is reused); distinct rounds
+        # draw distinct cohorts with overwhelming probability
+        ds = make_blob_federated(client_num=16, seed=6)
+        api = _api(ds, client_num_per_round=4)
+        fused = FusedRounds(api, device_sampling=True)
+        base = api._base_key
+        draws = []
+        for r in range(4):
+            rk = jax.random.fold_in(base, r)
+            idx = jax.random.choice(jax.random.fold_in(rk, 2**31 - 2),
+                                    16, (4,), replace=False)
+            draws.append(tuple(np.asarray(idx)))
+            assert len(set(draws[-1])) == 4  # without replacement
+        assert len(set(draws)) > 1
+        fused.run_rounds(0, 4)  # and the fused program executes
