@@ -130,6 +130,44 @@ class SegEvaluator:
         return float((freq[freq > 0] * iu[freq > 0]).sum())
 
 
+def make_confusion_eval(module, num_class: int, batch_size: int = 16):
+    """Jitted scanned confusion-matrix accumulation: applies the model in
+    fixed-size batches (the trainer/functional.make_eval pattern) and sums
+    the [C, C] one-hot matmul per batch — segmentation eval at real
+    resolutions without materializing logits for the whole test set.
+    Padded samples get label -1, which the validity mask (the same
+    ``0 <= gt < C`` rule as SegEvaluator/reference Evaluator.add_batch,
+    fedseg/utils.py:246-288) excludes along with ignore_index pixels."""
+    C = num_class
+
+    def confusion(variables, x, y):
+        n = x.shape[0]
+        bsz = min(batch_size, n)
+        n_pad = ((n + bsz - 1) // bsz) * bsz
+        pad = n_pad - n
+        if pad:
+            x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            y = jnp.pad(y, [(0, pad)] + [(0, 0)] * (y.ndim - 1),
+                        constant_values=-1)
+        nb = n_pad // bsz
+        xb = x.reshape((nb, bsz) + x.shape[1:])
+        yb = y.reshape((nb, bsz) + y.shape[1:])
+
+        def step(cm, batch):
+            bx, by = batch
+            pred = jnp.argmax(module.apply(variables, bx, train=False), -1)
+            valid = (by >= 0) & (by < C)
+            g1 = jax.nn.one_hot(jnp.where(valid, by, 0).reshape(-1), C)
+            p1 = jax.nn.one_hot(pred.reshape(-1), C)
+            w = valid.reshape(-1, 1).astype(jnp.float32)
+            return cm + jnp.einsum("ng,np->gp", g1 * w, p1), None
+
+        cm, _ = jax.lax.scan(step, jnp.zeros((C, C), jnp.float32), (xb, yb))
+        return cm
+
+    return jax.jit(confusion)
+
+
 class FedSegAPI(FedAvgAPI):
     """FedAvg rounds over a segmentation model; evaluation reports the full
     IoU metric family per round (reference FedSegAggregator +
@@ -137,19 +175,21 @@ class FedSegAPI(FedAvgAPI):
 
     def __init__(self, dataset: FederatedDataset, module,
                  config: Optional[FedAvgConfig] = None,
-                 loss_mode: str = "ce"):
+                 loss_mode: str = "ce", eval_batch_size: int = 16):
         task = ("segmentation" if loss_mode == "ce"
                 else "segmentation_focal")
         super().__init__(dataset, module, task=task, config=config)
+        self._confusion = make_confusion_eval(module, dataset.class_num,
+                                              eval_batch_size)
 
     def evaluate(self, round_idx: int) -> Dict:
         rec = super().evaluate(round_idx)
         xt, yt = self.dataset.test_data_global
         if len(xt):
             ev = SegEvaluator(self.dataset.class_num)
-            logits = self.module.apply(self.variables, jnp.asarray(xt),
-                                       train=False)
-            ev.add_batch(np.asarray(yt), np.asarray(jnp.argmax(logits, -1)))
+            ev.confusion_matrix += np.asarray(
+                self._confusion(self.variables, jnp.asarray(xt),
+                                jnp.asarray(yt)), dtype=np.float64)
             keeper = EvaluationMetricsKeeper(
                 accuracy=ev.pixel_accuracy(),
                 accuracy_class=ev.pixel_accuracy_class(),

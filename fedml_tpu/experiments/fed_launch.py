@@ -21,7 +21,8 @@ from fedml_tpu.utils.metrics import MetricsSink
 # split across --party_num parties (their APIs take arbitrary splits)
 ALGOS = ["fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
          "decentralized", "centralized", "fednas", "fedgkt",
-         "turboaggregate", "fedseg", "split_nn", "vertical_fl"]
+         "turboaggregate", "fedseg", "split_nn", "vertical_fl",
+         "contribution"]
 
 
 def add_algo_args(parser: argparse.ArgumentParser):
@@ -44,6 +45,20 @@ def add_algo_args(parser: argparse.ArgumentParser):
     parser.add_argument("--trim_ratio", type=float, default=0.1)
     parser.add_argument("--num_byzantine", type=int, default=1)
     parser.add_argument("--multi_m", type=int, default=1)
+    # reference poisoned artifacts (edge_case_examples/data_loader.py:283):
+    # path-based ingestion of the shipped southwest/ardis pickles; the
+    # attacker client's local set becomes the reference's clean+edge mix
+    # and accuracy on the edge test set is reported as backdoor_asr
+    parser.add_argument("--poison_pkl", type=str, default=None,
+                        help="reference-format poisoned train artifact "
+                             "(.pkl southwest stack or .pt torch dataset)")
+    parser.add_argument("--poison_test_pkl", type=str, default=None,
+                        help="edge-case test artifact for the attack-"
+                             "success-rate metric")
+    parser.add_argument("--attacker_client", type=int, default=0)
+    parser.add_argument("--target_label", type=int, default=9)
+    parser.add_argument("--poison_num_edge", type=int, default=100)
+    parser.add_argument("--poison_num_clean", type=int, default=400)
     # hierarchical (group_num = edge servers)
     parser.add_argument("--group_num", type=int, default=2)
     parser.add_argument("--group_comm_round", type=int, default=2)
@@ -146,6 +161,19 @@ def run_algo(args):
     elif args.algo == "fedavg_robust":
         from fedml_tpu.algorithms.fedavg_robust import (FedAvgRobustAPI,
                                                         FedAvgRobustConfig)
+        edge_test = None
+        if args.poison_pkl:
+            from fedml_tpu.data.poisoned import (load_edge_case_artifact,
+                                                 mix_edge_case_into_client)
+            x_edge, y_edge = load_edge_case_artifact(
+                args.poison_pkl, target_label=args.target_label)
+            ds = mix_edge_case_into_client(
+                ds, args.attacker_client, x_edge, y_edge,
+                num_edge=args.poison_num_edge,
+                num_clean=args.poison_num_clean, seed=args.seed)
+            if args.poison_test_pkl:
+                edge_test = load_edge_case_artifact(
+                    args.poison_test_pkl, target_label=args.target_label)
         api = FedAvgRobustAPI(ds, model, task=task,
                               config=FedAvgRobustConfig(
                                   defense_type=args.defense_type,
@@ -155,6 +183,23 @@ def run_algo(args):
                                   num_byzantine=args.num_byzantine,
                                   multi_m=args.multi_m,
                                   **common))
+        if edge_test is not None:
+            import jax.numpy as jnp
+
+            from fedml_tpu.algorithms.fedavg import _normalized
+            final = api.train()
+            for rec in api.history:
+                sink.log(rec, step=rec.get("round"))
+            xh, yh = edge_test
+            asr = _normalized(api._eval_fn(
+                api.variables, jnp.asarray(xh), jnp.asarray(yh),
+                jnp.ones(len(xh), jnp.float32)), "backdoor")
+            final = {**final, "backdoor_asr": asr["backdoor_acc"]}
+            sink.log({"backdoor_asr": final["backdoor_asr"]})
+            sink.finish()
+            logging.info("backdoor ASR on edge test set: %.4f",
+                         final["backdoor_asr"])
+            return final
     elif args.algo == "hierarchical":
         from fedml_tpu.algorithms.hierarchical import (HierarchicalConfig,
                                                        HierarchicalFedAvgAPI)
@@ -334,6 +379,26 @@ def run_algo(args):
                             [x_test[:, c] for c in cuts], y_test)
         for rec in fixture.history:
             sink.log(rec, step=rec["epoch"])
+        sink.finish()
+        logging.info("final: %s", final)
+        return final
+    elif args.algo == "contribution":
+        # the reference's contribution workflow driver
+        # (main_fedavg_contribution.py:366-380): train the base federation,
+        # then one leave-one-out retrain per client; report each client's
+        # influence (mean |prob diff| on the test set) through the sink
+        from fedml_tpu.algorithms.fedavg import FedAvgConfig
+        from fedml_tpu.contribution.loo import LeaveOneOutMeasure
+        measure = LeaveOneOutMeasure(ds, lambda: model,
+                                     config=FedAvgConfig(**common),
+                                     task=task)
+        influence = measure.compute_influence()
+        ranked = measure.ranked()
+        for k, v in enumerate(influence):
+            sink.log({"client": k, "influence": v}, step=k)
+        final = {"influence": influence, "ranked": ranked}
+        sink.log({f"influence_client_{k}": v
+                  for k, v in enumerate(influence)})
         sink.finish()
         logging.info("final: %s", final)
         return final

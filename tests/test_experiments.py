@@ -105,6 +105,15 @@ class TestFedLaunch:
                                  "--topology_neighbors_num_undirected", "2"])
         assert final["regret"] > 0
 
+    def test_contribution(self, tmp_path):
+        # one CLI command -> per-client LOO influence scores
+        # (reference main_fedavg_contribution.py:366-380 workflow)
+        final = fed_launch.main(self._common(tmp_path, "contribution"))
+        import numpy as np
+        assert len(final["influence"]) == 4
+        assert all(np.isfinite(v) and v >= 0 for v in final["influence"])
+        assert sorted(final["ranked"]) == [0, 1, 2, 3]
+
     def test_unknown_algo_rejected_by_argparse(self, tmp_path):
         import pytest
         with pytest.raises(SystemExit):

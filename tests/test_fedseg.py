@@ -115,6 +115,59 @@ class TestSegEvaluator:
         assert ev.confusion_matrix.sum() == 3.0
 
 
+class TestConfusionEvalBatched:
+    def test_matches_unbatched_forward_on_large_test_set(self):
+        """Eval set ≫ one batch: the scanned confusion matrix equals the
+        single-call oracle (old code path) exactly, padding excluded."""
+        import jax
+
+        from fedml_tpu.algorithms.fedseg import make_confusion_eval
+        from fedml_tpu.models.segnet import SegNet
+
+        ds = make_seg_federation(client_num=2, n_per=8, hw=16)
+        rng = np.random.RandomState(7)
+        # 37 samples with batch 8 -> 5 scan steps, 3 padded rows
+        xt = rng.randn(37, 16, 16, 3).astype(np.float32)
+        yt = rng.randint(0, 4, (37, 16, 16)).astype(np.int32)
+        yt[0, :2, :2] = IGNORE_INDEX  # ignore pixels excluded either way
+        model = SegNet(num_classes=4, width=8)
+        variables = model.init(jax.random.key(0), jnp.asarray(xt[:1]),
+                               train=False)
+        conf = make_confusion_eval(model, 4, batch_size=8)
+        got = np.asarray(conf(variables, jnp.asarray(xt), jnp.asarray(yt)))
+
+        ev = SegEvaluator(4)
+        logits = model.apply(variables, jnp.asarray(xt), train=False)
+        ev.add_batch(yt, np.asarray(jnp.argmax(logits, -1)))
+        np.testing.assert_allclose(got, ev.confusion_matrix, atol=1e-3)
+        assert got.sum() == 37 * 16 * 16 - 4  # all real pixels minus ignored
+
+    def test_fedseg_evaluate_uses_batched_path(self):
+        # test set (16 samples) larger than eval_batch_size=4: metrics equal
+        # a SegEvaluator fed the same predictions
+        import jax
+
+        from fedml_tpu.models.segnet import SegNet
+
+        ds = make_seg_federation(client_num=2, n_per=8, hw=16)
+        api = FedSegAPI(ds, SegNet(num_classes=ds.class_num, width=8),
+                        eval_batch_size=4,
+                        config=FedAvgConfig(
+                            comm_round=1, client_num_per_round=2,
+                            train=TrainConfig(epochs=1, batch_size=8,
+                                              lr=0.1)))
+        rec = api.evaluate(0)
+        xt, yt = ds.test_data_global
+        ev = SegEvaluator(ds.class_num)
+        logits = api.module.apply(api.variables, jnp.asarray(xt),
+                                  train=False)
+        ev.add_batch(np.asarray(yt), np.asarray(jnp.argmax(logits, -1)))
+        np.testing.assert_allclose(rec["test_mIoU"], ev.mean_iou(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(rec["test_FWIoU"],
+                                   ev.frequency_weighted_iou(), rtol=1e-5)
+
+
 class TestFedSegE2E:
     def test_learns_color_blocks(self):
         ds = make_seg_federation()

@@ -1,0 +1,114 @@
+"""Reference-format poisoned artifact ingestion (edge_case_examples parity).
+
+The reference ships its edge-case attack corpora as pickled numpy stacks
+(southwest .pkl) and torch-saved datasets (ARDIS .pt); these tests cover the
+path-based loader for both formats, the reference's clean+edge attacker mix
+(edge_case_examples/data_loader.py:379-409), and the fedavg_robust CLI drive
+with a backdoor-ASR report.
+"""
+
+import pickle
+
+import numpy as np
+
+from fedml_tpu.data.poisoned import (load_edge_case_artifact,
+                                     mix_edge_case_into_client)
+from fedml_tpu.data.synthetic import make_image_blob_federated
+
+
+class _DuckDataset:
+    """Module-level so torch.save/load can pickle it (duck-typed like a
+    torchvision dataset: .data + .targets)."""
+
+    def __init__(self):
+        import torch
+        self.data = torch.ones(6, 8, 8, 3, dtype=torch.uint8) * 255
+        self.targets = list(range(6))
+
+
+def _southwest_pkl(tmp_path, n=40, hw=32):
+    # the southwest artifact is a raw pickled uint8 image stack
+    x = (np.random.RandomState(0).rand(n, hw, hw, 3) * 255).astype(np.uint8)
+    p = tmp_path / "southwest_images_new_train.pkl"
+    with open(p, "rb+" if p.exists() else "wb") as f:
+        pickle.dump(x, f)
+    return str(p), x
+
+
+class TestLoadArtifact:
+    def test_southwest_pickle_stack(self, tmp_path):
+        path, raw = _southwest_pkl(tmp_path)
+        x, y = load_edge_case_artifact(path, target_label=9)
+        assert x.shape == raw.shape and x.dtype == np.float32
+        assert float(x.max()) <= 1.0  # uint8 scaled
+        assert (y == 9).all() and y.dtype == np.int32
+
+    def test_torch_pair_keeps_targets(self, tmp_path):
+        import torch
+        data = torch.zeros(10, 28, 28, dtype=torch.uint8)
+        targets = torch.full((10,), 7)
+        p = tmp_path / "ardis_test_dataset.pt"
+        torch.save((data, targets), p)
+        x, y = load_edge_case_artifact(str(p), target_label=1)
+        assert x.shape == (10, 28, 28, 1)  # grayscale expanded to NHWC
+        assert (y == 7).all()  # artifact targets win over target_label
+
+    def test_torch_dataset_object(self, tmp_path):
+        import torch
+
+        p = tmp_path / "poisoned_dataset_fraction_10.pt"
+        torch.save(_DuckDataset(), p)
+        x, y = load_edge_case_artifact(str(p))
+        assert x.shape == (6, 8, 8, 3)
+        np.testing.assert_allclose(x.max(), 1.0)
+        assert list(y) == list(range(6))
+
+
+class TestMixIntoClient:
+    def test_reference_mix_counts(self, tmp_path):
+        ds = make_image_blob_federated(client_num=4, samples_per_client=50,
+                                       image_size=16, seed=0)
+        x_edge = np.zeros((30, 16, 16, 3), np.float32)
+        y_edge = np.full(30, 3, np.int32)
+        mixed = mix_edge_case_into_client(ds, 1, x_edge, y_edge,
+                                          num_edge=10, num_clean=20, seed=0)
+        xa, ya = mixed.train_data_local_dict[1]
+        assert len(xa) == 30  # 20 clean + 10 edge
+        assert (ya == 3).sum() >= 10  # every edge example target-labeled
+        # other clients untouched
+        np.testing.assert_array_equal(mixed.train_data_local_dict[0][0],
+                                      ds.train_data_local_dict[0][0])
+
+    def test_shape_mismatch_rejected(self):
+        ds = make_image_blob_federated(client_num=2, samples_per_client=20,
+                                       image_size=16, seed=0)
+        try:
+            mix_edge_case_into_client(ds, 0, np.zeros((5, 32, 32, 3)),
+                                      np.zeros(5, np.int32))
+        except ValueError as e:
+            assert "shape" in str(e)
+        else:
+            raise AssertionError("mismatched edge images accepted")
+
+
+class TestRobustCLIWithArtifact:
+    def test_fedavg_robust_drivable_against_artifact(self, tmp_path):
+        from fedml_tpu.experiments import fed_launch
+        path, _ = _southwest_pkl(tmp_path, n=30, hw=32)
+        test_path = str(tmp_path / "southwest_images_new_test.pkl")
+        with open(test_path, "wb") as f:
+            pickle.dump((np.random.RandomState(1).rand(12, 32, 32, 3)
+                         * 255).astype(np.uint8), f)
+        final = fed_launch.main([
+            "--algo", "fedavg_robust", "--dataset", "img_blob",
+            "--model", "lr",
+            "--client_num_in_total", "4", "--client_num_per_round", "4",
+            "--comm_round", "2", "--batch_size", "8", "--lr", "0.05",
+            "--frequency_of_the_test", "1",
+            "--defense_type", "norm_diff_clipping",
+            "--poison_pkl", path, "--poison_test_pkl", test_path,
+            "--attacker_client", "1", "--target_label", "3",
+            "--poison_num_edge", "10", "--poison_num_clean", "20",
+            "--run_dir", str(tmp_path / "run")])
+        assert "backdoor_asr" in final
+        assert 0.0 <= final["backdoor_asr"] <= 1.0
