@@ -1,0 +1,117 @@
+"""LEAF-format dataset generator — full loader-path fidelity without
+downloads.
+
+This environment has zero network egress (see runs/fetch_attempt_r3.log:
+``fedml.s3-us-west-1.amazonaws.com`` unresolvable), so the reference's LEAF
+corpora cannot be fetched. This module writes datasets in the EXACT on-disk
+format the reference's readers consume — ``train/*.json`` + ``test/*.json``
+with ``users`` / ``num_samples`` / ``user_data`` keys (reference read_data,
+fedml_api/data_preprocessing/MNIST/data_loader.py:8-49) — with the
+reference's power-law client-size distribution (leaf mnist niid split:
+median tens of samples, max hundreds, data_loader.py:88), so
+``load_partition_data_mnist`` and the whole downstream stack (9-tuple
+contract, packing, sampling) run exactly as they would on the real corpus.
+
+Content is synthetic: class-conditional "digit" prototypes + pixel noise in
+[0, 1]^784, linearly separable enough that MNIST+LR reaches the reference's
+>75% anchor (benchmark/README.md:12) — a stand-in for trajectory/scale/
+throughput validation, NOT a claim about real-MNIST accuracy.
+
+CLI: ``python -m fedml_tpu.data.leaf_gen --out /tmp/leaf_mnist --clients
+1000``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def _digit_prototypes(rng: np.random.RandomState, class_num: int = 10,
+                      hw: int = 28) -> np.ndarray:
+    """Smooth per-class intensity patterns (low-frequency cosine mixtures),
+    visually blob-like and linearly separable under noise."""
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float64) / hw
+    protos = []
+    for c in range(class_num):
+        f1, f2 = rng.randint(1, 4, 2)
+        p1, p2 = rng.rand(2) * 2 * np.pi
+        img = (np.cos(2 * np.pi * f1 * xx + p1)
+               * np.cos(2 * np.pi * f2 * yy + p2))
+        img += 0.5 * np.cos(2 * np.pi * (xx + yy) * (c % 5 + 1))
+        img = (img - img.min()) / (img.max() - img.min())
+        protos.append(img.reshape(-1))
+    return np.asarray(protos)
+
+
+def generate_leaf_mnist(out_dir: str, client_num: int = 1000, seed: int = 0,
+                        min_samples: int = 10, size_mean: float = 3.2,
+                        size_sigma: float = 1.1, max_samples: int = 500,
+                        noise: float = 0.25, class_num: int = 10,
+                        shards: int = 4, test_fraction: float = 0.15
+                        ) -> str:
+    """Write a LEAF-MNIST-format dataset and return ``out_dir``.
+
+    Power-law sizes: ``min_samples + lognormal(size_mean, size_sigma)``
+    capped at ``max_samples`` — the shape of the reference's niid power-law
+    MNIST split. Each client's class mix is skewed (2 dominant classes per
+    client) to mirror LEAF's writer-level non-IIDness.
+    """
+    rng = np.random.RandomState(seed)
+    protos = _digit_prototypes(rng, class_num)
+    sizes = np.minimum(
+        (min_samples + rng.lognormal(size_mean, size_sigma,
+                                     client_num)).astype(int),
+        max_samples)
+
+    users = [f"f_{i:05d}" for i in range(client_num)]
+    train_blobs = [{"users": [], "num_samples": [], "user_data": {}}
+                   for _ in range(shards)]
+    test_blobs = [{"users": [], "num_samples": [], "user_data": {}}
+                  for _ in range(shards)]
+    for i, (u, n) in enumerate(zip(users, sizes)):
+        # skewed class mix: 2 dominant classes hold ~70% of the samples
+        dom = rng.choice(class_num, 2, replace=False)
+        probs = np.full(class_num, 0.3 / (class_num - 2))
+        probs[dom] = 0.35
+        y = rng.choice(class_num, n, p=probs)
+        x = protos[y] + noise * rng.randn(n, protos.shape[1])
+        x = np.clip(x, 0.0, 1.0)
+        n_test = max(1, int(n * test_fraction))
+        s = i % shards
+        for blob, lo, hi in ((test_blobs[s], 0, n_test),
+                             (train_blobs[s], n_test, int(n))):
+            blob["users"].append(u)
+            blob["num_samples"].append(hi - lo)
+            blob["user_data"][u] = {
+                "x": np.round(x[lo:hi], 4).tolist(),
+                "y": y[lo:hi].astype(int).tolist(),
+            }
+    for sub, blobs in (("train", train_blobs), ("test", test_blobs)):
+        d = os.path.join(out_dir, sub)
+        os.makedirs(d, exist_ok=True)
+        for s, blob in enumerate(blobs):
+            with open(os.path.join(
+                    d, f"all_data_{s}_niid_0_keep_0_{sub}_9.json"),
+                    "w") as f:
+                json.dump(blob, f)
+    return out_dir
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("fedml_tpu leaf_gen")
+    p.add_argument("--out", type=str, required=True)
+    p.add_argument("--clients", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max_samples", type=int, default=500)
+    args = p.parse_args(argv)
+    out = generate_leaf_mnist(args.out, client_num=args.clients,
+                              seed=args.seed, max_samples=args.max_samples)
+    print(f"wrote LEAF-format dataset to {out}")
+
+
+if __name__ == "__main__":
+    main()
