@@ -13,7 +13,12 @@ Workloads (BASELINE.md rows):
 3. ``transformer_flash_s2048``: causal LM train step (4-layer, width 256,
    S=2048) with the Pallas flash-attention kernel; tokens/s plus the
    speedup over the XLA reference attention.
-4. ``time_to_target_acc``: seconds for the seeded blob federation to reach
+4. ``fedavg_powerlaw_1000``: the reference flagship shape (1000 power-law
+   clients, 10/round, B=10, LR) with cohort-bucket packing; also reports
+   the padded-row reduction vs global-max packing.
+5. ``fedavg_fused_rounds``: R rounds under one lax.scan with device-side
+   sampling (FusedRounds) vs the host loop — host sync amortized over R.
+6. ``time_to_target_acc``: seconds for the seeded blob federation to reach
    92% test accuracy (BASELINE.md names time-to-target as a north-star
    metric; the federation is fully reproducible, seed=3).
 
@@ -240,6 +245,188 @@ def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
     }
 
 
+def bench_powerlaw_1000() -> dict:
+    """The reference flagship shape: 1000 power-law clients (LEAF MNIST
+    size distribution), 10 sampled/round, B=10 — the workload where
+    cohort-bucket packing matters. Reports rounds/s (cohort packing, the
+    default) and the padded-row reduction vs global-max packing (a direct
+    per-round FLOP proxy; VERDICT r2 contract: >=3x)."""
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.sampling import sample_clients
+    from fedml_tpu.data.synthetic import make_powerlaw_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+
+    tpu = _is_tpu()
+    N = 1000
+    timed = 50 if tpu else 8
+    ds = make_powerlaw_blob_federated(client_num=N, dim=64, class_num=10,
+                                      seed=2)
+    api = FedAvgAPI(ds, LogisticRegression(num_classes=10),
+                    config=FedAvgConfig(
+                        comm_round=timed + 1, client_num_per_round=10,
+                        frequency_of_the_test=10**9,
+                        train=TrainConfig(epochs=1, batch_size=10,
+                                          lr=0.03)))
+    # warm every bucket shape before timing (bounded: <= log2 shapes)
+    warmed = set()
+    for r in range(timed + 1):
+        n_pad = ds.cohort_padded_len(sample_clients(r, N, 10), 10)
+        if n_pad not in warmed:
+            warmed.add(n_pad)
+            api.run_round(r)
+    jax.block_until_ready(api.variables)
+    t0 = time.perf_counter()
+    for r in range(1, timed + 1):
+        api.run_round(r)
+    jax.block_until_ready(api.variables)
+    rps = timed / (time.perf_counter() - t0)
+    glob = ds.padded_len(10)
+    rows_g = rows_c = 0
+    for r in range(1, timed + 1):
+        idxs = sample_clients(r, N, 10)
+        rows_g += glob * len(idxs)
+        rows_c += ds.cohort_padded_len(idxs, 10) * len(idxs)
+    return {
+        "rounds_per_sec": round(rps, 3),
+        "clients_total": N,
+        "padded_row_reduction_vs_global": round(rows_g / rows_c, 2),
+        "phase_ms": {k: round(v * 1e3, 3)
+                     for k, v in api.timer.means().items()},
+    }
+
+
+def bench_fused_rounds() -> dict:
+    """Multi-round on-device driver: R sampled rounds under one lax.scan
+    (FusedRounds device-sampling mode) vs the host loop on the identical
+    workload — the SURVEY §7 'keep the entire round on-device' win
+    condition, with host pack/dispatch amortized over R rounds."""
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import (FedAvgAPI, FedAvgConfig,
+                                             FusedRounds)
+    from fedml_tpu.data.synthetic import make_powerlaw_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+
+    tpu = _is_tpu()
+    N = 1000
+    R = 100 if tpu else 20
+    ds = make_powerlaw_blob_federated(client_num=N, dim=64, class_num=10,
+                                      seed=2)
+
+    def make_api():
+        return FedAvgAPI(ds, LogisticRegression(num_classes=10),
+                         config=FedAvgConfig(
+                             comm_round=10**9, client_num_per_round=10,
+                             frequency_of_the_test=10**9,
+                             train=TrainConfig(epochs=1, batch_size=10,
+                                               lr=0.03)))
+
+    api = make_api()
+    fused = FusedRounds(api, device_sampling=True)
+    fused.run_rounds(0, R)  # compile + warm
+    jax.block_until_ready(api.variables)
+    t0 = time.perf_counter()
+    fused.run_rounds(R, R)
+    jax.block_until_ready(api.variables)
+    fused_rps = R / (time.perf_counter() - t0)
+
+    host = make_api()
+    timed = min(R, 20)
+    # warm EVERY bucket shape the timed rounds will hit (cohort packing
+    # compiles one program per pow-2 bucket; compiling inside the timed
+    # loop would understate the host loop and inflate amortization_x)
+    from fedml_tpu.core.sampling import sample_clients
+    warmed = set()
+    for r in range(timed + 1):
+        n_pad = ds.cohort_padded_len(sample_clients(r, N, 10), 10)
+        if n_pad not in warmed:
+            warmed.add(n_pad)
+            host.run_round(r)
+    jax.block_until_ready(host.variables)
+    t0 = time.perf_counter()
+    for r in range(1, timed + 1):
+        host.run_round(r)
+    jax.block_until_ready(host.variables)
+    host_rps = timed / (time.perf_counter() - t0)
+    return {
+        "rounds_per_sec_fused": round(fused_rps, 3),
+        "rounds_per_sec_host_loop": round(host_rps, 3),
+        "amortization_x": round(fused_rps / host_rps, 2),
+        "rounds_per_scan": R,
+    }
+
+
+def bench_parallel_axes() -> dict:
+    """Perf numbers for the parallelism layer (VERDICT r2 stretch):
+    tokens/s of the federated long-context round on a ('clients', 'seq')
+    mesh and the Megatron round on ('clients', 'tp'). On the single real
+    chip both model axes are size 1 (S=2048 tokens/s of the sharded
+    program); on CPU the 8 virtual devices give a real 4x2 layout at smoke
+    shapes (the scaling-curve artifact lives in
+    runs/parallel_scaling_cpu.json, scripts in tests/perf notes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.parallel.sequence import make_seq_federated_round
+    from fedml_tpu.parallel.tensor import (make_tp_federated_round,
+                                           shard_transformer_tp)
+    from fedml_tpu.trainer.functional import TrainConfig
+
+    tpu = _is_tpu()
+    devs = jax.devices()
+    S = 2048 if tpu else 64
+    vocab = 512
+    width, depth, heads = (256, 4, 4) if tpu else (32, 1, 2)
+    n_pad, bsz, steps = (4, 2, 5) if tpu else (2, 2, 2)
+    cfg = TrainConfig(epochs=1, batch_size=bsz, lr=0.1)
+    rng = np.random.RandomState(0)
+
+    def run(kind, n_model):
+        n_cl = max(1, len(devs) // n_model)
+        P = n_cl
+        mesh = Mesh(np.asarray(devs[:n_cl * n_model]).reshape(
+            n_cl, n_model), ("clients", kind))
+        lm = TransformerLM(vocab_size=vocab, width=width, depth=depth,
+                           num_heads=heads, max_len=S)
+        x = rng.randint(0, vocab, (P, n_pad, S)).astype(np.int32)
+        y = np.roll(x, -1, axis=-1).astype(np.int32)
+        mask = np.ones((P, n_pad), np.float32)
+        weights = np.full((P,), float(n_pad), np.float32)
+        keys = jax.random.split(jax.random.key(0), P)
+        variables = lm.init(jax.random.key(1), jnp.asarray(x[0, :1]),
+                            train=False)
+        if kind == "seq":
+            round_fn = make_seq_federated_round(lm, cfg, mesh)
+        else:
+            round_fn, shard_params = make_tp_federated_round(
+                lm, "nwp", cfg, mesh)
+            variables = shard_params(variables)
+        args = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask), keys,
+                jnp.asarray(weights))
+        v, _ = round_fn(variables, *args)  # compile
+        jax.block_until_ready(v)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            v, _ = round_fn(v, *args)
+        jax.block_until_ready(v)
+        dt = time.perf_counter() - t0
+        return round(steps * P * n_pad * S / dt, 1)
+
+    n_model = 1 if tpu else 2
+    return {
+        "seq_len": S,
+        "mesh_model_axis": n_model,
+        "seq_round_tokens_per_sec": run("seq", n_model),
+        "tp_round_tokens_per_sec": run("tp", n_model),
+    }
+
+
 def bench_time_to_target(target_acc: float = 0.95, max_rounds: int = 60
                          ) -> dict:
     import jax
@@ -371,6 +558,15 @@ def _run(name, fn, timeout_s: int = 420):
         signal.signal(signal.SIGALRM, prev)
 
 
+def _persist_partial(partial: dict) -> None:
+    """Write per-stage results as they land (runs/bench_partial.json): a
+    mid-suite tunnel wedge can kill the process, but every stage that
+    completed stays on disk as evidence."""
+    os.makedirs("runs", exist_ok=True)
+    with open(os.path.join("runs", "bench_partial.json"), "w") as f:
+        json.dump(partial, f, indent=2)
+
+
 def _emit(line: dict) -> None:
     """Print the driver contract line AND persist it to
     runs/bench_details.json (also on failure paths, so a stale success
@@ -450,16 +646,27 @@ def main():
     partial: dict = {}
     _arm_global_watchdog(
         int(os.environ.get("FEDML_BENCH_TOTAL_TIMEOUT_S", 2400)), partial)
-    flagship = partial["fedavg_femnist_cnn"] = _run(
-        "fedavg_femnist_cnn", bench_fedavg_cnn)
-    flagship_bf16 = partial["fedavg_femnist_cnn_bf16"] = _run(
-        "fedavg_femnist_cnn_bf16", bench_fedavg_cnn_bf16)
-    resnet = partial["resnet18_gn_fedcifar100"] = _run(
-        "resnet18_gn", bench_resnet18_gn)
-    transformer = partial["transformer_flash_s2048"] = _run(
-        "transformer_flash", bench_transformer_flash)
-    tta = partial["time_to_target_acc"] = _run(
-        "time_to_target", bench_time_to_target)
+    def staged(key, name, fn):
+        partial[key] = _run(name, fn)
+        _persist_partial(partial)
+        return partial[key]
+
+    flagship = staged("fedavg_femnist_cnn", "fedavg_femnist_cnn",
+                      bench_fedavg_cnn)
+    flagship_bf16 = staged("fedavg_femnist_cnn_bf16",
+                           "fedavg_femnist_cnn_bf16", bench_fedavg_cnn_bf16)
+    resnet = staged("resnet18_gn_fedcifar100", "resnet18_gn",
+                    bench_resnet18_gn)
+    transformer = staged("transformer_flash_s2048", "transformer_flash",
+                         bench_transformer_flash)
+    powerlaw = staged("fedavg_powerlaw_1000", "fedavg_powerlaw_1000",
+                      bench_powerlaw_1000)
+    fused = staged("fedavg_fused_rounds", "fedavg_fused_rounds",
+                   bench_fused_rounds)
+    par_axes = staged("federated_parallel_axes", "federated_parallel_axes",
+                      bench_parallel_axes)
+    tta = staged("time_to_target_acc", "time_to_target",
+                 bench_time_to_target)
     base_out = _run("torch_baseline", lambda: {"rps": bench_torch_baseline()})
     base = base_out.get("rps", float("nan"))
 
@@ -468,6 +675,9 @@ def main():
         "fedavg_femnist_cnn_bf16": flagship_bf16,
         "resnet18_gn_fedcifar100": resnet,
         "transformer_flash_s2048": transformer,
+        "fedavg_powerlaw_1000": powerlaw,
+        "fedavg_fused_rounds": fused,
+        "federated_parallel_axes": par_axes,
         "time_to_target_acc": tta,
         "baseline_kind": "torch_cpu_this_host (reference-style sequential "
                          "simulation; NOT the published GPU baseline)",

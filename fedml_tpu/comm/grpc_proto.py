@@ -146,7 +146,15 @@ def _restore_tensors(value: Any) -> Any:
     Python types). float64 drops to float32 exactly as the reference's
     ``.float()`` does. A zero-size leaf comes back as float32 [0] — the
     JSON wire cannot carry its original shape/dtype (use the binary
-    backends for models with empty params)."""
+    backends for models with empty params).
+
+    Coercion is by VALUE SHAPE, not position: ANY homogeneous numeric
+    nested list under the model payload becomes an ndarray (so a
+    structural int list — e.g. a shape stored inside model_params — comes
+    back as int64 ndarray, and float lists as float32). This mirrors
+    transform_list_to_tensor, which walks every key of the dict the same
+    way; keep non-tensor metadata in other message params (they are left
+    untouched), or use the binary backends for exact type round-trips."""
     if isinstance(value, dict):
         return {k: _restore_tensors(v) for k, v in value.items()}
     if isinstance(value, list):

@@ -79,7 +79,13 @@ def _compile_into(src: Path, cand: Path) -> Path:
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=300)
-    except (OSError, subprocess.TimeoutExpired) as exc:
+    except subprocess.TimeoutExpired as exc:
+        # a loaded host can time the build out transiently; mark it so
+        # load_packer doesn't negative-cache for the whole process
+        err = NativeUnavailable(f"g++ timed out: {exc}")
+        err.transient = True
+        raise err from exc
+    except OSError as exc:
         raise NativeUnavailable(f"g++ unavailable: {exc}") from exc
     if proc.returncode != 0:
         raise NativeUnavailable(
@@ -132,6 +138,7 @@ _PACKER_SRC = _find_src("packer.cpp")
 _PACKER_LIB = _BUILD_DIR / "libfedml_packer.so"
 # CDLL once loaded, NativeUnavailable after a failed build (negative cache)
 _packer_handle = None
+_packer_transient_fails = 0  # g++ timeouts seen (2nd one becomes terminal)
 
 
 def load_packer() -> ctypes.CDLL:
@@ -145,7 +152,16 @@ def load_packer() -> ctypes.CDLL:
         lib = ctypes.CDLL(str(path))
         lib.fedml_pack_clients  # noqa: B018 — probe the symbol now
     except NativeUnavailable as exc:
-        _packer_handle = exc
+        if getattr(exc, "transient", False):
+            # transient (g++ timeout): allow ONE later retry, then treat as
+            # terminal — unbounded retries would stall every large pack for
+            # up to 300s on a host where the build reliably times out
+            global _packer_transient_fails
+            _packer_transient_fails += 1
+            if _packer_transient_fails >= 2:
+                _packer_handle = exc
+        else:
+            _packer_handle = exc  # terminal: missing toolchain/compile error
         raise
     except (OSError, AttributeError) as exc:
         # corrupt/truncated .so (e.g. a g++ killed mid-link whose output
@@ -185,6 +201,9 @@ def pack_arrays_native(srcs, dst, mask=None,
     import numpy as np
 
     lib = load_packer()
+    # copy the list: elements may be replaced by contiguous copies below,
+    # and the caller's list must not see that mutation
+    srcs = list(srcs)
     P, n_pad = dst.shape[0], dst.shape[1]
     if len(srcs) != P or not dst.flags.c_contiguous:
         raise ValueError("dst must be C-contiguous [P, n_pad, ...] with "

@@ -113,18 +113,23 @@ def make_fsdp_train_step(model, mesh: Mesh, lr: float = 1e-3,
         updates, new_opt = tx.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), new_opt), loss
 
-    _jit = {}  # built on first call, reused after (one compile, many steps)
+    # cache keyed by the state's tree structure + leaf avals: a
+    # differently-structured state (new model, new dtype) recompiles with
+    # fresh shardings instead of silently reusing the first call's
+    from fedml_tpu.parallel.gspmd_round import _avals_key
+    _jit = {}
 
     def jitted_step(state, tokens):
-        if "fn" not in _jit:
+        key = _avals_key(state)
+        if key not in _jit:
             state_shardings = (to_sharding(state[0]), to_sharding(state[1]))
-            _jit["fn"] = jax.jit(
+            _jit[key] = jax.jit(
                 step,
                 in_shardings=(state_shardings,
                               NamedSharding(mesh, P(axis))),
                 out_shardings=(state_shardings, None),
                 donate_argnums=(0,) if donate else ())
-        return _jit["fn"](state, tokens)
+        return _jit[key](state, tokens)
 
     return init_state, jitted_step
 

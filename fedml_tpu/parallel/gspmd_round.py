@@ -17,6 +17,15 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+
+def _avals_key(tree):
+    """Jit-cache key: tree structure + leaf shapes/dtypes, so a
+    differently-structured variables tree recompiles with fresh shardings
+    instead of reusing the first call's (stale) ones. Shared with
+    parallel/fsdp.py — keep the rule in one place."""
+    return (jax.tree.structure(tree),
+            tuple((l.shape, str(l.dtype)) for l in jax.tree.leaves(tree)))
+
 def tree_shardings(mesh: Mesh, spec_tree):
     """PartitionSpec tree -> NamedSharding tree over ``mesh``."""
     return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
@@ -61,18 +70,19 @@ def make_sharded_federated_round(model, task: str, cfg, mesh: Mesh,
     def shard_params(variables):
         return place(variables, mesh, param_specs_fn(variables))
 
-    _jit = {}  # one compile across rounds
+    _jit = {}  # one compile per variables structure (see _avals_key)
 
     def jitted(variables, x, y, mask, keys, weights):
-        if "fn" not in _jit:
+        key = _avals_key(variables)
+        if key not in _jit:
             data = NamedSharding(mesh, P(clients_axis))
-            _jit["fn"] = jax.jit(
+            _jit[key] = jax.jit(
                 round_fn,
                 in_shardings=(to_sharding(variables), data, data, data,
                               data, data),
                 out_shardings=(to_sharding(variables), None),
                 donate_argnums=(0,) if donate else ())
-        return _jit["fn"](variables, x, y, mask, keys, weights)
+        return _jit[key](variables, x, y, mask, keys, weights)
 
     return jitted, shard_params
 
@@ -92,14 +102,15 @@ def make_gspmd_eval(module, task: str, mesh: Mesh,
     _jit = {}
 
     def jitted(variables, x, y, mask):
-        if "fn" not in _jit:
+        key = _avals_key(variables)
+        if key not in _jit:
             data = NamedSharding(mesh, P(clients_axis))
-            _jit["fn"] = jax.jit(
+            _jit[key] = jax.jit(
                 ev,
                 in_shardings=(tree_shardings(mesh,
                                              param_specs_fn(variables)),
                               data, data, data),
                 out_shardings=None)
-        return _jit["fn"](variables, x, y, mask)
+        return _jit[key](variables, x, y, mask)
 
     return jitted
