@@ -68,4 +68,4 @@ class LeaveOneOutMeasure:
     def ranked(self) -> List[int]:
         """Client indices by descending influence."""
         assert all(v is not None for v in self.influence), "run compute first"
-        return list(np.argsort(self.influence)[::-1])
+        return [int(i) for i in np.argsort(self.influence)[::-1]]

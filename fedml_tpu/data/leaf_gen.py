@@ -47,6 +47,43 @@ def _digit_prototypes(rng: np.random.RandomState, class_num: int = 10,
     return np.asarray(protos)
 
 
+def build_leaf_mnist_federation(client_num: int = 1000, seed: int = 0,
+                                min_samples: int = 10,
+                                size_mean: float = 3.2,
+                                size_sigma: float = 1.1,
+                                max_samples: int = 500,
+                                noise: float = 0.25, class_num: int = 10,
+                                test_fraction: float = 0.15):
+    """The generator's federation as in-memory arrays (the same content
+    ``generate_leaf_mnist`` serializes): per-client ``(x[784], y)`` train
+    and test splits with power-law sizes and 2-dominant-class skew.
+    Returns a :class:`~fedml_tpu.data.base.FederatedDataset` — used by the
+    bench's reference-anchor time-to-target workload, where writing 250 MB
+    of json per run would be waste."""
+    from fedml_tpu.data.base import FederatedDataset
+
+    rng = np.random.RandomState(seed)
+    protos = _digit_prototypes(rng, class_num)
+    sizes = np.minimum(
+        (min_samples + rng.lognormal(size_mean, size_sigma,
+                                     client_num)).astype(int),
+        max_samples)
+    train_local, test_local = {}, {}
+    for i, n in enumerate(sizes):
+        # skewed class mix: 2 dominant classes hold ~70% of the samples
+        dom = rng.choice(class_num, 2, replace=False)
+        probs = np.full(class_num, 0.3 / (class_num - 2))
+        probs[dom] = 0.35
+        y = rng.choice(class_num, int(n), p=probs).astype(np.int32)
+        x = protos[y] + noise * rng.randn(int(n), protos.shape[1])
+        x = np.clip(x, 0.0, 1.0).astype(np.float32)
+        n_test = max(1, int(n * test_fraction))
+        test_local[i] = (x[:n_test], y[:n_test])
+        train_local[i] = (x[n_test:], y[n_test:])
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               class_num)
+
+
 def generate_leaf_mnist(out_dir: str, client_num: int = 1000, seed: int = 0,
                         min_samples: int = 10, size_mean: float = 3.2,
                         size_sigma: float = 1.1, max_samples: int = 500,
@@ -58,37 +95,29 @@ def generate_leaf_mnist(out_dir: str, client_num: int = 1000, seed: int = 0,
     Power-law sizes: ``min_samples + lognormal(size_mean, size_sigma)``
     capped at ``max_samples`` — the shape of the reference's niid power-law
     MNIST split. Each client's class mix is skewed (2 dominant classes per
-    client) to mirror LEAF's writer-level non-IIDness.
+    client) to mirror LEAF's writer-level non-IIDness. Serializes the
+    federation :func:`build_leaf_mnist_federation` builds (identical RNG
+    stream, identical content for equal parameters).
     """
-    rng = np.random.RandomState(seed)
-    protos = _digit_prototypes(rng, class_num)
-    sizes = np.minimum(
-        (min_samples + rng.lognormal(size_mean, size_sigma,
-                                     client_num)).astype(int),
-        max_samples)
-
+    ds = build_leaf_mnist_federation(
+        client_num=client_num, seed=seed, min_samples=min_samples,
+        size_mean=size_mean, size_sigma=size_sigma,
+        max_samples=max_samples, noise=noise, class_num=class_num,
+        test_fraction=test_fraction)
     users = [f"f_{i:05d}" for i in range(client_num)]
     train_blobs = [{"users": [], "num_samples": [], "user_data": {}}
                    for _ in range(shards)]
     test_blobs = [{"users": [], "num_samples": [], "user_data": {}}
                   for _ in range(shards)]
-    for i, (u, n) in enumerate(zip(users, sizes)):
-        # skewed class mix: 2 dominant classes hold ~70% of the samples
-        dom = rng.choice(class_num, 2, replace=False)
-        probs = np.full(class_num, 0.3 / (class_num - 2))
-        probs[dom] = 0.35
-        y = rng.choice(class_num, n, p=probs)
-        x = protos[y] + noise * rng.randn(n, protos.shape[1])
-        x = np.clip(x, 0.0, 1.0)
-        n_test = max(1, int(n * test_fraction))
+    for i, u in enumerate(users):
         s = i % shards
-        for blob, lo, hi in ((test_blobs[s], 0, n_test),
-                             (train_blobs[s], n_test, int(n))):
+        for blob, (x, y) in ((train_blobs[s], ds.train_data_local_dict[i]),
+                             (test_blobs[s], ds.test_data_local_dict[i])):
             blob["users"].append(u)
-            blob["num_samples"].append(hi - lo)
+            blob["num_samples"].append(len(y))
             blob["user_data"][u] = {
-                "x": np.round(x[lo:hi], 4).tolist(),
-                "y": y[lo:hi].astype(int).tolist(),
+                "x": np.round(x, 4).tolist(),
+                "y": y.astype(int).tolist(),
             }
     for sub, blobs in (("train", train_blobs), ("test", test_blobs)):
         d = os.path.join(out_dir, sub)

@@ -100,6 +100,12 @@ def _blob(data_dir, **kw):
         partition_alpha=kw.get("partition_alpha", 0.5))
 
 
+def _powerlaw_blob(data_dir, **kw):
+    from fedml_tpu.data.synthetic import make_powerlaw_blob_federated
+    return make_powerlaw_blob_federated(
+        client_num=kw.get("client_num_in_total", 1000))
+
+
 def _seg_shapes(data_dir, **kw):
     from fedml_tpu.data.synthetic import make_shapes_segmentation
     return make_shapes_segmentation(
@@ -162,6 +168,7 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "cinic10": _cifar_family("cinic10"),
     "synthetic": _synthetic_generated,  # generated in-memory (no files)
     "blob": _blob,                      # test/bench workhorse
+    "powerlaw_blob": _powerlaw_blob,    # 1000-client power-law scale shape
     "seg_shapes": _seg_shapes,          # synthetic segmentation (fedseg)
     "img_blob": _img_blob,              # synthetic NHWC image classification
     "token_blob": _token_blob,          # synthetic token sequences (nwp)
@@ -187,6 +194,7 @@ DEFAULT_MODEL_AND_TASK = {
     "cinic10": ("resnet56", "classification"),
     "synthetic": ("lr", "classification"),
     "blob": ("lr", "classification"),
+    "powerlaw_blob": ("lr", "classification"),
     "seg_shapes": ("segnet", "segmentation"),
     "img_blob": ("resnet56", "classification"),
     "token_blob": ("transformer", "nwp"),

@@ -105,6 +105,62 @@ def make_spmd_round(module, task: str, cfg: TrainConfig, mesh: Mesh,
     ), donate_argnums=(0,) if donate else ())
 
 
+def make_spmd_multiround(module, task: str, cfg: TrainConfig, mesh: Mesh,
+                         rounds: int, axis: str = "clients",
+                         donate: bool = True):
+    """R full-participation FedAvg rounds as ONE jitted shard_map program:
+    ``lax.scan`` over round indices with the weighted ``psum`` aggregation
+    inside the scan body — on a slice the host is touched once per R
+    rounds instead of once per round (the mesh analogue of
+    algorithms.fedavg.FusedRounds; SURVEY §7 "keep the entire round
+    on-device"). Per-round/per-client keys are derived in-scan by the same
+    fold_in chain the host loop uses, so the trajectory equals R calls of
+    ``make_spmd_round`` with FedAvgAPI-style keys.
+
+    Returns ``fn(variables, x, y, mask, client_ids, weights, base_key,
+    r0) -> (new_variables, stats[R])`` with x/y/mask/weights client-major
+    as in make_spmd_round and ``client_ids`` the uint32 global client ids
+    of the local slots (used only for key derivation).
+    """
+    local_train = make_local_train(module, task, cfg)
+
+    def body(variables, x, y, mask, client_ids, weights, base_key, r0):
+        # client_ids/x/y/mask/weights are sharded inputs — already
+        # device-varying; only the replicated variables need the pcast
+        variables = _pvary(variables, (axis,))
+
+        def one_round(vars_r, r):
+            round_key = jax.random.fold_in(base_key, r)
+            keys = jax.vmap(
+                lambda c: jax.random.fold_in(round_key, c))(client_ids)
+            stacked, stats = jax.vmap(
+                local_train, in_axes=(None, 0, 0, 0, 0))(vars_r, x, y,
+                                                         mask, keys)
+            new_vars = _weighted_psum_mean(stacked, weights, (axis,))
+            totals = jax.tree.map(
+                lambda s: jax.lax.psum(jnp.sum(s, axis=0), axis), stats)
+            # re-vary: the psum result is replicated-typed, the next scan
+            # step consumes it as the (device-varying) client input again
+            return _pvary(new_vars, (axis,)), totals
+
+        new_vars, stats = jax.lax.scan(
+            one_round, variables,
+            r0 + jnp.arange(rounds, dtype=jnp.uint32))
+        # the carry is device-varying-typed but value-identical on every
+        # device (each step ends in the same psum); one pmean clears the
+        # type for the replicated output at zero numeric cost
+        new_vars = jax.tree.map(lambda v: jax.lax.pmean(v, axis), new_vars)
+        return new_vars, stats
+
+    sharded = P(axis)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), sharded, sharded, sharded, sharded, sharded, P(),
+                  P()),
+        out_specs=(P(), P()),
+    ), donate_argnums=(0,) if donate else ())
+
+
 def make_sharded_eval(module, task: str, mesh: Mesh, axis="clients"):
     """Evaluation sharded over the mesh: each device scores its slice of
     the eval union, stat sums meet in one psum. The multi-chip analogue of
@@ -204,6 +260,7 @@ class DistributedFedAvgAPI:
                  config: Optional[DistributedFedAvgConfig] = None):
         self.dataset = dataset
         self.module = module
+        self.task = task
         self.config = config or DistributedFedAvgConfig()
         mp = self.config.model_parallel
         if mp and mp not in ("tp", "fsdp"):
@@ -323,6 +380,47 @@ class DistributedFedAvgAPI:
         self.variables, stats = self._round_fn(
             self.variables, xd, yd, maskd, put(keys), wd)
         return idxs, stats
+
+    def run_rounds_fused(self, r0: int, rounds: int):
+        """Advance the model by ``rounds`` full-participation rounds in ONE
+        device dispatch (make_spmd_multiround): data packed and uploaded
+        once, per-round keys derived in-scan, host synced once. Returns
+        stacked per-round stats. The throughput counterpart of run_round
+        for slices; partial-participation sampling stays on the host loop
+        (its np.random parity contract can't be honored in-scan)."""
+        cfg = self.config
+        N = self.dataset.client_num
+        if cfg.client_num_per_round != N:
+            raise ValueError(
+                "fused mesh rounds require full participation "
+                f"(got {cfg.client_num_per_round}/{N})")
+        if cfg.model_parallel:
+            raise ValueError(
+                "fused mesh rounds support the flat 'clients' mesh only")
+        if (getattr(self, "_fused_data", None) is None
+                or self._fused_data[0] is not self.dataset):
+            padded, alive = self._pad_round(np.arange(N))
+            x, y, mask = self.dataset.pack_clients(
+                padded, cfg.train.batch_size, n_pad=self._n_pad)
+            mask = mask * alive[:, None]
+            weights = self.dataset.client_weights(padded) * alive
+            put = lambda a: jax.device_put(jnp.asarray(a),
+                                           self._data_sharding)
+            # keyed by dataset identity like _pack_cache/_eval_cache: a
+            # mid-run dataset swap must invalidate the resident arrays
+            self._fused_data = (self.dataset,
+                                (put(x), put(y), put(mask),
+                                 put(jnp.asarray(np.asarray(padded),
+                                                 dtype=jnp.uint32)),
+                                 put(weights)))
+            self._fused_fns = {}
+        if rounds not in self._fused_fns:
+            self._fused_fns[rounds] = make_spmd_multiround(
+                self.module, self.task, cfg.train, self.mesh, rounds)
+        self.variables, stats = self._fused_fns[rounds](
+            self.variables, *self._fused_data[1], self._base_key,
+            jnp.uint32(r0))
+        return stats
 
     def train(self, checkpoint_mgr=None, resume: bool = False) -> Dict:
         """Round loop with optional round-level checkpoint/resume: client

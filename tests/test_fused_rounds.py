@@ -68,6 +68,69 @@ class TestFusedFullParticipation:
         assert float(stats["count"][0]) > 0
 
 
+class TestMeshFusedRounds:
+    def test_fused_mesh_rounds_match_host_loop(self):
+        """R rounds under one shard_map scan == R host-loop mesh rounds
+        (and both == the vmapped sim, transitively via test_spmd)."""
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig,
+                                             build_mesh)
+        mesh = build_mesh({"clients": 8})
+        ds = make_blob_federated(client_num=8, partition_method="hetero",
+                                 seed=7)
+        model = LogisticRegression(num_classes=ds.class_num)
+        cfg = DistributedFedAvgConfig(
+            comm_round=4, client_num_per_round=8,
+            train=TrainConfig(epochs=2, batch_size=16, lr=0.1))
+        host = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        fused = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        for r in range(4):
+            host.run_round(r)
+        stats = fused.run_rounds_fused(0, 4)
+        assert stats["loss_sum"].shape == (4,)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+
+    def test_fused_mesh_rejects_partial_and_mp(self):
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig,
+                                             build_mesh)
+        mesh = build_mesh({"clients": 8})
+        ds = make_blob_federated(client_num=8, seed=7)
+        model = LogisticRegression(num_classes=ds.class_num)
+        api = DistributedFedAvgAPI(
+            ds, model, mesh=mesh,
+            config=DistributedFedAvgConfig(
+                client_num_per_round=4,
+                train=TrainConfig(epochs=1, batch_size=16)))
+        try:
+            api.run_rounds_fused(0, 2)
+        except ValueError as e:
+            assert "full participation" in str(e)
+        else:
+            raise AssertionError("partial cohort accepted")
+
+    def test_fused_mesh_resume_mid_stream(self):
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig,
+                                             build_mesh)
+        mesh = build_mesh({"clients": 8})
+        ds = make_blob_federated(client_num=8, seed=8)
+        model = LogisticRegression(num_classes=ds.class_num)
+        cfg = DistributedFedAvgConfig(
+            client_num_per_round=8,
+            train=TrainConfig(epochs=1, batch_size=16, lr=0.1))
+        a = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        b = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        a.run_rounds_fused(0, 6)
+        b.run_rounds_fused(0, 3)
+        b.run_rounds_fused(3, 3)
+        diff = float(pt.tree_norm(pt.tree_sub(a.variables, b.variables)))
+        assert diff < 1e-6, diff
+
+
 class TestFusedDeviceSampling:
     def test_partial_requires_explicit_mode(self):
         ds = make_blob_federated(client_num=12, seed=4)
