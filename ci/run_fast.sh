@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI fast lane (the reference's per-PR Travis role, CI-script-fedavg.sh):
-# unit + integration tests on 8 virtual CPU devices, < ~5 min.
+# unit + integration tests on 8 virtual CPU devices, ~6 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m pytest tests/ -q -m "not slow" "$@"

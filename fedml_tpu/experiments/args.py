@@ -53,6 +53,11 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "(ZeRO-3, any model)")
     parser.add_argument("--mp_size", type=int, default=1,
                         help="devices per client slot for --model_parallel")
+    parser.add_argument("--fused_rounds", type=int, default=0,
+                        help="throughput mode (simulation backend): run N "
+                             "rounds per device dispatch under one "
+                             "lax.scan; partial cohorts sample on device "
+                             "(jax RNG, not the np.random host contract)")
     parser.add_argument("--eval_train_subsample", type=int, default=None,
                         help="evaluate train metrics on a fixed seeded "
                              "subsample of the train union (None = full)")

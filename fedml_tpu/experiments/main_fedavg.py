@@ -42,6 +42,26 @@ def run_simulation(args, ds, model, task, sink):
                            args, "eval_train_subsample", None),
                        train=make_train_config(args))
     api = FedAvgAPI(ds, model, task=task, config=cfg)
+    if getattr(args, "fused_rounds", 0):
+        # throughput mode: chunks of R rounds per device dispatch
+        # (FusedRounds). Device-side sampling when the cohort is partial —
+        # documented divergence from the host sampler's np.random contract.
+        from fedml_tpu.algorithms.fedavg import FusedRounds
+        if args.checkpoint_dir:
+            logging.warning("--checkpoint_dir is not wired for "
+                            "--fused_rounds; ignoring")
+        fused = FusedRounds(
+            api, device_sampling=(
+                cfg.client_num_per_round != ds.client_num))
+        r, rec = 0, {}
+        R = args.fused_rounds
+        while r < cfg.comm_round:
+            chunk = min(R, cfg.comm_round - r)
+            fused.run_rounds(r, chunk)
+            r += chunk
+            rec = api.evaluate(r - 1)
+            sink.log(rec, step=r - 1)
+        return rec
     mgr = (CheckpointManager(args.checkpoint_dir)
            if args.checkpoint_dir else None)
     start = 0

@@ -18,6 +18,24 @@ class TestFedAvgMain:
         summary = json.load(open(tmp_path / "run" / "wandb-summary.json"))
         assert "test_acc" in summary
 
+    def test_fused_rounds_flag(self, tmp_path):
+        # throughput mode: full participation chunks match the host loop's
+        # trajectory, so the final metrics agree with the plain run
+        plain = main_fedavg.main([
+            "--dataset", "blob", "--client_num_in_total", "4",
+            "--client_num_per_round", "4", "--comm_round", "4",
+            "--batch_size", "8", "--lr", "0.1",
+            "--frequency_of_the_test", "3",
+            "--run_dir", str(tmp_path / "plain")])
+        fused = main_fedavg.main([
+            "--dataset", "blob", "--client_num_in_total", "4",
+            "--client_num_per_round", "4", "--comm_round", "4",
+            "--batch_size", "8", "--lr", "0.1",
+            "--frequency_of_the_test", "3", "--fused_rounds", "2",
+            "--run_dir", str(tmp_path / "fused")])
+        assert abs(fused["test_acc"] - plain["test_acc"]) < 1e-6
+        assert abs(fused["test_loss"] - plain["test_loss"]) < 1e-5
+
     def test_spmd_backend(self, tmp_path):
         final = main_fedavg.main([
             "--dataset", "blob", "--client_num_in_total", "8",
