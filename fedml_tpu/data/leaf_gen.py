@@ -130,15 +130,83 @@ def generate_leaf_mnist(out_dir: str, client_num: int = 1000, seed: int = 0,
     return out_dir
 
 
+_WORDS = ("the lord doth speak and all the court attend his word "
+          "what light from yonder window breaks it is the east "
+          "to be or not to be that is the question of the hour "
+          "good night sweet prince and flights of angels sing "
+          "now is the winter of our discontent made glorious summer "
+          "friends romans countrymen lend me your ears i come ").split()
+
+
+def generate_leaf_shakespeare(out_dir: str, client_num: int = 20,
+                              seed: int = 0, seq_len: int = 80,
+                              min_windows: int = 20,
+                              size_mean: float = 4.0,
+                              size_sigma: float = 0.8,
+                              max_windows: int = 400,
+                              shards: int = 2,
+                              test_fraction: float = 0.15) -> str:
+    """Write a LEAF-Shakespeare-format dataset: per-speaker json with
+    ``x`` = 80-char context strings and ``y`` = next-char strings, the
+    exact schema shakespeare/data_loader.py consumes through
+    ``word_to_indices``/``letter_to_index`` (reference
+    language_utils.py:12-25). Content is word-salad over a fixed
+    pseudo-Shakespeare vocabulary — highly predictable char structure, so
+    the RNN next-char path is learnable end to end without the real
+    corpus (zero-egress stand-in; see generate_leaf_mnist)."""
+    rng = np.random.RandomState(seed)
+    sizes = np.minimum(
+        (min_windows + rng.lognormal(size_mean, size_sigma,
+                                     client_num)).astype(int),
+        max_windows)
+    users = [f"speaker_{i:04d}" for i in range(client_num)]
+    train_blobs = [{"users": [], "num_samples": [], "user_data": {}}
+                   for _ in range(shards)]
+    test_blobs = [{"users": [], "num_samples": [], "user_data": {}}
+                  for _ in range(shards)]
+    for i, (u, n_windows) in enumerate(zip(users, sizes)):
+        # one long per-speaker text stream, then sliding windows
+        n_chars = seq_len + int(n_windows)
+        words = []
+        while sum(len(w) + 1 for w in words) < n_chars + 1:
+            words.append(_WORDS[rng.randint(len(_WORDS))])
+        text = " ".join(words)
+        xs = [text[j:j + seq_len] for j in range(int(n_windows))]
+        ys = [text[j + seq_len] for j in range(int(n_windows))]
+        n_test = max(1, int(n_windows * test_fraction))
+        s = i % shards
+        for blob, lo, hi in ((test_blobs[s], 0, n_test),
+                             (train_blobs[s], n_test, int(n_windows))):
+            blob["users"].append(u)
+            blob["num_samples"].append(hi - lo)
+            blob["user_data"][u] = {"x": xs[lo:hi], "y": ys[lo:hi]}
+    for sub, blobs in (("train", train_blobs), ("test", test_blobs)):
+        d = os.path.join(out_dir, sub)
+        os.makedirs(d, exist_ok=True)
+        for s, blob in enumerate(blobs):
+            with open(os.path.join(
+                    d, f"all_data_{s}_niid_0_keep_0_{sub}_9.json"),
+                    "w") as f:
+                json.dump(blob, f)
+    return out_dir
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("fedml_tpu leaf_gen")
     p.add_argument("--out", type=str, required=True)
+    p.add_argument("--format", type=str, default="mnist",
+                   choices=["mnist", "shakespeare"])
     p.add_argument("--clients", type=int, default=1000)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max_samples", type=int, default=500)
     args = p.parse_args(argv)
-    out = generate_leaf_mnist(args.out, client_num=args.clients,
-                              seed=args.seed, max_samples=args.max_samples)
+    if args.format == "shakespeare":
+        out = generate_leaf_shakespeare(args.out, client_num=args.clients,
+                                        seed=args.seed)
+    else:
+        out = generate_leaf_mnist(args.out, client_num=args.clients,
+                                  seed=args.seed,
+                                  max_samples=args.max_samples)
     print(f"wrote LEAF-format dataset to {out}")
 
 

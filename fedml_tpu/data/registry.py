@@ -185,8 +185,8 @@ DEFAULT_MODEL_AND_TASK = {
     "mnist": ("lr", "classification"),
     "femnist": ("cnn", "classification"),
     "fed_cifar100": ("resnet18_gn", "classification"),
-    "shakespeare": ("rnn", "nwp"),
-    "fed_shakespeare": ("rnn", "nwp"),
+    "shakespeare": ("rnn_seq", "nwp"),
+    "fed_shakespeare": ("rnn_seq", "nwp"),
     "stackoverflow_nwp": ("rnn_stackoverflow", "nwp"),
     "stackoverflow_lr": ("lr", "tag_prediction"),
     "cifar10": ("resnet56", "classification"),

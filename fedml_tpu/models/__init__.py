@@ -34,6 +34,14 @@ def create_model(model_name: str, output_dim: int = 10, **kw):
     if model_name == "rnn":
         from fedml_tpu.models.rnn import RNN_OriginalFedAvg
         return RNN_OriginalFedAvg(**kw)
+    if model_name == "rnn_seq":
+        # per-position scoring over output_dim chars — the variant the
+        # shakespeare/fed_shakespeare loaders need: both emit full shifted
+        # target sequences [N, T] for the per-token nwp head (data/leaf.py
+        # convert, data/tff_h5.py), so the LM must score every step
+        from fedml_tpu.models.rnn import RNN_OriginalFedAvg
+        return RNN_OriginalFedAvg(
+            **{"vocab_size": output_dim, "seq_output": True, **kw})
     if model_name == "rnn_stackoverflow":
         from fedml_tpu.models.rnn import RNN_StackOverflow
         return RNN_StackOverflow(**kw)

@@ -42,6 +42,49 @@ class TestLeafGen:
         assert sizes[-1] > 4 * np.median(sizes)
         assert sizes[0] >= 5
 
+    def test_shakespeare_schema_and_round_trip(self, tmp_path):
+        from fedml_tpu.data.leaf import (VOCAB_SIZE,
+                                         load_partition_data_shakespeare)
+        from fedml_tpu.data.leaf_gen import generate_leaf_shakespeare
+
+        out = generate_leaf_shakespeare(str(tmp_path), client_num=6,
+                                        seed=0)
+        with open(os.path.join(out, "train",
+                               sorted(os.listdir(
+                                   os.path.join(out, "train")))[0])) as f:
+            blob = json.load(f)
+        u = blob["users"][0]
+        assert all(len(ctx) == 80 for ctx in blob["user_data"][u]["x"])
+        assert all(len(nxt) == 1 for nxt in blob["user_data"][u]["y"])
+        ds = load_partition_data_shakespeare(out)
+        assert ds.client_num == 6
+        assert ds.class_num == VOCAB_SIZE
+        # targets are the shifted index sequence (per-token CE contract)
+        assert ds.train_data_global[1].shape[1] == 80
+
+    def test_shakespeare_cli_model_scores_every_position(self, tmp_path):
+        """The registry must hand shakespeare the seq_output LM: the
+        loaders emit [N, T] targets, so [B, V] logits (plain \"rnn\")
+        cannot train — this pins the rnn_seq wiring."""
+        import jax
+
+        from fedml_tpu.data.leaf import load_partition_data_shakespeare
+        from fedml_tpu.data.leaf_gen import generate_leaf_shakespeare
+        from fedml_tpu.data.registry import DEFAULT_MODEL_AND_TASK
+        from fedml_tpu.models import create_model
+
+        assert DEFAULT_MODEL_AND_TASK["shakespeare"] == ("rnn_seq", "nwp")
+        assert DEFAULT_MODEL_AND_TASK["fed_shakespeare"] == ("rnn_seq",
+                                                             "nwp")
+        out = generate_leaf_shakespeare(str(tmp_path), client_num=2,
+                                        seed=1)
+        ds = load_partition_data_shakespeare(out)
+        model = create_model("rnn_seq", output_dim=ds.class_num)
+        x = ds.train_data_global[0][:2]
+        v = model.init(jax.random.key(0), x, train=False)
+        logits = model.apply(v, x, train=False)
+        assert logits.shape == (2, 80, ds.class_num)
+
     def test_learnable_by_lr(self, tmp_path):
         # the >75% anchor config shape in miniature: B=10, lr=0.03, E=1
         from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
