@@ -86,12 +86,20 @@ def load_partition_data_shakespeare(data_dir: str,
         os.path.join(data_dir, "train"), os.path.join(data_dir, "test"))
 
     def convert(entries):
+        # char ids are SHIFTED BY +1 so id 0 stays reserved for PAD (the
+        # nwp head masks targets == PAD_TOKEN == 0; unshifted,
+        # ALL_LETTERS[0] = 'd' would collide and every 'd' target would
+        # silently drop out of the loss). tff_h5.py applies the same i+1
+        # convention; VOCAB_SIZE's +4 slack covers the shift. A char not
+        # in the vocabulary (find() == -1) maps to 0 = PAD and is
+        # excluded — the oov policy.
         xs, ys = [], []
         for ctx, nxt in zip(entries["x"], entries["y"]):
-            seq = word_to_indices(ctx[:seq_len].ljust(seq_len))
+            seq = [i + 1 for i in word_to_indices(ctx[:seq_len].ljust(
+                seq_len))]
             xs.append(seq)
             # next-char target sequence: x shifted left, final = y
-            tgt = seq[1:] + [letter_to_index(nxt[0])]
+            tgt = seq[1:] + [letter_to_index(nxt[0]) + 1]
             ys.append(tgt)
         return (np.asarray(xs, np.int32), np.asarray(ys, np.int32))
 

@@ -30,6 +30,20 @@ import os
 import numpy as np
 
 
+
+def _write_shard_jsons(out_dir, train_blobs, test_blobs):
+    """Write the LEAF on-disk layout: train/ and test/ dirs of
+    all_data_{shard}_niid_0_keep_0_{split}_9.json files (the reference's
+    preprocessed-LEAF filename convention)."""
+    for sub, blobs in (("train", train_blobs), ("test", test_blobs)):
+        d = os.path.join(out_dir, sub)
+        os.makedirs(d, exist_ok=True)
+        for s, blob in enumerate(blobs):
+            with open(os.path.join(
+                    d, f"all_data_{s}_niid_0_keep_0_{sub}_9.json"),
+                    "w") as f:
+                json.dump(blob, f)
+
 def _digit_prototypes(rng: np.random.RandomState, class_num: int = 10,
                       hw: int = 28) -> np.ndarray:
     """Smooth per-class intensity patterns (low-frequency cosine mixtures),
@@ -119,14 +133,7 @@ def generate_leaf_mnist(out_dir: str, client_num: int = 1000, seed: int = 0,
                 "x": np.round(x, 4).tolist(),
                 "y": y.astype(int).tolist(),
             }
-    for sub, blobs in (("train", train_blobs), ("test", test_blobs)):
-        d = os.path.join(out_dir, sub)
-        os.makedirs(d, exist_ok=True)
-        for s, blob in enumerate(blobs):
-            with open(os.path.join(
-                    d, f"all_data_{s}_niid_0_keep_0_{sub}_9.json"),
-                    "w") as f:
-                json.dump(blob, f)
+    _write_shard_jsons(out_dir, train_blobs, test_blobs)
     return out_dir
 
 
@@ -180,14 +187,7 @@ def generate_leaf_shakespeare(out_dir: str, client_num: int = 20,
             blob["users"].append(u)
             blob["num_samples"].append(hi - lo)
             blob["user_data"][u] = {"x": xs[lo:hi], "y": ys[lo:hi]}
-    for sub, blobs in (("train", train_blobs), ("test", test_blobs)):
-        d = os.path.join(out_dir, sub)
-        os.makedirs(d, exist_ok=True)
-        for s, blob in enumerate(blobs):
-            with open(os.path.join(
-                    d, f"all_data_{s}_niid_0_keep_0_{sub}_9.json"),
-                    "w") as f:
-                json.dump(blob, f)
+    _write_shard_jsons(out_dir, train_blobs, test_blobs)
     return out_dir
 
 
@@ -196,17 +196,22 @@ def main(argv=None):
     p.add_argument("--out", type=str, required=True)
     p.add_argument("--format", type=str, default="mnist",
                    choices=["mnist", "shakespeare"])
-    p.add_argument("--clients", type=int, default=1000)
+    p.add_argument("--clients", type=int, default=None,
+                   help="default: 1000 (mnist) / 20 (shakespeare)")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--max_samples", type=int, default=500)
+    p.add_argument("--max_samples", type=int, default=None,
+                   help="per-client cap: samples (mnist) / context "
+                        "windows (shakespeare); default 500 / 400")
     args = p.parse_args(argv)
     if args.format == "shakespeare":
-        out = generate_leaf_shakespeare(args.out, client_num=args.clients,
-                                        seed=args.seed)
+        out = generate_leaf_shakespeare(
+            args.out, client_num=args.clients or 20, seed=args.seed,
+            max_windows=args.max_samples or 400)
     else:
-        out = generate_leaf_mnist(args.out, client_num=args.clients,
+        out = generate_leaf_mnist(args.out,
+                                  client_num=args.clients or 1000,
                                   seed=args.seed,
-                                  max_samples=args.max_samples)
+                                  max_samples=args.max_samples or 500)
     print(f"wrote LEAF-format dataset to {out}")
 
 

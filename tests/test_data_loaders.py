@@ -75,7 +75,10 @@ class TestLeaf:
         assert x.shape == (2, 80) and y.shape == (2, 80)
         # y is x shifted left by one with the next char appended
         np.testing.assert_array_equal(x[0, 1:], y[0, :-1])
-        assert y[0, -1] == ALL_LETTERS.find(ctx[80])
+        # +1 shift: id 0 is reserved for PAD so 'd' (ALL_LETTERS[0])
+        # cannot collide with the nwp head's pad mask
+        assert y[0, -1] == ALL_LETTERS.find(ctx[80]) + 1
+        assert (x > 0).all() and (y > 0).all()
 
 
 class TestTffH5:
