@@ -338,7 +338,7 @@ def bench_fused_rounds() -> dict:
                                                lr=0.03)))
 
     api = make_api()
-    fused = FusedRounds(api, device_sampling=True)
+    fused = api.fused_rounds(device_sampling=True)
     fused.run_rounds(0, R)  # compile + warm
     jax.block_until_ready(api.variables)
     t0 = time.perf_counter()

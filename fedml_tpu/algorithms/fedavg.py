@@ -196,6 +196,13 @@ class FedAvgAPI:
         agg_key = jax.random.fold_in(round_key, 2**31 - 1)
         return idxs, (xd, yd, maskd, keys, wd, agg_key)
 
+    def fused_rounds(self, device_sampling: bool = False) -> "FusedRounds":
+        """The fused multi-round driver PAIRED with this API class
+        (subclasses fusing richer server state override
+        ``_fused_driver_cls``); always construct through here so an API
+        cannot be mispaired with a driver that drops its server state."""
+        return self._fused_driver_cls(self, device_sampling)
+
     def run_round(self, round_idx: int):
         with self.timer.phase("pack"):
             idxs, (x, y, mask, keys, weights, agg_key) = self._prepare_round(
@@ -288,6 +295,13 @@ class FusedRounds:
     """
 
     def __init__(self, api: FedAvgAPI, device_sampling: bool = False):
+        if not isinstance(self, api._fused_driver_cls):
+            # e.g. plain FusedRounds(FedOptAPI) would silently run FedAvg
+            # aggregation and drop the server optimizer
+            raise TypeError(
+                f"{type(api).__name__} must be fused with "
+                f"{api._fused_driver_cls.__name__} (use api.fused_rounds())"
+                f", not {type(self).__name__}")
         self.api = api
         cfg = api.config
         ds = api.dataset
@@ -309,11 +323,11 @@ class FusedRounds:
         x, y, mask = ds.pack_clients(pool, bsz, n_pad=api._n_pad)
         self._data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
                       jnp.asarray(ds.client_weights(pool)))
-        round_fn = api._round_fn_py
+        round_step = self._round
         base_key = api._base_key
         k, N = self.k, self.N
 
-        def one_round(variables, r, x, y, mask, weights):
+        def one_round(carry, r, x, y, mask, weights):
             round_key = jax.random.fold_in(base_key, r)
             if device_sampling and k != N:
                 # draw key is a sentinel OUTSIDE the client-id range (like
@@ -329,20 +343,35 @@ class FusedRounds:
             keys = jax.vmap(
                 lambda c: jax.random.fold_in(round_key, c))(ids)
             agg_key = jax.random.fold_in(round_key, 2**31 - 1)
-            return round_fn(variables, x, y, mask, keys, weights, agg_key)
+            return round_step(carry, x, y, mask, keys, weights, agg_key)
 
-        def run(variables, x, y, mask, weights, r0, rounds):
+        def run(carry, x, y, mask, weights, r0, rounds):
             return jax.lax.scan(
-                lambda v, r: one_round(v, r, x, y, mask, weights),
-                variables, r0 + jnp.arange(rounds))
+                lambda c, r: one_round(c, r, x, y, mask, weights),
+                carry, r0 + jnp.arange(rounds))
 
         self._run = jax.jit(run, static_argnums=(6,), donate_argnums=(0,))
+
+    # -- carry protocol: subclasses fusing richer server state (e.g.
+    #    FedOpt's optimizer) override these three -------------------------
+    def _init_carry(self):
+        return self.api.variables
+
+    def _store_carry(self, carry) -> None:
+        self.api.variables = carry
+
+    def _round(self, carry, x, y, mask, keys, weights, agg_key):
+        """One round on the scan carry; the base carry is the variables
+        tree and the body is the exact host-loop round program."""
+        return self.api._round_fn_py(carry, x, y, mask, keys, weights,
+                                     agg_key)
 
     def run_rounds(self, r0: int, rounds: int):
         """Advance the api's model by ``rounds`` fused rounds starting at
         round index ``r0``; returns stacked per-round stat totals."""
-        self.api.variables, stats = self._run(
-            self.api.variables, *self._data, jnp.uint32(r0), rounds)
+        carry, stats = self._run(
+            self._init_carry(), *self._data, jnp.uint32(r0), rounds)
+        self._store_carry(carry)
         return stats
 
     def train(self) -> Dict:
@@ -363,3 +392,8 @@ class FusedRounds:
             api.history.append(rec)
             logging.info("fused round %d: %s", r - 1, rec)
         return api.history[-1] if api.history else {}
+
+
+# the paired fused driver (set after both classes exist); FedOptAPI and
+# other subclasses fusing more server state override this attribute
+FedAvgAPI._fused_driver_cls = FusedRounds

@@ -19,7 +19,8 @@ import jax
 import optax
 
 from fedml_tpu.core import pytree as pt
-from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.algorithms.fedavg import (FedAvgAPI, FedAvgConfig,
+                                         FusedRounds)
 from fedml_tpu.data.base import FederatedDataset
 
 #: name -> constructor(lr, **kw); parity with OptRepo's name2cls lookup
@@ -88,9 +89,34 @@ class FedOptAPI(FedAvgAPI):
 
         # donate the dead global model + opt state buffers (HBM reuse)
         self._fedopt_round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
+        # unjitted body, shared with FedOptFusedRounds (one source of truth)
+        self._fedopt_round_fn_py = round_fn
 
     def run_round(self, round_idx: int):
         idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
         self.variables, self.server_opt_state, stats = self._fedopt_round_fn(
             self.variables, self.server_opt_state, x, y, mask, keys, weights)
         return idxs, stats
+
+
+class FedOptFusedRounds(FusedRounds):
+    """FusedRounds for FedOpt: the scan carry is (variables,
+    server_opt_state), so the persistent server optimizer (Adam/Yogi/...)
+    advances INSIDE the R-round scan — the whole adaptive-server outer
+    loop becomes one device program. Same RNG chain as the host loop;
+    FedOpt's aggregation ignores agg_key just like FedOptAPI.run_round."""
+
+    def _init_carry(self):
+        return (self.api.variables, self.api.server_opt_state)
+
+    def _store_carry(self, carry) -> None:
+        self.api.variables, self.api.server_opt_state = carry
+
+    def _round(self, carry, x, y, mask, keys, weights, agg_key):
+        variables, opt_state = carry
+        new_vars, new_opt, totals = self.api._fedopt_round_fn_py(
+            variables, opt_state, x, y, mask, keys, weights)
+        return (new_vars, new_opt), totals
+
+
+FedOptAPI._fused_driver_cls = FedOptFusedRounds

@@ -46,13 +46,11 @@ def run_simulation(args, ds, model, task, sink):
         # throughput mode: chunks of R rounds per device dispatch
         # (FusedRounds). Device-side sampling when the cohort is partial —
         # documented divergence from the host sampler's np.random contract.
-        from fedml_tpu.algorithms.fedavg import FusedRounds
         if args.checkpoint_dir:
             logging.warning("--checkpoint_dir is not wired for "
                             "--fused_rounds; ignoring")
-        fused = FusedRounds(
-            api, device_sampling=(
-                cfg.client_num_per_round != ds.client_num))
+        fused = api.fused_rounds(
+            device_sampling=(cfg.client_num_per_round != ds.client_num))
         r, rec = 0, {}
         R = args.fused_rounds
         while r < cfg.comm_round:

@@ -68,6 +68,69 @@ class TestFusedFullParticipation:
         assert float(stats["count"][0]) > 0
 
 
+class TestFedOptFused:
+    def test_matches_host_loop_with_server_adam(self):
+        """The server Adam state advances in-scan: R fused rounds equal R
+        host-loop FedOpt rounds (params AND optimizer state)."""
+        from fedml_tpu.algorithms.fedopt import (FedOptAPI, FedOptConfig,
+                                                 FedOptFusedRounds)
+        ds = make_blob_federated(client_num=6, partition_method="hetero",
+                                 seed=9)
+        model = LogisticRegression(num_classes=ds.class_num)
+        kw = dict(comm_round=6, client_num_per_round=6,
+                  frequency_of_the_test=100, server_optimizer="adam",
+                  server_lr=0.01,
+                  train=TrainConfig(epochs=2, batch_size=16, lr=0.1))
+        host = FedOptAPI(ds, model, config=FedOptConfig(**kw))
+        fused_api = FedOptAPI(ds, model, config=FedOptConfig(**kw))
+        fused = FedOptFusedRounds(fused_api)
+        for r in range(6):
+            host.run_round(r)
+        stats = fused.run_rounds(0, 6)
+        assert stats["loss_sum"].shape == (6,)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused_api.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+        opt_diff = jax.tree.map(
+            lambda a, b: float(np.max(np.abs(np.asarray(a)
+                                             - np.asarray(b)))),
+            host.server_opt_state, fused_api.server_opt_state)
+        assert max(jax.tree.leaves(opt_diff)) < 1e-6, opt_diff
+
+    def test_mispairing_rejected(self):
+        # plain FusedRounds on a FedOptAPI would silently drop the server
+        # optimizer — must fail loudly; api.fused_rounds() pairs correctly
+        from fedml_tpu.algorithms.fedopt import (FedOptAPI, FedOptConfig,
+                                                 FedOptFusedRounds)
+        ds = make_blob_federated(client_num=4, seed=9)
+        api = FedOptAPI(ds, LogisticRegression(num_classes=ds.class_num),
+                        config=FedOptConfig(
+                            client_num_per_round=4,
+                            train=TrainConfig(batch_size=16)))
+        try:
+            FusedRounds(api)
+        except TypeError as e:
+            assert "FedOptFusedRounds" in str(e)
+        else:
+            raise AssertionError("mispaired driver accepted")
+        assert isinstance(api.fused_rounds(), FedOptFusedRounds)
+
+    def test_device_sampling_learns(self):
+        from fedml_tpu.algorithms.fedopt import (FedOptAPI, FedOptConfig,
+                                                 FedOptFusedRounds)
+        ds = make_blob_federated(client_num=12, seed=10, n_samples=2500)
+        model = LogisticRegression(num_classes=ds.class_num)
+        api = FedOptAPI(ds, model, config=FedOptConfig(
+            comm_round=20, client_num_per_round=4,
+            frequency_of_the_test=100, server_optimizer="yogi",
+            server_lr=0.05,
+            train=TrainConfig(epochs=1, batch_size=16, lr=0.1)))
+        fused = FedOptFusedRounds(api, device_sampling=True)
+        fused.run_rounds(0, 20)
+        assert api.evaluate(19)["test_acc"] > 0.85
+
+
 class TestMeshFusedRounds:
     def test_fused_mesh_rounds_match_host_loop(self):
         """R rounds under one shard_map scan == R host-loop mesh rounds
