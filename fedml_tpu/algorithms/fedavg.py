@@ -199,8 +199,15 @@ class FedAvgAPI:
     def fused_rounds(self, device_sampling: bool = False) -> "FusedRounds":
         """The fused multi-round driver PAIRED with this API class
         (subclasses fusing richer server state override
-        ``_fused_driver_cls``); always construct through here so an API
-        cannot be mispaired with a driver that drops its server state."""
+        ``_fused_driver_cls``; subclasses whose round leaves the device —
+        e.g. secure aggregation — set it to None); always construct
+        through here so an API cannot be mispaired with a driver that
+        drops its server state."""
+        if self._fused_driver_cls is None:
+            raise TypeError(
+                f"{type(self).__name__} cannot fuse rounds: its round has "
+                "a host-side stage (e.g. the secure share exchange) that "
+                "cannot run inside a scan")
         return self._fused_driver_cls(self, device_sampling)
 
     def run_round(self, round_idx: int):
@@ -295,13 +302,16 @@ class FusedRounds:
     """
 
     def __init__(self, api: FedAvgAPI, device_sampling: bool = False):
-        if not isinstance(self, api._fused_driver_cls):
+        if (api._fused_driver_cls is None
+                or not isinstance(self, api._fused_driver_cls)):
             # e.g. plain FusedRounds(FedOptAPI) would silently run FedAvg
-            # aggregation and drop the server optimizer
+            # aggregation and drop the server optimizer; FusedRounds on a
+            # SecureFedAvgAPI would skip the secure share exchange
+            want = (api._fused_driver_cls.__name__
+                    if api._fused_driver_cls else "no fused driver")
             raise TypeError(
-                f"{type(api).__name__} must be fused with "
-                f"{api._fused_driver_cls.__name__} (use api.fused_rounds())"
-                f", not {type(self).__name__}")
+                f"{type(api).__name__} pairs with {want} "
+                f"(use api.fused_rounds()), not {type(self).__name__}")
         self.api = api
         cfg = api.config
         ds = api.dataset

@@ -124,3 +124,9 @@ class SecureFedAvgAPI(FedAvgAPI):
         self.variables = self._secure.aggregate(stacked, np.asarray(weights),
                                                 round_idx=round_idx)
         return idxs, stats
+
+
+# the secure server step is a HOST-side share exchange; it cannot run
+# inside a fused scan, so this API has no fused driver (fused_rounds()
+# raises instead of silently skipping the MPC protocol)
+SecureFedAvgAPI._fused_driver_cls = None

@@ -246,3 +246,48 @@ class TestFusedDeviceSampling:
             assert len(set(draws[-1])) == 4  # without replacement
         assert len(set(draws)) > 1
         fused.run_rounds(0, 4)  # and the fused program executes
+
+
+class TestFusedPairings:
+    def test_robust_hooks_fuse_with_rng_parity(self):
+        """FedAvgRobustAPI's defenses live in the aggregate hook, which
+        _round_fn_py carries into the scan — including the agg_key the
+        weak-DP noise consumes, so stochastic defenses stay bit-compatible
+        with the host loop."""
+        from fedml_tpu.algorithms.fedavg_robust import (FedAvgRobustAPI,
+                                                        FedAvgRobustConfig)
+        ds = make_blob_federated(client_num=5, partition_method="hetero",
+                                 seed=11)
+        model = LogisticRegression(num_classes=ds.class_num)
+        kw = dict(comm_round=4, client_num_per_round=5,
+                  frequency_of_the_test=100,
+                  defense_type="weak_dp", stddev=0.05,
+                  train=TrainConfig(epochs=1, batch_size=16, lr=0.1))
+        host = FedAvgRobustAPI(ds, model, config=FedAvgRobustConfig(**kw))
+        fused_api = FedAvgRobustAPI(ds, model,
+                                    config=FedAvgRobustConfig(**kw))
+        fused = fused_api.fused_rounds()
+        for r in range(4):
+            host.run_round(r)
+        fused.run_rounds(0, 4)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused_api.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+
+    def test_secure_api_refuses_fusion(self):
+        from fedml_tpu.algorithms.turboaggregate import SecureFedAvgAPI
+        from fedml_tpu.algorithms.fedavg import FedAvgConfig
+        ds = make_blob_federated(client_num=4, seed=11)
+        api = SecureFedAvgAPI(ds,
+                              LogisticRegression(num_classes=ds.class_num),
+                              config=FedAvgConfig(
+                                  client_num_per_round=4,
+                                  train=TrainConfig(batch_size=16)))
+        for ctor in (api.fused_rounds, lambda: FusedRounds(api)):
+            try:
+                ctor()
+            except TypeError as e:
+                assert "fused" in str(e) or "host-side" in str(e)
+            else:
+                raise AssertionError("secure API fused silently")
