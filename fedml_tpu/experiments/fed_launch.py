@@ -94,7 +94,27 @@ def add_algo_args(parser: argparse.ArgumentParser):
                         choices=["ce", "focal"])
 
 
-def _log_history(api, sink):
+def _log_history(api, sink, fused_rounds: int = 0):
+    """Run api.train() — or, when ``--fused_rounds`` is set and the API
+    has a fused driver, the scan-chunked FusedRounds.train() (host sync
+    once per eval interval). APIs without a fusable round (host-side
+    stages, non-FedAvg-family loops) fall back to the host loop with a
+    warning rather than failing the run."""
+    if fused_rounds:
+        try:
+            driver = api.fused_rounds(device_sampling=(
+                api.config.client_num_per_round != api.dataset.client_num))
+        except (AttributeError, TypeError, ValueError) as exc:
+            logging.warning("--fused_rounds unsupported for %s (%s); "
+                            "using the host loop",
+                            type(api).__name__, exc)
+        else:
+            final = driver.train()
+            for rec in getattr(api, "history", []):
+                sink.log(rec, step=rec.get("round"))
+            sink.finish()
+            logging.info("final: %s", final)
+            return final
     final = api.train()
     for rec in getattr(api, "history", []):
         sink.log(rec, step=rec.get("round"))
@@ -405,7 +425,8 @@ def run_algo(args):
     else:  # pragma: no cover - argparse choices rejects unknown algos
         raise SystemExit(f"--algo {args.algo} is not wired in fed_launch")
 
-    return _log_history(api, sink)
+    return _log_history(api, sink,
+                        fused_rounds=getattr(args, "fused_rounds", 0))
 
 
 def main(argv=None):

@@ -88,6 +88,21 @@ class TestFedLaunch:
                                  "--server_lr", "0.01"])
         assert "test_acc" in final
 
+    def test_fedopt_fused_rounds(self, tmp_path):
+        # --fused_rounds through the launcher: FedOpt's paired driver
+        final = fed_launch.main(self._common(tmp_path, "fedopt") +
+                                ["--fused_rounds", "2",
+                                 "--server_optimizer", "adam",
+                                 "--server_lr", "0.01"])
+        assert final["test_acc"] > 0.8, final
+
+    def test_turboaggregate_fused_falls_back(self, tmp_path):
+        # secure aggregation cannot fuse; the launcher must warn and run
+        # the host loop, not crash
+        final = fed_launch.main(self._common(tmp_path, "turboaggregate") +
+                                ["--fused_rounds", "2"])
+        assert final["test_acc"] > 0.8, final
+
     def test_fednova(self, tmp_path):
         final = fed_launch.main(self._common(tmp_path, "fednova"))
         assert "test_acc" in final
