@@ -93,11 +93,15 @@ class TestFedLaunch:
         # The contract is host==fused (2 rounds of server Adam at
         # lr=0.01 move the global model very little either way, so an
         # accuracy bar would test the optimizer, not the fusion)
-        base = self._common(tmp_path, "fedopt")[:-1]  # drop run_dir value
-        extra = ["--server_optimizer", "adam", "--server_lr", "0.01"]
-        host = fed_launch.main(base + [str(tmp_path / "host")] + extra)
-        fused = fed_launch.main(base + [str(tmp_path / "fused")] + extra
-                                + ["--fused_rounds", "2"])
+        def args_for(run_name):
+            # swap only the run_dir VALUE (robust to _common reordering)
+            a = self._common(tmp_path, "fedopt")
+            a[a.index("--run_dir") + 1] = str(tmp_path / run_name)
+            return a + ["--server_optimizer", "adam",
+                        "--server_lr", "0.01"]
+
+        host = fed_launch.main(args_for("host"))
+        fused = fed_launch.main(args_for("fused") + ["--fused_rounds", "2"])
         assert abs(fused["test_acc"] - host["test_acc"]) < 1e-9
         assert abs(fused["test_loss"] - host["test_loss"]) < 1e-6
 
