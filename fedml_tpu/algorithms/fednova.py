@@ -41,6 +41,10 @@ class FedNovaConfig:
     mu: float = 0.0  # proximal coefficient
     dampening: float = 0.0
     nesterov: bool = False
+    # padding policy, mirroring FedAvgConfig.pack ("cohort" | "global").
+    # a_i counts only real batches, so padding never affects the
+    # normalization — this is purely a FLOP/wall-clock knob
+    pack: str = "cohort"
 
 
 def make_fednova_local_train(module, task: str, cfg: FedNovaConfig):
@@ -187,6 +191,8 @@ class FedNovaAPI:
         # donate the dead global model + server momentum buffers
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
         self._eval_fn = jax.jit(make_eval(module, task))
+        if cfg.pack not in ("cohort", "global"):
+            raise ValueError(f"unknown pack policy: {cfg.pack!r}")
         self._n_pad = dataset.padded_len(cfg.train.batch_size)
         self._base_key = jax.random.key(cfg.seed)
         sample_x = dataset.train_data_global[0][:1]
@@ -199,8 +205,10 @@ class FedNovaAPI:
         cfg = self.config
         idxs = sample_clients(round_idx, self.dataset.client_num,
                               cfg.client_num_per_round)
+        n_pad = (self.dataset.cohort_padded_len(idxs, cfg.train.batch_size)
+                 if cfg.pack == "cohort" else self._n_pad)
         x, y, mask = self.dataset.pack_clients(idxs, cfg.train.batch_size,
-                                               n_pad=self._n_pad)
+                                               n_pad=n_pad)
         counts = self.dataset.client_weights(idxs)
         ratios = counts / counts.sum()  # ratio_i = n_i / round_sample_num
         round_key = jax.random.fold_in(self._base_key, round_idx)

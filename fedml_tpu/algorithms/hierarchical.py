@@ -38,6 +38,9 @@ class HierarchicalConfig:
     client_num_per_round: int = 10
     frequency_of_the_test: int = 5
     seed: int = 0
+    # padding policy, mirroring FedAvgConfig.pack ("cohort" | "global"):
+    # each group's round pads to ITS sampled clients' pow-2 bucket
+    pack: str = "cohort"
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 
 
@@ -71,6 +74,8 @@ class HierarchicalFedAvgAPI:
 
         self._round_fn = jax.jit(round_fn)
         self._eval_fn = jax.jit(make_eval(module, task))
+        if cfg.pack not in ("cohort", "global"):
+            raise ValueError(f"unknown pack policy: {cfg.pack!r}")
         self._n_pad = dataset.padded_len(cfg.train.batch_size)
         self._base_key = jax.random.key(cfg.seed)
         sample_x = dataset.train_data_global[0][:1]
@@ -96,8 +101,11 @@ class HierarchicalFedAvgAPI:
             client_idxs + [client_idxs[-1]] * (bucket - len(client_idxs)))
         alive = np.concatenate([np.ones(len(client_idxs)),
                                 np.zeros(bucket - len(client_idxs))])
+        n_pad = (self.dataset.cohort_padded_len(padded,
+                                                cfg.train.batch_size)
+                 if cfg.pack == "cohort" else self._n_pad)
         x, y, mask = self.dataset.pack_clients(padded, cfg.train.batch_size,
-                                               n_pad=self._n_pad)
+                                               n_pad=n_pad)
         mask = mask * alive[:, None].astype(np.float32)
         weights = self.dataset.client_weights(padded) * alive.astype(np.float32)
         for gr in range(cfg.group_comm_round):

@@ -112,6 +112,80 @@ class TestCohortPackTrajectory:
             raise AssertionError("bad pack policy accepted")
 
 
+class TestCohortPackOtherAlgorithms:
+    def test_fednova_full_participation_identical_across_policies(self):
+        """FedNova under full participation: cohort and global packing
+        produce the same shapes, so the trajectories must be IDENTICAL
+        (a_i counts real batches only — a cohort-path regression that
+        altered the normalization would break this equality)."""
+        from fedml_tpu.algorithms.fednova import FedNovaAPI, FedNovaConfig
+        ds = make_powerlaw_blob_federated(client_num=6, dim=8, seed=6,
+                                          max_samples=120)
+        model = LogisticRegression(num_classes=ds.class_num)
+        finals = {}
+        for pack in ("cohort", "global"):
+            api = FedNovaAPI(ds, model, config=FedNovaConfig(
+                comm_round=4, client_num_per_round=6,
+                frequency_of_the_test=100, pack=pack, gmf=0.9,
+                train=TrainConfig(epochs=1, batch_size=10, lr=0.1)))
+            for r in range(4):
+                _, stats = api.run_round(r)
+            assert np.isfinite(float(stats["loss_sum"])), pack
+            finals[pack] = api.variables
+        assert float(pt.tree_norm(pt.tree_sub(finals["cohort"],
+                                              finals["global"]))) == 0
+
+    def test_fednova_sampled_cohort_trains(self):
+        from fedml_tpu.algorithms.fednova import FedNovaAPI, FedNovaConfig
+        ds = make_powerlaw_blob_federated(client_num=20, dim=8, seed=6,
+                                          max_samples=120)
+        model = LogisticRegression(num_classes=ds.class_num)
+        api = FedNovaAPI(ds, model, config=FedNovaConfig(
+            comm_round=6, client_num_per_round=6, frequency_of_the_test=100,
+            train=TrainConfig(epochs=1, batch_size=10, lr=0.1)))
+        for r in range(6):
+            _, stats = api.run_round(r)
+        assert np.isfinite(float(stats["loss_sum"]))
+
+    def test_fednova_hierarchical_reject_bad_policy(self):
+        from fedml_tpu.algorithms.fednova import FedNovaAPI, FedNovaConfig
+        from fedml_tpu.algorithms.hierarchical import (HierarchicalConfig,
+                                                       HierarchicalFedAvgAPI)
+        ds = make_blob_federated(client_num=4, seed=6)
+        model = LogisticRegression(num_classes=ds.class_num)
+        for ctor, cfg in ((FedNovaAPI, FedNovaConfig(pack="chort")),
+                          (HierarchicalFedAvgAPI,
+                           HierarchicalConfig(pack="chort"))):
+            try:
+                ctor(ds, model, config=cfg)
+            except ValueError as e:
+                assert "pack" in str(e)
+            else:
+                raise AssertionError(f"{ctor.__name__} accepted a typo'd "
+                                     "pack policy")
+
+    def test_hierarchical_both_policies_learn(self):
+        from fedml_tpu.algorithms.hierarchical import (HierarchicalConfig,
+                                                       HierarchicalFedAvgAPI)
+        ds = make_powerlaw_blob_federated(client_num=24, dim=8, seed=7,
+                                          max_samples=120)
+        model = LogisticRegression(num_classes=ds.class_num)
+        for pack in ("cohort", "global"):
+            api = HierarchicalFedAvgAPI(ds, model,
+                                        config=HierarchicalConfig(
+                                            global_comm_round=6,
+                                            group_comm_round=2,
+                                            group_num=2,
+                                            client_num_per_round=8,
+                                            frequency_of_the_test=5,
+                                            pack=pack,
+                                            train=TrainConfig(
+                                                epochs=1, batch_size=10,
+                                                lr=0.1)))
+            final = api.train()
+            assert final["test_acc"] > 0.8, (pack, final)
+
+
 class TestDistributedCohortParity:
     def test_sim_equals_distributed_partial_cohort(self):
         """Partial participation (7 of 20 on an 8-device mesh): the mesh pad
