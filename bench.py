@@ -1,5 +1,5 @@
-"""Benchmark suite v2 — flagship FedAvg throughput with MFU, plus
-ResNet-18-GN, transformer flash-attention, and time-to-target-accuracy.
+"""Benchmark suite v3 — flagship FedAvg throughput with MFU, heavier
+conv/LM workloads, packing/fusion evidence, and time-to-target rows.
 
 Workloads (BASELINE.md rows):
 1. ``fedavg_femnist_cnn`` (headline): 10 clients/round, B=20, E=1, the
@@ -7,20 +7,25 @@ Workloads (BASELINE.md rows):
    full FedAvg round = host packing + transfer + local SGD for every sampled
    client + weighted aggregation, all one jitted program. Reported with the
    XLA cost model's FLOPs/round (utils/flops.cost_analysis) and MFU against
-   the chip's bf16 peak.
+   the chip's bf16 peak (plus a bf16-compute variant).
 2. ``resnet18_gn_fedcifar100``: same round shape at fed-CIFAR100 scale
    (ResNet-18 + GroupNorm, 24x24x3, B=20) — the heavier conv workload.
 3. ``transformer_flash_s2048``: causal LM train step (4-layer, width 256,
    S=2048) with the Pallas flash-attention kernel; tokens/s plus the
    speedup over the XLA reference attention.
 4. ``fedavg_powerlaw_1000``: the reference flagship shape (1000 power-law
-   clients, 10/round, B=10, LR) with cohort-bucket packing; also reports
-   the padded-row reduction vs global-max packing.
+   clients, 10/round, B=10, LR) — cohort-bucket packing wall-clock vs
+   global-max packing, plus the padded-row reduction.
 5. ``fedavg_fused_rounds``: R rounds under one lax.scan with device-side
-   sampling (FusedRounds) vs the host loop — host sync amortized over R.
-6. ``time_to_target_acc``: seconds for the seeded blob federation to reach
-   92% test accuracy (BASELINE.md names time-to-target as a north-star
-   metric; the federation is fully reproducible, seed=3).
+   sampling (FusedRounds) vs the host loop at IDENTICAL packing
+   (amortization) and vs the cohort-packed host loop (the other
+   throughput contender).
+6. ``federated_parallel_axes``: tokens/s of the ('clients','seq') and
+   ('clients','tp') federated rounds (S=2048 on chip).
+7. ``time_to_target_mnist_lr``: seconds/rounds to the reference's >75%
+   MNIST+LR anchor at its exact config (benchmark/README.md:12).
+8. ``time_to_target_acc``: seconds for the seeded blob federation to reach
+   92% test accuracy (the fast trend metric; fully reproducible, seed=3).
 
 ``vs_baseline`` on the headline metric is measured against a faithful
 reference-style sequential torch simulation **on this machine's CPU**
