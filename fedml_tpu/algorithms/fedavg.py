@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.core import pytree as pt
-from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.sampling import (DEVICE_SAMPLE_SENTINEL, round_keys,
+                                     sample_clients)
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train)
@@ -108,6 +109,8 @@ class FedAvgAPI:
         self.delete_client = delete_client
         cfg = self.config.train
 
+        from fedml_tpu.trainer.functional import validate_accum_steps
+        validate_accum_steps(cfg, dataset.train_data_local_num_dict)
         self._local_train = make_local_train(module, task, cfg)
         self._vmapped_body = make_vmapped_body(self._local_train)
         if aggregate_hook is not None:
@@ -190,10 +193,9 @@ class FedAvgAPI:
             if len(idxs) == self.dataset.client_num:
                 self._pack_cache = (self.dataset, cohort,
                                     (xd, yd, maskd, wd))
-        round_key = jax.random.fold_in(self._base_key, round_idx)
-        keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
+        _, keys, agg_key = round_keys(
+            self._base_key, round_idx,
             jnp.asarray(np.asarray(idxs), dtype=jnp.uint32))
-        agg_key = jax.random.fold_in(round_key, 2**31 - 1)
         return idxs, (xd, yd, maskd, keys, wd, agg_key)
 
     def fused_rounds(self, device_sampling: bool = False) -> "FusedRounds":
@@ -279,23 +281,33 @@ class FedAvgAPI:
 class FusedRounds:
     """Multi-round on-device driver: R FedAvg rounds under ONE ``lax.scan``,
     so the host syncs once per R rounds instead of once per round (SURVEY §7
-    "keep the entire round on-device"). Two modes:
+    "keep the entire round on-device"). Three modes:
 
     - **full participation** (``client_num_per_round == client_num``): data
       is packed and uploaded once; per-round/per-client RNG keys are derived
       *inside* the scan by the same ``fold_in`` chain the host loop uses
       (FedAvgAPI._prepare_round), so the fused trajectory is equal to the
       host loop's round for round.
+    - **block sampling** (the default when ``client_num_per_round <
+      client_num``): the R cohorts are drawn host-side UP FRONT with the
+      host loop's exact sampling stream (core/sampling.sample_clients, the
+      reference's ``np.random.seed(round_idx)`` contract,
+      FedAVGAggregator.py:89-97), packed as ONE ``[R, k, n_pad, ...]``
+      block at the pow-2 bucket of the block's max cohort size
+      (data/base.py cohort_padded_len), and scanned in one dispatch. This
+      composes the two throughput levers — cohort-bucket padding AND fused
+      multi-round scans — while staying trajectory-identical to the host
+      loop (same cohorts, same fold_in key chain). HBM holds only the
+      R-block, not the federation.
     - **device-side sampling** (``device_sampling=True``): the WHOLE
       federation is packed once as ``[client_num, n_pad, ...]`` device
       arrays; each scanned round draws ``client_num_per_round`` indices
       without replacement with ``jax.random.choice`` and gathers its cohort
-      on device. This is the throughput mode for the reference's
-      10-of-1000 sampling regime — zero host work per round — but its
-      sampling stream is jax-native, NOT the host loop's
-      ``np.random.seed(round_idx)`` contract (core/sampling.py), so use the
-      host loop when reference-sampling parity matters. HBM holds the full
-      federation (global-max padding; the gather needs one static shape).
+      on device — zero host work per round, but the sampling stream is
+      jax-native, NOT the host loop's contract, and HBM holds the full
+      federation at global-max padding (the in-scan gather needs one
+      static shape). Use block sampling unless the per-block host pack is
+      the bottleneck.
 
     Stats come back stacked ``[R, ...]`` per scan, so per-round local-loss
     trajectories survive fusion.
@@ -303,10 +315,13 @@ class FusedRounds:
 
     def __init__(self, api: FedAvgAPI, device_sampling: bool = False):
         if (api._fused_driver_cls is None
-                or not isinstance(self, api._fused_driver_cls)):
+                or type(self) is not api._fused_driver_cls):
             # e.g. plain FusedRounds(FedOptAPI) would silently run FedAvg
             # aggregation and drop the server optimizer; FusedRounds on a
-            # SecureFedAvgAPI would skip the secure share exchange
+            # SecureFedAvgAPI would skip the secure share exchange. Exact
+            # type match: a subclass driver on a base API would pass an
+            # isinstance check and then fail deep in _round on missing
+            # server state (ADVICE r3)
             want = (api._fused_driver_cls.__name__
                     if api._fused_driver_cls else "no fused driver")
             raise TypeError(
@@ -318,41 +333,40 @@ class FusedRounds:
         self.k = cfg.client_num_per_round
         self.N = ds.client_num
         self.device_sampling = device_sampling
-        if not device_sampling and self.k != self.N:
+        self.mode = ("device" if device_sampling
+                     else "full" if self.k == self.N else "block")
+        if api.delete_client is not None and self.mode != "block":
             raise ValueError(
-                "fused rounds without device_sampling require full "
-                f"participation (got {self.k}/{self.N} clients); pass "
-                "device_sampling=True for the sampled-cohort throughput mode")
-        if api.delete_client is not None:
-            raise ValueError(
-                "FusedRounds does not honor delete_client (the in-scan "
-                "cohort covers all clients); use the host loop for "
-                "leave-one-out measurements")
+                "full/device-sampled fused rounds do not honor "
+                "delete_client (the in-scan cohort covers all clients); "
+                "block mode (partial participation) samples host-side and "
+                "honors it")
         bsz = cfg.train.batch_size
-        pool = np.arange(self.N)
-        x, y, mask = ds.pack_clients(pool, bsz, n_pad=api._n_pad)
-        self._data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
-                      jnp.asarray(ds.client_weights(pool)))
         round_step = self._round
         base_key = api._base_key
         k, N = self.k, self.N
 
+        if self.mode in ("full", "device"):
+            # federation resident on device, packed once at the global max
+            pool = np.arange(self.N)
+            x, y, mask = ds.pack_clients(pool, bsz, n_pad=api._n_pad)
+            self._data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+                          jnp.asarray(ds.client_weights(pool)))
+        else:
+            self._data = None  # block mode packs per run_rounds call
+
         def one_round(carry, r, x, y, mask, weights):
-            round_key = jax.random.fold_in(base_key, r)
             if device_sampling and k != N:
-                # draw key is a sentinel OUTSIDE the client-id range (like
-                # agg_key): fold_in(round_key, 0) is client 0's training key
                 idx = jax.random.choice(
-                    jax.random.fold_in(round_key, 2**31 - 2),
+                    jax.random.fold_in(jax.random.fold_in(base_key, r),
+                                       DEVICE_SAMPLE_SENTINEL),
                     N, (k,), replace=False)
                 x, y, mask, weights = (jnp.take(a, idx, axis=0)
                                        for a in (x, y, mask, weights))
                 ids = idx.astype(jnp.uint32)
             else:
                 ids = jnp.arange(N, dtype=jnp.uint32)
-            keys = jax.vmap(
-                lambda c: jax.random.fold_in(round_key, c))(ids)
-            agg_key = jax.random.fold_in(round_key, 2**31 - 1)
+            _, keys, agg_key = round_keys(base_key, r, ids)
             return round_step(carry, x, y, mask, keys, weights, agg_key)
 
         def run(carry, x, y, mask, weights, r0, rounds):
@@ -361,6 +375,41 @@ class FusedRounds:
                 carry, r0 + jnp.arange(rounds))
 
         self._run = jax.jit(run, static_argnums=(6,), donate_argnums=(0,))
+
+        def block_round(carry, inp):
+            r, x, y, mask, ids, weights = inp
+            _, keys, agg_key = round_keys(base_key, r, ids)
+            return round_step(carry, x, y, mask, keys, weights, agg_key)
+
+        def run_block(carry, xs, ys, masks, ids, ws, r0):
+            rs = r0 + jnp.arange(xs.shape[0], dtype=jnp.uint32)
+            return jax.lax.scan(block_round, carry,
+                                (rs, xs, ys, masks, ids, ws))
+
+        # recompiles per (R, n_pad-bucket) pair — both bounded (R is the
+        # caller's chunk size; buckets are O(log2 max batches))
+        self._run_block = jax.jit(run_block, donate_argnums=(0,))
+
+    def _block_inputs(self, r0: int, rounds: int):
+        """Host side of a fused block: draw the R cohorts with the host
+        loop's sampling stream, pack them as one [R, k, n_pad, ...] batch
+        at the block's cohort bucket (one pack_clients call — the native
+        packer parallelizes over all R*k slots)."""
+        api, cfg, ds = self.api, self.api.config, self.api.dataset
+        bsz = cfg.train.batch_size
+        cohorts = [sample_clients(r, self.N, self.k,
+                                  delete_client=api.delete_client)
+                   for r in range(r0, r0 + rounds)]
+        flat = np.concatenate([np.asarray(c) for c in cohorts])
+        n_pad = (max(ds.cohort_padded_len(c, bsz) for c in cohorts)
+                 if cfg.pack == "cohort" else api._n_pad)
+        x, y, mask = ds.pack_clients(flat, bsz, n_pad=n_pad)
+        lead = (rounds, self.k)
+        return (jnp.asarray(x.reshape(lead + x.shape[1:])),
+                jnp.asarray(y.reshape(lead + y.shape[1:])),
+                jnp.asarray(mask.reshape(lead + mask.shape[1:])),
+                jnp.asarray(flat.astype(np.uint32).reshape(lead)),
+                jnp.asarray(ds.client_weights(flat).reshape(lead)))
 
     # -- carry protocol: subclasses fusing richer server state (e.g.
     #    FedOpt's optimizer) override these three -------------------------
@@ -379,26 +428,54 @@ class FusedRounds:
     def run_rounds(self, r0: int, rounds: int):
         """Advance the api's model by ``rounds`` fused rounds starting at
         round index ``r0``; returns stacked per-round stat totals."""
-        carry, stats = self._run(
-            self._init_carry(), *self._data, jnp.uint32(r0), rounds)
+        api = self.api
+        if self.mode == "block":
+            with api.timer.phase("pack"):
+                inputs = self._block_inputs(r0, rounds)
+            with api.timer.phase("dispatch"):
+                carry, stats = self._run_block(
+                    self._init_carry(), *inputs, jnp.uint32(r0))
+        else:
+            with api.timer.phase("dispatch"):
+                carry, stats = self._run(
+                    self._init_carry(), *self._data, jnp.uint32(r0), rounds)
         self._store_carry(carry)
         return stats
 
-    def train(self) -> Dict:
+    def train(self, max_rounds_per_dispatch: Optional[int] = None) -> Dict:
         """The FedAvgAPI.train loop with the scan chunked at eval points:
-        one device dispatch per test interval instead of per round."""
+        one device dispatch per test interval instead of per round.
+
+        Eval cadence matches the host loop exactly — records after rounds
+        0, freq, 2*freq, ..., and the last round (FedAvgAPI.train's
+        ``round_idx % freq == 0 or last``) — so fused and host histories
+        line up round for round. ``max_rounds_per_dispatch`` caps the scan
+        length per device call (the --fused_rounds CLI value); None fuses
+        each full eval interval."""
         api, cfg = self.api, self.api.config
+        if cfg.comm_round <= 0:
+            return api.history[-1] if api.history else {}
+        freq = cfg.frequency_of_the_test
         t0 = time.time()
+        evals = sorted({r for r in range(0, cfg.comm_round, freq)}
+                       | {cfg.comm_round - 1})
         r = 0
-        while r < cfg.comm_round:
-            chunk = min(cfg.frequency_of_the_test, cfg.comm_round - r)
-            stats = self.run_rounds(r, chunk)
-            r += chunk
-            rec = api.evaluate(r - 1)
+        for e in evals:
+            stats = None
+            while r <= e:
+                chunk = e + 1 - r
+                if max_rounds_per_dispatch:
+                    chunk = min(chunk, max_rounds_per_dispatch)
+                stats = self.run_rounds(r, chunk)
+                r += chunk
+            with api.timer.phase("eval"):
+                rec = api.evaluate(r - 1)
             rec["train_loss_local"] = (
                 float(stats["loss_sum"][-1])
                 / max(1.0, float(stats["count"][-1])))
             rec["wall_s"] = time.time() - t0
+            rec.update({f"phase_{k}_ms": v * 1e3
+                        for k, v in api.timer.means().items()})
             api.history.append(rec)
             logging.info("fused round %d: %s", r - 1, rec)
         return api.history[-1] if api.history else {}

@@ -59,7 +59,8 @@ def make_fednova_local_train(module, task: str, cfg: FedNovaConfig):
         n_pad = x.shape[0]
         bsz = tc.batch_size or n_pad
         batch_idx, step_keys = make_batch_schedule(n_pad, tc.epochs, bsz,
-                                                   tc.shuffle, rng)
+                                                   tc.shuffle, rng,
+                                                   mask=mask)
 
         params0 = variables["params"]
         colls0 = {k: v for k, v in variables.items() if k != "params"}

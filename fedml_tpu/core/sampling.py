@@ -16,7 +16,31 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
 import numpy as np
+
+#: sentinel fold indices OUTSIDE the client-id range: client c's training
+#: key is fold_in(round_key, c), so server-side draws use ids no client can
+#: occupy (client ids are int32-positive)
+AGG_KEY_SENTINEL = 2**31 - 1
+DEVICE_SAMPLE_SENTINEL = 2**31 - 2
+
+
+def round_keys(base_key, round_idx, client_ids):
+    """The per-round RNG chain EVERY FedAvg-family driver shares:
+    ``round_key = fold_in(base, round)``, per-client training keys
+    ``fold_in(round_key, client_id)``, and the aggregation key at the
+    ``AGG_KEY_SENTINEL`` fold. One definition — host loop
+    (FedAvgAPI._prepare_round), fused scans (FusedRounds), and mesh scans
+    (make_spmd_multiround) all call it, so host/fused/mesh trajectory
+    parity cannot drift. ``client_ids`` must be uint32 (traced or host).
+
+    Returns ``(round_key, per_client_keys, agg_key)``.
+    """
+    round_key = jax.random.fold_in(base_key, round_idx)
+    keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(client_ids)
+    agg_key = jax.random.fold_in(round_key, AGG_KEY_SENTINEL)
+    return round_key, keys, agg_key
 
 
 def sample_clients(

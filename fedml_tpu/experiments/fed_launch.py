@@ -102,14 +102,17 @@ def _log_history(api, sink, fused_rounds: int = 0):
     warning rather than failing the run."""
     if fused_rounds:
         try:
-            driver = api.fused_rounds(device_sampling=(
-                api.config.client_num_per_round != api.dataset.client_num))
+            # block mode: partial cohorts host-presampled with the host
+            # loop's sampling stream — trajectory-identical to api.train()
+            driver = api.fused_rounds()
         except (AttributeError, TypeError, ValueError) as exc:
             logging.warning("--fused_rounds unsupported for %s (%s); "
                             "using the host loop",
                             type(api).__name__, exc)
         else:
-            final = driver.train()
+            # the flag's value is the dispatch cap: N rounds per device
+            # call, eval cadence unchanged (ADVICE r3)
+            final = driver.train(max_rounds_per_dispatch=fused_rounds)
             for rec in getattr(api, "history", []):
                 sink.log(rec, step=rec.get("round"))
             sink.finish()

@@ -43,22 +43,17 @@ def run_simulation(args, ds, model, task, sink):
                        train=make_train_config(args))
     api = FedAvgAPI(ds, model, task=task, config=cfg)
     if getattr(args, "fused_rounds", 0):
-        # throughput mode: chunks of R rounds per device dispatch
-        # (FusedRounds). Device-side sampling when the cohort is partial —
-        # documented divergence from the host sampler's np.random contract.
+        # throughput mode: up to N rounds per device dispatch
+        # (FusedRounds). Partial cohorts run in block mode — host-presampled
+        # with the host loop's exact sampling stream, packed at the block's
+        # cohort bucket — so the trajectory equals the host loop's.
         if args.checkpoint_dir:
             logging.warning("--checkpoint_dir is not wired for "
                             "--fused_rounds; ignoring")
-        fused = api.fused_rounds(
-            device_sampling=(cfg.client_num_per_round != ds.client_num))
-        r, rec = 0, {}
-        R = args.fused_rounds
-        while r < cfg.comm_round:
-            chunk = min(R, cfg.comm_round - r)
-            fused.run_rounds(r, chunk)
-            r += chunk
-            rec = api.evaluate(r - 1)
-            sink.log(rec, step=r - 1)
+        fused = api.fused_rounds()
+        rec = fused.train(max_rounds_per_dispatch=args.fused_rounds)
+        for hist_rec in api.history:
+            sink.log(hist_rec, step=hist_rec["round"])
         return rec
     mgr = (CheckpointManager(args.checkpoint_dir)
            if args.checkpoint_dir else None)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.sampling import round_keys, sample_clients
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train)
@@ -130,9 +130,7 @@ def make_spmd_multiround(module, task: str, cfg: TrainConfig, mesh: Mesh,
         variables = _pvary(variables, (axis,))
 
         def one_round(vars_r, r):
-            round_key = jax.random.fold_in(base_key, r)
-            keys = jax.vmap(
-                lambda c: jax.random.fold_in(round_key, c))(client_ids)
+            _, keys, _ = round_keys(base_key, r, client_ids)
             stacked, stats = jax.vmap(
                 local_train, in_axes=(None, 0, 0, 0, 0))(vars_r, x, y,
                                                          mask, keys)
@@ -267,6 +265,9 @@ class DistributedFedAvgAPI:
             raise ValueError(f"unknown model_parallel: {mp!r}")
         if self.config.pack not in ("cohort", "global"):
             raise ValueError(f"unknown pack policy: {self.config.pack!r}")
+        from fedml_tpu.trainer.functional import validate_accum_steps
+        validate_accum_steps(self.config.train,
+                             dataset.train_data_local_num_dict)
         if mesh is None and mp:
             devs = jax.devices()
             k = self.config.mp_size
@@ -374,8 +375,8 @@ class DistributedFedAvgAPI:
             if len(idxs) == self.dataset.client_num:
                 self._pack_cache = (self.dataset, cohort,
                                     (padded, xd, yd, maskd, wd))
-        round_key = jax.random.fold_in(self._base_key, round_idx)
-        keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(
+        _, keys, _ = round_keys(
+            self._base_key, round_idx,
             jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
         self.variables, stats = self._round_fn(
             self.variables, xd, yd, maskd, put(keys), wd)

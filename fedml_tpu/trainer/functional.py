@@ -49,6 +49,31 @@ class TrainConfig:
     accum_steps: int = 1
 
 
+def validate_accum_steps(cfg: TrainConfig, client_sizes) -> None:
+    """Host-side accum_steps guard: MultiSteps emits an optimizer update
+    only on every k-th REAL micro-batch (padding-only batches are gated
+    no-ops), so a client whose ``epochs * ceil(n_i / bsz)`` is not a
+    multiple of ``accum_steps`` silently drops its trailing micro-batches
+    (worst case: zero optimizer steps). The real batch count is per-client
+    data the traced trainer cannot see — drivers that know the federation's
+    sizes call this at construction."""
+    if cfg.accum_steps <= 1:
+        return
+    bad = {}
+    for c, n in dict(client_sizes).items():
+        bsz = cfg.batch_size or n
+        real_steps = cfg.epochs * -(-n // bsz)
+        if real_steps % cfg.accum_steps != 0:
+            bad[c] = real_steps
+    if bad:
+        some = dict(list(bad.items())[:5])
+        raise ValueError(
+            f"accum_steps={cfg.accum_steps} must divide every client's "
+            f"epochs*ceil(n_i/batch_size); offending clients (first 5 of "
+            f"{len(bad)}): {some} — trailing real micro-batches would be "
+            "silently dropped")
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """Client optimizer factory, matching the reference's two choices
     (MyModelTrainer.py:26-31): plain SGD, or Adam(amsgrad) with L2-style
@@ -94,23 +119,50 @@ def make_forward(module) -> Callable:
 
 
 def make_batch_schedule(n_pad: int, epochs: int, bsz: int, shuffle: bool,
-                        rng):
+                        rng, mask=None):
     """Shared epochs×batches schedule: per-epoch permutations reshaped to
     [epochs*nb, bsz] index batches plus one dropout key per step. Used by the
     FedAvg local trainer and custom local trainers (FedNova) so shuffle
-    semantics cannot diverge."""
+    semantics cannot diverge.
+
+    The schedule is PADDING-INVARIANT: row ``i``'s sort key is derived from
+    ``fold_in(epoch_key, i)`` alone, and padding rows (``mask == 0``) sort
+    last, so the order restricted to real rows — and therefore the whole
+    trajectory — is identical for every ``n_pad`` the caller packs to. This
+    is what lets cohort-bucket packing, global packing, and fused R-round
+    blocks (one static shape for R cohorts) share one trajectory. It is
+    also the reference's DataLoader semantics: full real batches, then one
+    partial boundary batch, then pure-padding batches that the trainers
+    gate into no-ops (local_train's ``has_real``); the reference shuffles
+    only real samples (torch DataLoader(shuffle=True),
+    MyModelTrainer.py:19-49)."""
     assert n_pad % bsz == 0, "data must be padded to a batch multiple"
     nb = n_pad // bsz
     perm_key, step_key = jax.random.split(rng)
     epoch_keys = jax.random.split(perm_key, epochs)
+    rows = jnp.arange(n_pad)
     if shuffle:
-        perms = jnp.stack(
-            [jax.random.permutation(k, n_pad) for k in epoch_keys])
+        def epoch_perm(k):
+            vals = jax.vmap(
+                lambda i: jax.random.bits(jax.random.fold_in(k, i)))(rows)
+            if mask is not None:
+                # padding last; ties resolve by row index (stable argsort),
+                # and real rows always have lower indices than padding
+                vals = jnp.where(mask > 0, vals, jnp.uint32(0xFFFFFFFF))
+            return jnp.argsort(vals)
+        perms = jax.vmap(epoch_perm)(epoch_keys)
     else:
-        perms = jnp.tile(jnp.arange(n_pad), (epochs, 1))
+        # pack_clients lays real rows first, so the identity order already
+        # has padding last
+        perms = jnp.tile(rows, (epochs, 1))
     batch_idx = perms.reshape(epochs * nb, bsz)
-    step_keys = jax.random.split(step_key, epochs * nb)
-    return batch_idx, step_keys
+    # step (dropout) keys are per (epoch, batch-position): batch b of epoch
+    # e gets the same key at every n_pad, keeping stochastic layers on the
+    # padding-invariant trajectory too
+    step_keys = jax.vmap(
+        lambda ek: jax.vmap(lambda b: jax.random.fold_in(ek, b))(
+            jnp.arange(nb)))(jax.random.split(step_key, epochs))
+    return batch_idx, step_keys.reshape(epochs * nb)
 
 
 def make_local_train(module, task: str, cfg: TrainConfig,
@@ -145,19 +197,14 @@ def make_local_train(module, task: str, cfg: TrainConfig,
     def local_train(variables, x, y, mask, rng):
         n_pad = x.shape[0]
         bsz = cfg.batch_size or n_pad
-        if cfg.accum_steps > 1:
-            total_steps = cfg.epochs * (n_pad // bsz)
-            if total_steps % cfg.accum_steps != 0:
-                # MultiSteps emits updates only on every k-th micro-batch;
-                # a partial tail window would be silently dropped (worst
-                # case: zero optimizer steps in the whole call)
-                raise ValueError(
-                    f"accum_steps={cfg.accum_steps} must divide "
-                    f"epochs*num_batches={total_steps} "
-                    f"(epochs={cfg.epochs}, {n_pad // bsz} batches of "
-                    f"{bsz}); trailing micro-batches would be dropped")
+        # accum_steps divisibility cannot be checked here: only REAL
+        # batches advance MultiSteps (padding-only batches are has_real
+        # no-ops), and the real count is per-client data, not the static
+        # n_pad. Drivers that know client sizes call
+        # validate_accum_steps() host-side instead.
         batch_idx, step_keys = make_batch_schedule(n_pad, cfg.epochs, bsz,
-                                                   cfg.shuffle, rng)
+                                                   cfg.shuffle, rng,
+                                                   mask=mask)
         params = variables["params"]
         opt_state = tx.init(params)
         init = (params, {k: v for k, v in variables.items() if k != "params"},

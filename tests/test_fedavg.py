@@ -254,22 +254,37 @@ class TestGradAccumulation:
 
     def test_partial_accumulation_window_rejected(self):
         """A tail window MultiSteps would silently drop (worst case: zero
-        optimizer steps) is a hard error, not a no-op."""
+        optimizer steps) is a hard error at API construction — checked
+        against each client's REAL batch count (padding-only batches never
+        advance MultiSteps), so the guard is packing-policy-invariant."""
         import pytest
 
         from fedml_tpu.models.lr import LogisticRegression
         from fedml_tpu.trainer.functional import (TrainConfig,
-                                                  make_local_train)
+                                                  validate_accum_steps)
 
-        model = LogisticRegression(num_classes=4)
-        x = np.zeros((32, 12), np.float32)
-        variables = model.init(jax.random.key(0), jnp.asarray(x[:1]))
-        cfg = TrainConfig(epochs=1, batch_size=None, accum_steps=2)
-        lt = make_local_train(model, "classification", cfg)
+        # full-batch client: 1 real step/epoch, accum 2 never completes
         with pytest.raises(ValueError, match="accum_steps"):
-            lt(variables, jnp.asarray(x),
-               jnp.zeros(32, jnp.int32), jnp.ones(32, jnp.float32),
-               jax.random.key(1))
+            validate_accum_steps(
+                TrainConfig(epochs=1, batch_size=None, accum_steps=2),
+                {0: 32})
+        # 3 real batches of 16 with accum 2 drops the tail micro-batch —
+        # regardless of how far the 48 samples are padded
+        with pytest.raises(ValueError, match="accum_steps"):
+            validate_accum_steps(
+                TrainConfig(epochs=1, batch_size=16, accum_steps=2),
+                {0: 48})
+        # and the guard fires from API construction
+        ds = make_blob_federated(client_num=3, seed=0, n_samples=100)
+        with pytest.raises(ValueError, match="accum_steps"):
+            FedAvgAPI(ds, LogisticRegression(num_classes=ds.class_num),
+                      config=FedAvgConfig(
+                          client_num_per_round=3,
+                          train=TrainConfig(epochs=1, batch_size=16,
+                                            accum_steps=7)))
+        # a feasible config passes
+        validate_accum_steps(
+            TrainConfig(epochs=2, batch_size=16, accum_steps=2), {0: 64})
 
 
 class TestNoRetracing:

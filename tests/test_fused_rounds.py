@@ -2,9 +2,12 @@
 
 Contract points: (1) full-participation fusion reproduces the host loop's
 trajectory (the in-scan fold_in chain equals FedAvgAPI._prepare_round's),
-(2) the chunked train() loop learns and records history, (3) device-side
-sampling trains a sampled cohort per scanned round with zero host work,
-(4) the sampled mode must be requested explicitly.
+(2) the chunked train() loop learns, records history, and matches the host
+loop's eval cadence, (3) partial cohorts default to BLOCK mode —
+host-presampled R-cohort blocks packed at the block's cohort bucket,
+trajectory-identical to the host loop, (4) device-side sampling
+(jax-native stream, full federation resident) is the explicit opt-in
+alternative for when per-block host packing is the bottleneck.
 """
 
 import jax
@@ -57,8 +60,39 @@ class TestFusedFullParticipation:
         api = _api(ds, comm_round=12, frequency_of_the_test=4)
         final = FusedRounds(api).train()
         assert final["test_acc"] > 0.9, final
-        assert len(api.history) == 3
+        # eval cadence matches the host loop: after rounds 0, 4, 8, 11
+        assert [rec["round"] for rec in api.history] == [0, 4, 8, 11]
         assert np.isfinite(final["train_loss_local"])
+
+    def test_eval_cadence_matches_host_loop(self):
+        # same records at the same round indices as FedAvgAPI.train()
+        ds = make_blob_federated(client_num=4, seed=2)
+        host = _api(ds, client_num_per_round=4, comm_round=7,
+                    frequency_of_the_test=3)
+        fused_api = _api(ds, client_num_per_round=4, comm_round=7,
+                         frequency_of_the_test=3)
+        host.train()
+        FusedRounds(fused_api).train()
+        h = [rec["round"] for rec in host.history]
+        f = [rec["round"] for rec in fused_api.history]
+        assert h == f == [0, 3, 6]
+        for hr, fr in zip(host.history, fused_api.history):
+            assert abs(hr["test_acc"] - fr["test_acc"]) < 1e-6
+
+    def test_max_rounds_per_dispatch_caps_scan(self):
+        # the --fused_rounds value bounds the per-dispatch chunk without
+        # changing the trajectory or the eval schedule (ADVICE r3)
+        ds = make_blob_federated(client_num=4, seed=12)
+        a = _api(ds, client_num_per_round=4, comm_round=9,
+                 frequency_of_the_test=4)
+        b = _api(ds, client_num_per_round=4, comm_round=9,
+                 frequency_of_the_test=4)
+        FusedRounds(a).train()
+        FusedRounds(b).train(max_rounds_per_dispatch=2)
+        assert ([r["round"] for r in a.history]
+                == [r["round"] for r in b.history])
+        diff = float(pt.tree_norm(pt.tree_sub(a.variables, b.variables)))
+        assert diff < 1e-6, diff
 
     def test_stats_stacked_per_round(self):
         ds = make_blob_federated(client_num=4, seed=3)
@@ -194,17 +228,100 @@ class TestMeshFusedRounds:
         assert diff < 1e-6, diff
 
 
-class TestFusedDeviceSampling:
-    def test_partial_requires_explicit_mode(self):
-        ds = make_blob_federated(client_num=12, seed=4)
-        api = _api(ds, client_num_per_round=4)
-        try:
-            FusedRounds(api)
-        except ValueError as e:
-            assert "device_sampling" in str(e)
-        else:
-            raise AssertionError("partial cohort accepted without opt-in")
+class TestFusedBlockSampling:
+    """Block mode (default for partial cohorts): host-presampled R-cohort
+    blocks packed at the block's cohort bucket — BOTH throughput levers in
+    one dispatch, trajectory-identical to the host loop (VERDICT r3 #1)."""
 
+    def test_block_matches_host_loop_trajectory(self):
+        # 4-of-12 sampling: same cohorts (sample_clients stream), same
+        # fold_in chain, bucketed block padding => same trajectory
+        ds = make_blob_federated(client_num=12, partition_method="hetero",
+                                 seed=4)
+        host = _api(ds, client_num_per_round=4, comm_round=8)
+        fused_api = _api(ds, client_num_per_round=4, comm_round=8)
+        fused = FusedRounds(fused_api)
+        assert fused.mode == "block"
+        for r in range(8):
+            host.run_round(r)
+        stats = fused.run_rounds(0, 8)
+        assert stats["loss_sum"].shape == (8,)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused_api.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+
+    def test_block_resume_mid_stream(self):
+        # two blocks of 3 == one block of 6 (cohorts derive from the
+        # absolute round index, not the block offset)
+        ds = make_blob_federated(client_num=10, seed=13)
+        a = _api(ds, client_num_per_round=3)
+        b = _api(ds, client_num_per_round=3)
+        FusedRounds(a).run_rounds(0, 6)
+        fb = FusedRounds(b)
+        fb.run_rounds(0, 3)
+        fb.run_rounds(3, 3)
+        diff = float(pt.tree_norm(pt.tree_sub(a.variables, b.variables)))
+        assert diff < 1e-6, diff
+
+    def test_block_honors_delete_client(self):
+        # leave-one-out runs fused now: sampling is host-side in block mode
+        ds = make_blob_federated(client_num=8, seed=14)
+        model = LogisticRegression(num_classes=ds.class_num)
+        kw = dict(comm_round=5, client_num_per_round=4,
+                  frequency_of_the_test=100,
+                  train=TrainConfig(epochs=1, batch_size=16, lr=0.1))
+        host = FedAvgAPI(ds, model, delete_client=2,
+                         config=FedAvgConfig(**kw))
+        fused_api = FedAvgAPI(ds, model, delete_client=2,
+                              config=FedAvgConfig(**kw))
+        for r in range(5):
+            host.run_round(r)
+        fused_api.fused_rounds().run_rounds(0, 5)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused_api.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+
+    def test_block_fedopt_matches_host(self):
+        # richer server state (Adam moments) advances in-scan under block
+        # sampling too — the carry protocol composes with the new mode
+        from fedml_tpu.algorithms.fedopt import FedOptAPI, FedOptConfig
+        ds = make_blob_federated(client_num=10, partition_method="hetero",
+                                 seed=15)
+        model = LogisticRegression(num_classes=ds.class_num)
+        kw = dict(comm_round=6, client_num_per_round=4,
+                  frequency_of_the_test=100, server_optimizer="adam",
+                  server_lr=0.01,
+                  train=TrainConfig(epochs=1, batch_size=16, lr=0.1))
+        host = FedOptAPI(ds, model, config=FedOptConfig(**kw))
+        fused_api = FedOptAPI(ds, model, config=FedOptConfig(**kw))
+        for r in range(6):
+            host.run_round(r)
+        fused_api.fused_rounds().run_rounds(0, 6)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused_api.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+        opt_diff = jax.tree.map(
+            lambda a, b: float(np.max(np.abs(np.asarray(a)
+                                             - np.asarray(b)))),
+            host.server_opt_state, fused_api.server_opt_state)
+        assert max(jax.tree.leaves(opt_diff)) < 1e-6, opt_diff
+
+    def test_block_respects_global_pack_policy(self):
+        # pack="global" blocks pad to the dataset max and still match
+        ds = make_blob_federated(client_num=10, partition_method="hetero",
+                                 seed=16)
+        a = _api(ds, client_num_per_round=4, pack="global")
+        b = _api(ds, client_num_per_round=4, pack="cohort")
+        FusedRounds(a).run_rounds(0, 4)
+        FusedRounds(b).run_rounds(0, 4)
+        diff = float(pt.tree_norm(pt.tree_sub(a.variables, b.variables)))
+        assert diff < 1e-6, diff  # padding policy never changes the math
+
+
+class TestFusedDeviceSampling:
     def test_delete_client_rejected(self):
         # leave-one-out semantics can't be honored in-scan; must refuse
         from fedml_tpu.models.lr import LogisticRegression as LR
