@@ -276,6 +276,8 @@ class FedAvgClientManager(ClientManager):
                  compress: bool = False):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
+        from fedml_tpu.trainer.functional import validate_accum_steps
+        validate_accum_steps(train_cfg, dataset.train_data_local_num_dict)
         self._local_train = jax.jit(make_local_train(module, task, train_cfg))
         self._n_pad = dataset.padded_len(train_cfg.batch_size)
         self._bsz = train_cfg.batch_size

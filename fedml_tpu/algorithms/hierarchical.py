@@ -66,6 +66,8 @@ class HierarchicalFedAvgAPI:
                                                dataset.client_num)
 
         from fedml_tpu.algorithms.fedavg import make_vmapped_body
+        from fedml_tpu.trainer.functional import validate_accum_steps
+        validate_accum_steps(cfg.train, dataset.train_data_local_num_dict)
         body = make_vmapped_body(make_local_train(module, task, cfg.train))
 
         def round_fn(variables, x, y, mask, keys, weights):

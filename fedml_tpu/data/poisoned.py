@@ -76,7 +76,12 @@ def load_edge_case_artifact(path: str, target_label: int = 9
     data = targets = None
     if path.endswith((".pt", ".pth")):
         import torch
-        obj = torch.load(path, map_location="cpu", weights_only=False)
+        try:
+            # safe deserialization first; reference artifacts that pickle
+            # whole Dataset objects need the legacy (code-executing) path
+            obj = torch.load(path, map_location="cpu", weights_only=True)
+        except Exception:  # noqa: BLE001 — any unpickling error
+            obj = torch.load(path, map_location="cpu", weights_only=False)
         if isinstance(obj, (tuple, list)) and len(obj) == 2:
             data, targets = obj
         else:

@@ -147,6 +147,18 @@ def _imagenet_pack(data_dir, **kw):
         partition_alpha=kw.get("partition_alpha", 0.5))
 
 
+def _femnist_gen(data_dir, **kw):
+    from fedml_tpu.data.flagship_gen import build_femnist_federation
+    return build_femnist_federation(
+        client_num=kw.get("client_num_in_total", 3400))
+
+
+def _fed_cifar100_gen(data_dir, **kw):
+    from fedml_tpu.data.flagship_gen import build_fedcifar100_federation
+    return build_fedcifar100_federation(
+        client_num=kw.get("client_num_in_total", 500))
+
+
 def _landmarks(data_dir, **kw):
     from fedml_tpu.data.images import load_partition_data_landmarks
     return load_partition_data_landmarks(
@@ -178,6 +190,10 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "ILSVRC2012_pack": _imagenet_pack,  # preconverted npz/h5 array pack
     "gld23k": _landmarks,
     "gld160k": _landmarks,
+    # reference-scale generated flagships (zero-egress stand-ins with the
+    # loaders' exact shape facts and calibrated accuracy ceilings)
+    "femnist_gen": _femnist_gen,          # 3400 clients, 62c, ceil 84.9%
+    "fed_cifar100_gen": _fed_cifar100_gen,  # 500 clients, 100c, ceil 44.7%
 }
 
 # reference --dataset name -> (model factory name, task head)
@@ -198,6 +214,16 @@ DEFAULT_MODEL_AND_TASK = {
     "seg_shapes": ("segnet", "segmentation"),
     "img_blob": ("resnet56", "classification"),
     "token_blob": ("transformer", "nwp"),
+    # large image federations pair with the reference's efficient-conv
+    # models (main_fedavg.py:229-266; its argparse default is mobilenet),
+    # not the silent lr fallback
+    "ILSVRC2012": ("mobilenet", "classification"),
+    "ILSVRC2012_hdf5": ("mobilenet", "classification"),
+    "ILSVRC2012_pack": ("mobilenet", "classification"),
+    "gld23k": ("efficientnet-b0", "classification"),
+    "gld160k": ("efficientnet-b0", "classification"),
+    "femnist_gen": ("cnn", "classification"),
+    "fed_cifar100_gen": ("resnet18_gn", "classification"),
 }
 
 

@@ -87,6 +87,11 @@ def build_dataset_and_model(args):
                    partition_method=args.partition_method,
                    partition_alpha=args.partition_alpha,
                    client_num_in_total=args.client_num_in_total)
+    if args.dataset not in DEFAULT_MODEL_AND_TASK and not args.model:
+        import logging
+        logging.warning("no reference model pairing for dataset %r; "
+                        "defaulting to lr (pass --model to override)",
+                        args.dataset)
     model_name, task = DEFAULT_MODEL_AND_TASK.get(
         args.dataset, ("lr", "classification"))
     if args.model:

@@ -22,7 +22,7 @@ from fedml_tpu.utils.metrics import MetricsSink
 ALGOS = ["fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
          "decentralized", "centralized", "fednas", "fedgkt",
          "turboaggregate", "fedseg", "split_nn", "vertical_fl",
-         "contribution"]
+         "contribution", "fedavg_async"]
 
 
 def add_algo_args(parser: argparse.ArgumentParser):
@@ -51,10 +51,13 @@ def add_algo_args(parser: argparse.ArgumentParser):
     # and accuracy on the edge test set is reported as backdoor_asr
     parser.add_argument("--poison_pkl", type=str, default=None,
                         help="reference-format poisoned train artifact "
-                             "(.pkl southwest stack or .pt torch dataset)")
+                             "(.pkl southwest stack or .pt torch dataset). "
+                             "TRUSTED PATHS ONLY: pickle/legacy torch.load "
+                             "execute arbitrary code from the file")
     parser.add_argument("--poison_test_pkl", type=str, default=None,
                         help="edge-case test artifact for the attack-"
-                             "success-rate metric")
+                             "success-rate metric (same trust caveat as "
+                             "--poison_pkl)")
     parser.add_argument("--attacker_client", type=int, default=0)
     parser.add_argument("--target_label", type=int, default=9)
     parser.add_argument("--poison_num_edge", type=int, default=100)
@@ -92,6 +95,20 @@ def add_algo_args(parser: argparse.ArgumentParser):
     # fedseg (reference SegmentationLosses / LR_Scheduler knobs)
     parser.add_argument("--seg_loss", type=str, default="ce",
                         choices=["ce", "focal"])
+    # fedavg_async (straggler tolerance — beyond the reference, whose
+    # server hard-blocks on the all-received barrier)
+    parser.add_argument("--async_mode", type=str, default="quorum",
+                        choices=["quorum", "fedasync"],
+                        help="quorum: close rounds at (all | deadline & "
+                             "quorum); fedasync: merge every update with "
+                             "a staleness-decayed weight")
+    parser.add_argument("--quorum", type=int, default=1)
+    parser.add_argument("--round_deadline_s", type=float, default=10.0)
+    parser.add_argument("--async_alpha", type=float, default=0.6)
+    parser.add_argument("--async_poly_a", type=float, default=0.5)
+    parser.add_argument("--max_updates", type=int, default=20,
+                        help="fedasync: total update budget (the async "
+                             "analogue of --comm_round)")
 
 
 def _log_history(api, sink, fused_rounds: int = 0):
@@ -402,6 +419,32 @@ def run_algo(args):
                             [x_test[:, c] for c in cuts], y_test)
         for rec in fixture.history:
             sink.log(rec, step=rec["epoch"])
+        sink.finish()
+        logging.info("final: %s", final)
+        return final
+    elif args.algo == "fedavg_async":
+        import numpy as np
+        from fedml_tpu.algorithms.fedavg_async import run_fedavg_async
+        _, history, server = run_fedavg_async(
+            ds, model, task=task,
+            worker_num=args.client_num_per_round, mode=args.async_mode,
+            comm_round=args.comm_round, quorum=args.quorum,
+            round_deadline_s=args.round_deadline_s,
+            alpha=args.async_alpha, poly_a=args.async_poly_a,
+            max_updates=args.max_updates, train_cfg=tcfg, seed=args.seed)
+        for rec in history:
+            sink.log(rec, step=rec["round"])
+        final = dict(history[-1]) if history else {}
+        if args.async_mode == "quorum":
+            final["partial_rounds"] = list(server.partial_rounds)
+        else:
+            final["updates"] = len(server.update_log)
+            final["mean_staleness"] = (
+                float(np.mean([u["staleness"]
+                               for u in server.update_log]))
+                if server.update_log else 0.0)
+        sink.log({k: v for k, v in final.items()
+                  if not isinstance(v, list)})
         sink.finish()
         logging.info("final: %s", final)
         return final

@@ -88,6 +88,20 @@ def run_spmd(args, ds, model, task, sink):
         mp_size=getattr(args, "mp_size", 1),
         train=make_train_config(args))
     api = DistributedFedAvgAPI(ds, model, task=task, config=cfg)
+    if getattr(args, "fused_rounds", 0) and cfg.model_parallel:
+        logging.warning("--fused_rounds supports the flat 'clients' mesh "
+                        "only; --model_parallel run uses the per-round "
+                        "host loop")
+    if getattr(args, "fused_rounds", 0) and not cfg.model_parallel:
+        # throughput mode on the mesh: sampled cohorts run as host-drawn
+        # fused blocks, full participation as federation-resident scans
+        if args.checkpoint_dir:
+            logging.warning("--checkpoint_dir is not wired for "
+                            "--fused_rounds; ignoring")
+        final = api.train_fused(max_rounds_per_dispatch=args.fused_rounds)
+        for rec in api.history:
+            sink.log(rec, step=rec["round"])
+        return final
     mgr = (CheckpointManager(args.checkpoint_dir)
            if args.checkpoint_dir else None)
     final = api.train(checkpoint_mgr=mgr, resume=args.resume)

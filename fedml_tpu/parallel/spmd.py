@@ -159,6 +159,59 @@ def make_spmd_multiround(module, task: str, cfg: TrainConfig, mesh: Mesh,
     ), donate_argnums=(0,) if donate else ())
 
 
+def make_spmd_block_multiround(module, task: str, cfg: TrainConfig,
+                               mesh: Mesh, axis: str = "clients",
+                               donate: bool = True):
+    """R SAMPLED-cohort FedAvg rounds as ONE jitted shard_map program.
+
+    The mesh analogue of ``algorithms.fedavg.FusedRounds`` block mode: the
+    host draws the R cohorts up front with the reference sampling stream
+    (FedAVGAggregator.py:89-97 np.random contract), packs them as one
+    ``[R, P, n_pad, ...]`` block (P = cohort size padded to a mesh
+    multiple), and this program scans the R rounds with the weighted
+    ``psum`` aggregation inside the scan body — composing cohort-bucket
+    packing with multi-round fusion on the slice, which
+    ``make_spmd_multiround`` (full participation, federation-resident)
+    cannot do for sampled regimes.
+
+    Returns ``fn(variables, xs, ys, masks, idsR, weightsR, base_key, r0)
+    -> (new_variables, stats[R])`` with the block arrays ``[R, P, ...]``
+    sharded over ``axis`` on dim 1 and ``idsR`` the uint32 global client
+    ids per round (key derivation via the shared fold_in chain,
+    core/sampling.round_keys — trajectory parity with R ``run_round``
+    calls is exact).
+    """
+    local_train = make_local_train(module, task, cfg)
+
+    def body(variables, xs, ys, masks, idsR, weightsR, base_key, r0):
+        variables = _pvary(variables, (axis,))
+
+        def one_round(vars_r, inp):
+            r, x, y, mask, ids, weights = inp
+            _, keys, _ = round_keys(base_key, r, ids)
+            stacked, stats = jax.vmap(
+                local_train, in_axes=(None, 0, 0, 0, 0))(vars_r, x, y,
+                                                         mask, keys)
+            new_vars = _weighted_psum_mean(stacked, weights, (axis,))
+            totals = jax.tree.map(
+                lambda s: jax.lax.psum(jnp.sum(s, axis=0), axis), stats)
+            return _pvary(new_vars, (axis,)), totals
+
+        rs = r0 + jnp.arange(xs.shape[0], dtype=jnp.uint32)
+        new_vars, stats = jax.lax.scan(one_round, variables,
+                                       (rs, xs, ys, masks, idsR, weightsR))
+        new_vars = jax.tree.map(lambda v: jax.lax.pmean(v, axis), new_vars)
+        return new_vars, stats
+
+    blocked = P(None, axis)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), blocked, blocked, blocked, blocked, blocked, P(),
+                  P()),
+        out_specs=(P(), P()),
+    ), donate_argnums=(0,) if donate else ())
+
+
 def make_sharded_eval(module, task: str, mesh: Mesh, axis="clients"):
     """Evaluation sharded over the mesh: each device scores its slice of
     the eval union, stat sums meet in one psum. The multi-chip analogue of
@@ -383,21 +436,24 @@ class DistributedFedAvgAPI:
         return idxs, stats
 
     def run_rounds_fused(self, r0: int, rounds: int):
-        """Advance the model by ``rounds`` full-participation rounds in ONE
-        device dispatch (make_spmd_multiround): data packed and uploaded
-        once, per-round keys derived in-scan, host synced once. Returns
-        stacked per-round stats. The throughput counterpart of run_round
-        for slices; partial-participation sampling stays on the host loop
-        (its np.random parity contract can't be honored in-scan)."""
+        """Advance the model by ``rounds`` rounds in ONE device dispatch.
+
+        Full participation (``client_num_per_round == client_num``): the
+        federation is packed and uploaded once, resident across calls, and
+        per-round keys derive in-scan (make_spmd_multiround). Sampled
+        cohorts: the R cohorts are drawn host-side with the host loop's
+        exact sampling stream, packed as one ``[R, P, n_pad, ...]`` block
+        at the block's cohort bucket, and scanned in one dispatch
+        (make_spmd_block_multiround) — both throughput levers at once,
+        trajectory-identical to R ``run_round`` calls. Returns stacked
+        per-round stats."""
         cfg = self.config
         N = self.dataset.client_num
-        if cfg.client_num_per_round != N:
-            raise ValueError(
-                "fused mesh rounds require full participation "
-                f"(got {cfg.client_num_per_round}/{N})")
         if cfg.model_parallel:
             raise ValueError(
                 "fused mesh rounds support the flat 'clients' mesh only")
+        if cfg.client_num_per_round != N:
+            return self._run_block_fused(r0, rounds)
         if (getattr(self, "_fused_data", None) is None
                 or self._fused_data[0] is not self.dataset):
             padded, alive = self._pad_round(np.arange(N))
@@ -422,6 +478,74 @@ class DistributedFedAvgAPI:
             self.variables, *self._fused_data[1], self._base_key,
             jnp.uint32(r0))
         return stats
+
+    def _run_block_fused(self, r0: int, rounds: int):
+        """Sampled-cohort fused block on the mesh: host-drawn cohorts,
+        one [R, P, n_pad, ...] sharded upload, one scan dispatch."""
+        cfg = self.config
+        bsz = cfg.train.batch_size
+        ds = self.dataset
+        cohorts = [sample_clients(r, ds.client_num,
+                                  cfg.client_num_per_round)
+                   for r in range(r0, r0 + rounds)]
+        padded_alive = [self._pad_round(np.asarray(c)) for c in cohorts]
+        flat = np.concatenate([p for p, _ in padded_alive])
+        alive = np.concatenate([a for _, a in padded_alive])
+        n_pad = (max(ds.cohort_padded_len(c, bsz) for c in cohorts)
+                 if cfg.pack == "cohort" else self._n_pad)
+        x, y, mask = ds.pack_clients(flat, bsz, n_pad=n_pad)
+        mask = mask * alive[:, None]
+        weights = ds.client_weights(flat) * alive
+        P_pad = len(padded_alive[0][0])  # cohort size padded to the mesh
+        lead = (rounds, P_pad)
+        put = lambda a: jax.device_put(
+            jnp.asarray(a), NamedSharding(self.mesh, P(None, "clients")))
+        args = (put(x.reshape(lead + x.shape[1:])),
+                put(y.reshape(lead + y.shape[1:])),
+                put(mask.reshape(lead + mask.shape[1:])),
+                put(flat.astype(np.uint32).reshape(lead)),
+                put(weights.reshape(lead)))
+        if getattr(self, "_block_fn", None) is None:
+            # one jitted program; jit's own shape-keyed trace cache
+            # specializes per (R, P_pad, n_pad) block shape
+            self._block_fn = make_spmd_block_multiround(
+                self.module, self.task, cfg.train, self.mesh)
+        self.variables, stats = self._block_fn(
+            self.variables, *args, self._base_key, jnp.uint32(r0))
+        return stats
+
+    def train_fused(self, max_rounds_per_dispatch: Optional[int] = None
+                    ) -> Dict:
+        """The round loop with fused dispatches: one device call per eval
+        interval (capped at ``max_rounds_per_dispatch``), eval after rounds
+        0, freq, 2*freq, ..., and the last round — the same cadence as
+        ``train()``, so fused and host histories line up (the mesh analogue
+        of FusedRounds.train)."""
+        from fedml_tpu.algorithms.fedavg import _normalized
+        cfg = self.config
+        if cfg.comm_round <= 0:
+            return self.history[-1] if self.history else {}
+        freq = cfg.frequency_of_the_test
+        evals = sorted({r for r in range(0, cfg.comm_round, freq)}
+                       | {cfg.comm_round - 1})
+        r = 0
+        for e in evals:
+            stats = None
+            while r <= e:
+                chunk = e + 1 - r
+                if max_rounds_per_dispatch:
+                    chunk = min(chunk, max_rounds_per_dispatch)
+                stats = self.run_rounds_fused(r, chunk)
+                r += chunk
+            rec = {"round": r - 1,
+                   "train_loss_local": (
+                       float(stats["loss_sum"][-1])
+                       / max(1.0, float(stats["count"][-1])))}
+            test_stats = self._eval_global()
+            if test_stats is not None:
+                rec.update(_normalized(test_stats, "test"))
+            self.history.append(rec)
+        return self.history[-1] if self.history else {}
 
     def train(self, checkpoint_mgr=None, resume: bool = False) -> Dict:
         """Round loop with optional round-level checkpoint/resume: client

@@ -55,6 +55,11 @@ class FlaxModelTrainer(ModelTrainer):
         currently installed params and returns summed train stats."""
         x, y, mask = _with_mask(train_data)
         bsz = self.cfg.batch_size or x.shape[0]
+        if self.cfg.accum_steps > 1:
+            # per-call guard: this trainer sees one client's real length
+            # only here (validate_accum_steps semantics, one client)
+            from fedml_tpu.trainer.functional import validate_accum_steps
+            validate_accum_steps(self.cfg, {0: len(x)})
         x, y, mask = _pad_to_multiple(x, y, mask, bsz)
         self._rng, sub = jax.random.split(self._rng)
         self._variables, stats = self._train_fn(

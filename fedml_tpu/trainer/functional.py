@@ -62,7 +62,9 @@ def validate_accum_steps(cfg: TrainConfig, client_sizes) -> None:
     bad = {}
     for c, n in dict(client_sizes).items():
         bsz = cfg.batch_size or n
-        real_steps = cfg.epochs * -(-n // bsz)
+        # an empty client has zero real batches -> zero optimizer steps,
+        # which accum_steps>1 cannot fix; flag it rather than divide by 0
+        real_steps = cfg.epochs * -(-n // bsz) if bsz else 0
         if real_steps % cfg.accum_steps != 0:
             bad[c] = real_steps
     if bad:

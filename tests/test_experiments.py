@@ -36,6 +36,20 @@ class TestFedAvgMain:
         assert abs(fused["test_acc"] - plain["test_acc"]) < 1e-6
         assert abs(fused["test_loss"] - plain["test_loss"]) < 1e-5
 
+    def test_spmd_fused_rounds_flag(self, tmp_path):
+        # --fused_rounds on the mesh backend: sampled cohorts run as
+        # host-drawn fused blocks, same history as the per-round mesh loop
+        common = ["--dataset", "blob", "--client_num_in_total", "8",
+                  "--client_num_per_round", "4", "--comm_round", "4",
+                  "--batch_size", "8", "--lr", "0.1",
+                  "--frequency_of_the_test", "3", "--backend", "spmd"]
+        plain = main_fedavg.main(
+            common + ["--run_dir", str(tmp_path / "plain")])
+        fused = main_fedavg.main(
+            common + ["--fused_rounds", "2",
+                      "--run_dir", str(tmp_path / "fused")])
+        assert abs(fused["test_acc"] - plain["test_acc"]) < 1e-6
+
     def test_spmd_backend(self, tmp_path):
         final = main_fedavg.main([
             "--dataset", "blob", "--client_num_in_total", "8",
@@ -155,6 +169,27 @@ class TestFedLaunch:
         assert len(final["influence"]) == 4
         assert all(np.isfinite(v) and v >= 0 for v in final["influence"])
         assert sorted(final["ranked"]) == [0, 1, 2, 3]
+
+    def test_fedavg_async_quorum(self, tmp_path):
+        # straggler-tolerant federation through the CLI: quorum rounds on
+        # the in-proc actor protocol (VERDICT r3 #8)
+        final = fed_launch.main(self._common(tmp_path, "fedavg_async") +
+                                ["--async_mode", "quorum", "--quorum", "2",
+                                 "--round_deadline_s", "30"])
+        assert final["test_acc"] > 0.5
+        assert "partial_rounds" in final
+        summary = json.load(
+            open(tmp_path / "fedavg_async" / "wandb-summary.json"))
+        assert "test_acc" in summary
+
+    def test_fedavg_async_fedasync(self, tmp_path):
+        final = fed_launch.main(self._common(tmp_path, "fedavg_async") +
+                                ["--async_mode", "fedasync",
+                                 "--max_updates", "6",
+                                 "--async_alpha", "0.5"])
+        assert final["updates"] == 6
+        assert final["test_acc"] > 0.5
+        assert final["mean_staleness"] >= 0.0
 
     def test_unknown_algo_rejected_by_argparse(self, tmp_path):
         import pytest

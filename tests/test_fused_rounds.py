@@ -190,24 +190,115 @@ class TestMeshFusedRounds:
         den = float(pt.tree_norm(host.variables))
         assert num / den < 1e-6, (num, den)
 
-    def test_fused_mesh_rejects_partial_and_mp(self):
+    def test_fused_mesh_sampled_block_matches_host_loop(self):
+        """Sampled cohorts on the mesh run as host-drawn fused blocks
+        (VERDICT r3 #2): 4-of-12 at 8 devices — cohorts pad to the mesh
+        multiple with zero-weight slots, block packs at the cohort bucket,
+        trajectory equals R run_round calls."""
         from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
                                              DistributedFedAvgConfig,
                                              build_mesh)
         mesh = build_mesh({"clients": 8})
-        ds = make_blob_federated(client_num=8, seed=7)
+        ds = make_blob_federated(client_num=12, partition_method="hetero",
+                                 seed=7)
+        model = LogisticRegression(num_classes=ds.class_num)
+        cfg = DistributedFedAvgConfig(
+            comm_round=6, client_num_per_round=4,
+            train=TrainConfig(epochs=2, batch_size=16, lr=0.1))
+        host = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        fused = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        for r in range(6):
+            host.run_round(r)
+        stats = fused.run_rounds_fused(0, 6)
+        assert stats["loss_sum"].shape == (6,)
+        num = float(pt.tree_norm(pt.tree_sub(host.variables,
+                                             fused.variables)))
+        den = float(pt.tree_norm(host.variables))
+        assert num / den < 1e-6, (num, den)
+
+    def test_fused_mesh_sampled_matches_sim_block(self):
+        # the mesh block and the sim block are the same trajectory: the
+        # sim==mesh invariant survives fusion in the sampled regime
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig,
+                                             build_mesh)
+        mesh = build_mesh({"clients": 4})
+        ds = make_blob_federated(client_num=10, partition_method="hetero",
+                                 seed=17)
+        model = LogisticRegression(num_classes=ds.class_num)
+        tcfg = TrainConfig(epochs=1, batch_size=16, lr=0.1)
+        sim = _api(ds, client_num_per_round=4, train=tcfg)
+        mesh_api = DistributedFedAvgAPI(
+            ds, model, mesh=mesh, config=DistributedFedAvgConfig(
+                client_num_per_round=4, train=tcfg))
+        FusedRounds(sim).run_rounds(0, 5)
+        mesh_api.run_rounds_fused(0, 5)
+        num = float(pt.tree_norm(pt.tree_sub(sim.variables,
+                                             mesh_api.variables)))
+        den = float(pt.tree_norm(sim.variables))
+        assert num / den < 1e-6, (num, den)
+
+    def test_fused_mesh_sampled_resume_mid_stream(self):
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig,
+                                             build_mesh)
+        mesh = build_mesh({"clients": 4})
+        ds = make_blob_federated(client_num=9, seed=18)
+        model = LogisticRegression(num_classes=ds.class_num)
+        cfg = DistributedFedAvgConfig(
+            client_num_per_round=3,
+            train=TrainConfig(epochs=1, batch_size=16, lr=0.1))
+        a = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        b = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        a.run_rounds_fused(0, 6)
+        b.run_rounds_fused(0, 3)
+        b.run_rounds_fused(3, 3)
+        diff = float(pt.tree_norm(pt.tree_sub(a.variables, b.variables)))
+        assert diff < 1e-6, diff
+
+    def test_train_fused_matches_train_cadence(self):
+        # api.train_fused produces the same history rounds and accuracies
+        # as api.train (sampled regime included)
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig,
+                                             build_mesh)
+        mesh = build_mesh({"clients": 4})
+        ds = make_blob_federated(client_num=8, seed=19)
+        model = LogisticRegression(num_classes=ds.class_num)
+        cfg = DistributedFedAvgConfig(
+            comm_round=7, client_num_per_round=4,
+            frequency_of_the_test=3,
+            train=TrainConfig(epochs=1, batch_size=16, lr=0.1))
+        host = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        fused = DistributedFedAvgAPI(ds, model, mesh=mesh, config=cfg)
+        host.train()
+        fused.train_fused(max_rounds_per_dispatch=2)
+        h = [rec["round"] for rec in host.history]
+        f = [rec["round"] for rec in fused.history]
+        assert h == f == [0, 3, 6]
+        for hr, fr in zip(host.history, fused.history):
+            assert abs(hr["test_acc"] - fr["test_acc"]) < 1e-6
+
+    def test_fused_mesh_rejects_mp(self):
+        from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
+                                             DistributedFedAvgConfig)
+        import jax
+        from jax.sharding import Mesh
+        devs = np.asarray(jax.devices()[:2]).reshape(1, 2)
+        mesh = Mesh(devs, ("clients", "fsdp"))
+        ds = make_blob_federated(client_num=4, seed=7)
         model = LogisticRegression(num_classes=ds.class_num)
         api = DistributedFedAvgAPI(
             ds, model, mesh=mesh,
             config=DistributedFedAvgConfig(
-                client_num_per_round=4,
+                client_num_per_round=4, model_parallel="fsdp", mp_size=2,
                 train=TrainConfig(epochs=1, batch_size=16)))
         try:
             api.run_rounds_fused(0, 2)
         except ValueError as e:
-            assert "full participation" in str(e)
+            assert "clients" in str(e)
         else:
-            raise AssertionError("partial cohort accepted")
+            raise AssertionError("model-parallel fused mesh accepted")
 
     def test_fused_mesh_resume_mid_stream(self):
         from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
