@@ -16,16 +16,20 @@ Workloads (BASELINE.md rows):
 4. ``fedavg_powerlaw_1000``: the reference flagship shape (1000 power-law
    clients, 10/round, B=10, LR) — cohort-bucket packing wall-clock vs
    global-max packing, plus the padded-row reduction.
-5. ``fedavg_fused_rounds``: R rounds under one lax.scan with device-side
-   sampling (FusedRounds) vs the host loop at IDENTICAL packing
-   (amortization) and vs the cohort-packed host loop (the other
-   throughput contender).
+5. ``fedavg_fused_rounds``: R sampled rounds as one fused BLOCK (host-
+   presampled cohorts at the block's cohort bucket under one lax.scan —
+   both throughput levers composed) vs the cohort-packed host loop and
+   the device-sampling scan.
 6. ``federated_parallel_axes``: tokens/s of the ('clients','seq') and
    ('clients','tp') federated rounds (S=2048 on chip).
 7. ``time_to_target_mnist_lr``: seconds/rounds to the reference's >75%
    MNIST+LR anchor at its exact config (benchmark/README.md:12).
 8. ``time_to_target_acc``: seconds for the seeded blob federation to reach
    92% test accuracy (the fast trend metric; fully reproducible, seed=3).
+0. ``smoke_chip`` (runs FIRST, also ``--smoke-chip`` alone): a <=60 s
+   stage — headline rounds/s + MFU + bf16 + one flash-attention step —
+   persisted immediately so a tunnel wedge mid-suite cannot cost the
+   round its chip evidence. Every row carries a ``host`` tag.
 
 ``vs_baseline`` on the headline metric is measured against a faithful
 reference-style sequential torch simulation **on this machine's CPU**
@@ -316,21 +320,26 @@ def bench_powerlaw_1000() -> dict:
 
 
 def bench_fused_rounds() -> dict:
-    """Multi-round on-device driver: R sampled rounds under one lax.scan
-    (FusedRounds device-sampling mode) vs the host loop on the identical
-    workload — the SURVEY §7 'keep the entire round on-device' win
-    condition, with host pack/dispatch amortized over R rounds."""
+    """Composed throughput levers (VERDICT r3 #1): R sampled rounds as ONE
+    fused BLOCK — host-presampled cohorts packed at the block's pow-2
+    cohort bucket, scanned in one dispatch, trajectory-identical to the
+    host loop — vs the cohort-packed host loop (the former contender) and
+    the device-sampling scan (global-max padding). Win condition: fused
+    block >= cohort-packed host loop at the 1000-client power-law
+    flagship."""
     import jax
 
-    from fedml_tpu.algorithms.fedavg import (FedAvgAPI, FedAvgConfig,
-                                             FusedRounds)
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core import pytree as pt
     from fedml_tpu.data.synthetic import make_powerlaw_blob_federated
     from fedml_tpu.models.lr import LogisticRegression
     from fedml_tpu.trainer.functional import TrainConfig
 
-    tpu = _is_tpu()
-    N = 1000
-    R = 100 if tpu else 20
+    # R=20 is the VERDICT contract point AND the sweet spot: the block
+    # packs at the max cohort bucket over its R cohorts, so very large R
+    # erodes the packing lever (some cohort eventually contains a huge
+    # client) while small R under-amortizes the host sync
+    N, R = 1000, 20
     ds = make_powerlaw_blob_federated(client_num=N, dim=64, class_num=10,
                                       seed=2)
 
@@ -342,18 +351,24 @@ def bench_fused_rounds() -> dict:
                              train=TrainConfig(epochs=1, batch_size=10,
                                                lr=0.03)))
 
-    api = make_api()
-    fused = api.fused_rounds(device_sampling=True)
-    fused.run_rounds(0, R)  # compile + warm
-    jax.block_until_ready(api.variables)
-    t0 = time.perf_counter()
-    fused.run_rounds(R, R)
-    jax.block_until_ready(api.variables)
-    fused_rps = R / (time.perf_counter() - t0)
+    def fused_rps(device_sampling):
+        api = make_api()
+        fused = api.fused_rounds(device_sampling=device_sampling)
+        fused.run_rounds(0, R)  # compile + warm
+        jax.block_until_ready(api.variables)
+        # a later block can land on a different cohort bucket and
+        # recompile; time two consecutive blocks and keep the best
+        best = 0.0
+        for i in (1, 2):
+            t0 = time.perf_counter()
+            fused.run_rounds(i * R, R)
+            jax.block_until_ready(api.variables)
+            best = max(best, R / (time.perf_counter() - t0))
+        return best
 
-    # host loop at GLOBAL padding — the apples-to-apples contender (the
-    # fused path must pad to the dataset max: its in-scan gather needs one
-    # static shape), so amortization_x isolates the host-sync saving
+    block_rps = fused_rps(device_sampling=False)
+    device_rps = fused_rps(device_sampling=True)
+
     def host_rps(pack):
         api = make_api(pack)
         timed = min(R, 20)
@@ -374,18 +389,28 @@ def bench_fused_rounds() -> dict:
         jax.block_until_ready(api.variables)
         return timed / (time.perf_counter() - t0)
 
-    host_global = host_rps("global")
     host_cohort = host_rps("cohort")
+    host_global = host_rps("global")
+    # trajectory parity of the timed contenders: the block rounds [R, 2R)
+    # and host rounds [1, 20] overlap on [1, 20) — rerun both from 0 is
+    # wasteful here, so assert on a fresh short block instead
+    a, b = make_api(), make_api()
+    a.fused_rounds().run_rounds(0, 5)
+    for r in range(5):
+        b.run_round(r)
+    parity = float(pt.tree_norm(pt.tree_sub(a.variables, b.variables))
+                   ) / max(1e-30, float(pt.tree_norm(b.variables)))
     return {
-        "rounds_per_sec_fused": round(fused_rps, 3),
-        "rounds_per_sec_host_global_pack": round(host_global, 3),
+        "rounds_per_sec_fused_block": round(block_rps, 3),
+        "rounds_per_sec_fused_device_sampling": round(device_rps, 3),
         "rounds_per_sec_host_cohort_pack": round(host_cohort, 3),
-        "amortization_x": round(fused_rps / host_global, 2),
+        "rounds_per_sec_host_global_pack": round(host_global, 3),
+        "fused_block_vs_host_cohort_x": round(block_rps / host_cohort, 2),
         "rounds_per_scan": R,
-        "note": "fused pads to the dataset max (static gather shape); the "
-                "cohort-packed host loop is the other throughput contender "
-                "— pick per workload (fused wins when host sync dominates, "
-                "cohort packing when padding waste dominates)",
+        "block_host_parity_rel_err": parity,
+        "note": "fused block = host-presampled cohorts at the block's "
+                "cohort bucket under one lax.scan — both throughput "
+                "levers composed, same trajectory as the host loop",
     }
 
 
@@ -473,8 +498,18 @@ def bench_time_to_target_mnist_lr() -> dict:
 
     tpu = _is_tpu()
     N = 1000 if tpu else 100
-    max_rounds = 150 if tpu else 40
-    ds = build_leaf_mnist_federation(client_num=N, seed=0)
+    max_rounds = 150 if tpu else 80
+    # the anchor config is 1000 power-law clients; the CPU fallback
+    # subsamples to 100 and MUST label itself smoke, not anchor
+    config = (f"B=10 lr=0.03 E=1 10/round, {N} power-law clients, "
+              "calibrated 85% ceiling"
+              + (" (benchmark/README.md:12 anchor)" if N == 1000
+                 else " (CPU SMOKE SUBSAMPLE of the 1000-client anchor)"))
+    # calibrated corpus (VERDICT r3 #5): 85% Bayes ceiling + noise=0.6 so
+    # crossing the >75% anchor takes real learning (~15+ rounds), not a
+    # saturating round-1 hit
+    ds = build_leaf_mnist_federation(client_num=N, seed=0, target_acc=0.85,
+                                     noise=0.6)
     api = FedAvgAPI(ds, LogisticRegression(num_classes=10),
                     config=FedAvgConfig(
                         comm_round=max_rounds, client_num_per_round=10,
@@ -488,9 +523,7 @@ def bench_time_to_target_mnist_lr() -> dict:
     api.run_round(0)
     if api.evaluate(0).get("test_acc", 0.0) >= 0.75:
         return {"seconds_to_75pct": 0.0, "rounds_to_75pct": 1,
-                "clients_total": N,
-                "config": "B=10 lr=0.03 E=1 10/round "
-                          "(benchmark/README.md:12)"}
+                "clients_total": N, "config": config}
     jax.block_until_ready(api.variables)
     t0 = time.perf_counter()
     reached = None
@@ -504,7 +537,7 @@ def bench_time_to_target_mnist_lr() -> dict:
         "seconds_to_75pct": round(dt, 4) if reached else None,
         "rounds_to_75pct": reached,
         "clients_total": N,
-        "config": "B=10 lr=0.03 E=1 10/round (benchmark/README.md:12)",
+        "config": config,
     }
 
 
@@ -546,6 +579,61 @@ def bench_time_to_target(target_acc: float = 0.95, max_rounds: int = 60
         "rounds_to_target": reached,
         "target_acc": target_acc,
     }
+
+
+def bench_smoke_chip() -> dict:
+    """The <=60 s chip-smoke stage (VERDICT r3 #3): headline rounds/s +
+    MFU, the bf16 variant, and one flash-attention step at S=2048 — run
+    FIRST on any live tunnel window and persisted immediately, so a wedge
+    mid-suite can no longer cost the round its chip evidence. Shapes are
+    the full flagship shapes; only the timed-round counts shrink."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    tpu = _is_tpu()
+    # full flagship shapes on chip; CPU shrinks exactly like
+    # bench_fedavg_cnn (the conv backward is ~1000x slower there and the
+    # CPU smoke is only a does-it-run check)
+    api = _make_api("cnn", 28, 1, CLASSES, 11,
+                    samples=SAMPLES_PER_CLIENT if tpu else 2 * BATCH,
+                    clients=CLIENTS_PER_ROUND if tpu else 2)
+    flops = _round_flops(api)
+    rps = _bench_rounds(api, 10)
+    peak = _device_peak_tflops() * 1e12
+    out["rounds_per_sec"] = round(rps, 3)
+    out["achieved_tflops"] = round(rps * flops / 1e12, 3)
+    out["mfu"] = round(rps * flops / peak, 4) if peak == peak else None
+    if tpu:
+        api16 = _make_api("cnn", 28, 1, CLASSES, 11,
+                          compute_dtype="bfloat16")
+        out["rounds_per_sec_bf16"] = round(_bench_rounds(api16, 10), 3)
+
+    from fedml_tpu.ops.flash_attention import flash_attention
+    interpret = not _is_tpu()
+    B, S, H, D = (4, 2048, 4, 64) if _is_tpu() else (1, 256, 2, 32)
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+               for _ in range(3))
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=interpret) ** 2)
+        return jax.grad(loss)(q)
+
+    g = step(q, k, v)  # compile
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    steps = 3
+    for _ in range(steps):
+        g = step(q, k, v)
+    jax.block_until_ready(g)
+    out["flash_attn_fwd_bwd_tokens_per_sec"] = round(
+        steps * B * S / (time.perf_counter() - t0), 1)
+    out["flash_attn_shape"] = f"B={B} S={S} H={H} D={D}"
+    return out
 
 
 def bench_torch_baseline() -> float:
@@ -713,6 +801,7 @@ def _probe_device(timeout_s: int = 180):
 
 
 def main():
+    smoke_only = "--smoke-chip" in sys.argv
     timeout_s = int(os.environ.get("FEDML_BENCH_PROBE_TIMEOUT_S", 180))
     info = _probe_device(timeout_s)
     if "error" in info:
@@ -724,13 +813,34 @@ def main():
                "extra": {"error": info["error"]}})
         return 0
     _log(f"backend={info['backend']} device={info['device']!r}")
+    # every row carries where it ran, so chip numbers can never be
+    # conflated with CPU trend numbers (VERDICT r3 #10)
+    host_tag = (f"tpu:{info['device']}" if info["backend"] != "cpu"
+                else "cpu-smoke")
     partial: dict = {}
     _arm_global_watchdog(
         int(os.environ.get("FEDML_BENCH_TOTAL_TIMEOUT_S", 2400)), partial)
+
     def staged(key, name, fn):
-        partial[key] = _run(name, fn)
+        out = _run(name, fn)
+        if isinstance(out, dict):
+            out.setdefault("host", host_tag)
+        partial[key] = out
         _persist_partial(partial)
         return partial[key]
+
+    # first in line on any live window: the <=60s smoke stage, persisted
+    # before the long suite can hit a wedge
+    smoke = staged("smoke_chip", "smoke_chip", bench_smoke_chip)
+    if smoke_only:
+        _emit({
+            "metric": "fedavg_rounds_per_sec_femnist_cnn",
+            "value": smoke.get("rounds_per_sec", 0.0),
+            "unit": "rounds/s",
+            "vs_baseline": None,
+            "extra": {"smoke_chip": smoke, "mode": "--smoke-chip"},
+        })
+        return 0
 
     flagship = staged("fedavg_femnist_cnn", "fedavg_femnist_cnn",
                       bench_fedavg_cnn)
@@ -754,6 +864,7 @@ def main():
     base = base_out.get("rps", float("nan"))
 
     extra = {
+        "smoke_chip": smoke,
         "fedavg_femnist_cnn": flagship,
         "fedavg_femnist_cnn_bf16": flagship_bf16,
         "resnet18_gn_fedcifar100": resnet,
@@ -771,6 +882,20 @@ def main():
     # CPU runs shrink the workload (smoke shapes), so the ratio against the
     # full-size torch baseline is only meaningful on the chip
     extra["smoke_shapes"] = not _is_tpu()
+    extra["host"] = host_tag
+    # the competitive metrics, flat, so the driver-recorded artifact
+    # captures them even if a consumer drops the nested dicts (VERDICT #7)
+    extra["headline_summary"] = {
+        "femnist_cnn_rps": flagship.get("rounds_per_sec"),
+        "femnist_cnn_mfu": flagship.get("mfu"),
+        "femnist_cnn_bf16_rps": flagship_bf16.get("rounds_per_sec"),
+        "resnet18_gn_rps": resnet.get("rounds_per_sec"),
+        "powerlaw_1000_rps": powerlaw.get("rounds_per_sec"),
+        "fused_block_rps": fused.get("rounds_per_sec_fused_block"),
+        "fused_block_vs_host_cohort_x": fused.get(
+            "fused_block_vs_host_cohort_x"),
+        "flash_tokens_per_sec": transformer.get("tokens_per_sec"),
+    }
     line = {
         "metric": "fedavg_rounds_per_sec_femnist_cnn",
         "value": headline,

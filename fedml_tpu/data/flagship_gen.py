@@ -1,0 +1,132 @@
+"""Reference-scale flagship federations for the zero-egress environment.
+
+The reference's two heavy flagship corpora cannot be downloaded here
+(egress is dead — runs/fetch_attempt_r3.log), so this module generates
+federations with the SAME shape facts the reference loaders produce:
+
+- **FEMNIST-shape**: 3400 natural clients, 62 classes, 28x28x1 images,
+  B=20 (reference FederatedEMNIST/data_loader.py:15-17 —
+  DEFAULT_TRAIN_CLIENTS_NUM = 3400, DEFAULT_BATCH_SIZE = 20; paired with
+  CNN_DropOut at the 84.9% anchor, benchmark/README.md:54).
+- **fed-CIFAR100-shape**: 500 train clients, 100 classes, 24x24x3 crops,
+  100 samples/client, B=20 (reference fed_cifar100/data_loader.py:17-19
+  — DEFAULT_TRAIN_CLIENTS_NUM = 500; paired with ResNet-18+GroupNorm at
+  the 44.7% anchor, benchmark/README.md:55).
+
+**Calibrated to discriminate** (VERDICT r3 #5): earlier generated corpora
+were linearly separable by construction and saturated at 100% accuracy,
+so the reference's accuracy anchors discriminated nothing. Here symmetric
+label noise sets a Bayes ceiling at the reference's published number:
+with flip probability p over C classes the best reachable accuracy is
+``(1 - p) + p / C`` — p is solved from the target so a model that fully
+learns the clean structure tops out AT the anchor, and the anchor is
+crossed only by models that genuinely learn (>50 rounds at the
+reference's federated configs, not round 1). Pixel noise and 2-dominant-
+class skew (LEAF-style writer non-IIDness) make the approach to the
+ceiling gradual.
+
+Content is synthetic (class-conditional low-frequency patterns + noise) —
+these are throughput/trajectory/scale stand-ins, NOT claims about real
+FEMNIST/CIFAR accuracy; the anchor comparison is against the calibrated
+ceiling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def label_noise_for_ceiling(target_acc: float, class_num: int) -> float:
+    """Symmetric label-flip probability whose Bayes ceiling is
+    ``target_acc``: ceiling = (1-p) + p/C  =>  p = (1-t) * C / (C-1)."""
+    if not 0.0 < target_acc <= 1.0:
+        raise ValueError(f"target_acc {target_acc} outside (0, 1]")
+    p = (1.0 - target_acc) * class_num / (class_num - 1)
+    return float(min(max(p, 0.0), 1.0))
+
+
+def apply_label_noise(y: np.ndarray, p: float, class_num: int,
+                      rng: np.random.RandomState) -> np.ndarray:
+    """Flip each label to a uniformly random OTHER class with prob p
+    (train and test alike — the ceiling must bind evaluation too)."""
+    if p <= 0.0:
+        return y
+    flip = rng.rand(len(y)) < p
+    # uniform over the other C-1 classes
+    offs = rng.randint(1, class_num, len(y))
+    return np.where(flip, (y + offs) % class_num, y).astype(y.dtype)
+
+
+def _class_prototypes(rng: np.random.RandomState, class_num: int, hw: int,
+                      chans: int) -> np.ndarray:
+    """Per-class smooth intensity patterns in [0,1]^(hw*hw*chans): cosine
+    mixtures keyed by class, per channel."""
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float64) / hw
+    protos = np.empty((class_num, hw, hw, chans), np.float64)
+    for c in range(class_num):
+        for ch in range(chans):
+            f1, f2 = rng.randint(1, 5, 2)
+            p1, p2 = rng.rand(2) * 2 * np.pi
+            img = (np.cos(2 * np.pi * f1 * xx + p1)
+                   * np.cos(2 * np.pi * f2 * yy + p2))
+            img += 0.5 * np.cos(2 * np.pi * (xx + yy) * (c % 7 + 1) + ch)
+            img = (img - img.min()) / (img.max() - img.min() + 1e-12)
+            protos[c, :, :, ch] = img
+    return protos
+
+
+def _build(client_num: int, class_num: int, hw: int, chans: int,
+           sizes: np.ndarray, seed: int, noise: float,
+           label_noise_p: float, test_fraction: float, dominant: int = 2):
+    from fedml_tpu.data.base import FederatedDataset
+
+    rng = np.random.RandomState(seed)
+    protos = _class_prototypes(rng, class_num, hw, chans)
+    train_local, test_local = {}, {}
+    for i, n in enumerate(sizes):
+        n = int(n)
+        dom = rng.choice(class_num, dominant, replace=False)
+        probs = np.full(class_num, 0.3 / (class_num - dominant))
+        probs[dom] = 0.7 / dominant
+        y_clean = rng.choice(class_num, n, p=probs).astype(np.int32)
+        x = (protos[y_clean]
+             + noise * rng.randn(n, hw, hw, chans)).astype(np.float32)
+        x = np.clip(x, 0.0, 1.0)
+        y = apply_label_noise(y_clean, label_noise_p, class_num, rng)
+        n_test = max(1, int(n * test_fraction))
+        test_local[i] = (x[:n_test], y[:n_test])
+        train_local[i] = (x[n_test:], y[n_test:])
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               class_num)
+
+
+def build_femnist_federation(client_num: int = 3400, seed: int = 0,
+                             target_acc: float = 0.849,
+                             noise: float = 0.35,
+                             test_fraction: float = 0.15):
+    """FEMNIST-shape federation: 3400 clients, 62 classes, 28x28x1,
+    LEAF-writer-like size spread (median ~150 samples, max ~400), Bayes
+    ceiling calibrated to the reference's 84.9% anchor
+    (benchmark/README.md:54)."""
+    class_num = 62
+    rng = np.random.RandomState(seed + 1)
+    sizes = np.clip((20 + rng.lognormal(4.9, 0.6, client_num)).astype(int),
+                    20, 400)
+    p = label_noise_for_ceiling(target_acc, class_num)
+    return _build(client_num, class_num, 28, 1, sizes, seed, noise, p,
+                  test_fraction)
+
+
+def build_fedcifar100_federation(client_num: int = 500, seed: int = 0,
+                                 target_acc: float = 0.447,
+                                 noise: float = 0.45,
+                                 samples_per_client: int = 100,
+                                 test_fraction: float = 0.2):
+    """fed-CIFAR100-shape federation: 500 clients x 100 samples (uniform,
+    as the TFF split), 100 classes, 24x24x3, Bayes ceiling calibrated to
+    the reference's 44.7% anchor (benchmark/README.md:55)."""
+    class_num = 100
+    sizes = np.full(client_num, samples_per_client)
+    p = label_noise_for_ceiling(target_acc, class_num)
+    return _build(client_num, class_num, 24, 3, sizes, seed, noise, p,
+                  test_fraction, dominant=10)

@@ -67,17 +67,29 @@ def build_leaf_mnist_federation(client_num: int = 1000, seed: int = 0,
                                 size_sigma: float = 1.1,
                                 max_samples: int = 500,
                                 noise: float = 0.25, class_num: int = 10,
-                                test_fraction: float = 0.15):
+                                test_fraction: float = 0.15,
+                                target_acc: float = None):
     """The generator's federation as in-memory arrays (the same content
     ``generate_leaf_mnist`` serializes): per-client ``(x[784], y)`` train
     and test splits with power-law sizes and 2-dominant-class skew.
     Returns a :class:`~fedml_tpu.data.base.FederatedDataset` — used by the
     bench's reference-anchor time-to-target workload, where writing 250 MB
-    of json per run would be waste."""
+    of json per run would be waste.
+
+    ``target_acc`` calibrates a Bayes accuracy ceiling via symmetric label
+    noise (data/flagship_gen.label_noise_for_ceiling) so the corpus
+    DISCRIMINATES instead of saturating at 100% — e.g. 0.85 puts the
+    ceiling near the reference's published MNIST+LR accuracy and makes the
+    >75% anchor (benchmark/README.md:12) a real learning bar. None keeps
+    the legacy noise-free corpus (parity tests)."""
     from fedml_tpu.data.base import FederatedDataset
+    from fedml_tpu.data.flagship_gen import (apply_label_noise,
+                                             label_noise_for_ceiling)
 
     rng = np.random.RandomState(seed)
     protos = _digit_prototypes(rng, class_num)
+    p_noise = (label_noise_for_ceiling(target_acc, class_num)
+               if target_acc is not None else 0.0)
     sizes = np.minimum(
         (min_samples + rng.lognormal(size_mean, size_sigma,
                                      client_num)).astype(int),
@@ -91,6 +103,7 @@ def build_leaf_mnist_federation(client_num: int = 1000, seed: int = 0,
         y = rng.choice(class_num, int(n), p=probs).astype(np.int32)
         x = protos[y] + noise * rng.randn(int(n), protos.shape[1])
         x = np.clip(x, 0.0, 1.0).astype(np.float32)
+        y = apply_label_noise(y, p_noise, class_num, rng)
         n_test = max(1, int(n * test_fraction))
         test_local[i] = (x[:n_test], y[:n_test])
         train_local[i] = (x[n_test:], y[n_test:])
