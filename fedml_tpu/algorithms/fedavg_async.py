@@ -209,76 +209,44 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                      comm_round: int = 2, quorum: int = 1,
                      round_deadline_s: float = 10.0, alpha: float = 0.6,
                      poly_a: float = 0.5, max_updates: int = 20,
-                     train_cfg=None, seed: int = 0):
+                     train_cfg=None, seed: int = 0,
+                     backend: str = "INPROC", addresses=None,
+                     wire_codec: bool = False):
     """Launch a straggler-tolerant federation (server + worker silos as
-    in-proc actor threads, the same protocol that runs over TCP/gRPC) and
-    block until it completes. ``mode="quorum"`` closes rounds at
-    (all | deadline & quorum); ``mode="fedasync"`` merges every arriving
-    update with the staleness-decayed weight. Returns
-    ``(final global model, history, server)`` — the server exposes
-    ``partial_rounds`` (quorum) / ``update_log`` (fedasync) for
-    straggler-behavior evidence."""
-    import threading
+    actor threads over any comm backend) and block until it completes.
+    ``mode="quorum"`` closes rounds at (all | deadline & quorum);
+    ``mode="fedasync"`` merges every arriving update with the
+    staleness-decayed weight. Returns ``(final global model, history,
+    server)`` — the server exposes ``partial_rounds`` (quorum) /
+    ``update_log`` (fedasync) for straggler-behavior evidence.
 
-    import jax
-    import jax.numpy as jnp
+    All scaffolding (model init, eval hook, comm wiring, thread
+    lifecycle, bounded join) is the shared
+    :func:`~fedml_tpu.algorithms.fedavg_cross_silo.launch_federation` —
+    only the server flavor differs."""
+    from fedml_tpu.algorithms.fedavg_cross_silo import launch_federation
 
-    from fedml_tpu.comm.inproc import InProcCommManager, InProcRouter
-    from fedml_tpu.trainer.functional import TrainConfig, make_eval
+    if mode not in ("quorum", "fedasync"):
+        raise ValueError(f"unknown async mode: {mode!r} "
+                         "(quorum | fedasync)")
 
-    train_cfg = train_cfg or TrainConfig()
-    size = worker_num + 1
-    sample_x = dataset.train_data_global[0][:1]
-    global_model = module.init(jax.random.key(seed), jnp.asarray(sample_x),
-                               train=False)
-    history = []
-    eval_fn = jax.jit(make_eval(module, task))
-
-    def on_round_done(round_idx, model):
-        xt, yt = dataset.test_data_global
-        if len(xt):
-            stats = eval_fn(model, jnp.asarray(xt), jnp.asarray(yt),
-                            jnp.ones(len(xt), jnp.float32))
-            total = max(1.0, float(stats["count"]))
-            history.append({
-                "round": round_idx,
-                "test_acc": float(stats["correct_sum"]) / total,
-                "test_loss": float(stats["loss_sum"]) / total,
-            })
-
-    router = InProcRouter()
-    aggregator = FedAvgAggregator(worker_num)
-    server_com = InProcCommManager(router, 0, size)
-    if mode == "quorum":
-        server = QuorumFedAvgServerManager(
-            0, size, server_com, aggregator, comm_round,
-            dataset.client_num, global_model, quorum=quorum,
-            round_deadline_s=round_deadline_s, on_round_done=on_round_done)
-    elif mode == "fedasync":
-        server = AsyncFedAvgServerManager(
+    def server_factory(size, server_com, aggregator, global_model,
+                       on_round_done):
+        if mode == "quorum":
+            return QuorumFedAvgServerManager(
+                0, size, server_com, aggregator, comm_round,
+                dataset.client_num, global_model, quorum=quorum,
+                round_deadline_s=round_deadline_s,
+                on_round_done=on_round_done)
+        return AsyncFedAvgServerManager(
             0, size, server_com, aggregator,
             client_num_in_total=dataset.client_num,
             global_model=global_model, alpha=alpha, poly_a=poly_a,
             max_updates=max_updates, on_round_done=on_round_done)
-    else:
-        raise ValueError(f"unknown async mode: {mode!r} "
-                         "(quorum | fedasync)")
-    clients = [FedAvgClientManager(
-        rank, size, InProcCommManager(router, rank, size), dataset, module,
-        task, train_cfg, seed=seed) for rank in range(1, size)]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
-    st = threading.Thread(target=server.run, daemon=True)
-    for t in threads:
-        t.start()
-    st.start()
-    server.send_init_msg()
-    # bounded join (as run_fedavg_cross_silo): a crashed worker must not
-    # hang the CLI forever
-    st.join(timeout=600.0)
-    if st.is_alive():
-        raise RuntimeError(
-            "async federation did not finish within 600s (dead worker or "
-            "quorum never reached?)")
-    for t in threads:
-        t.join(timeout=30.0)
-    return server.global_model, history, server
+
+    # wire_codec defaults False for in-proc async runs (the pre-refactor
+    # behavior: raw in-memory handoff, no per-update encode/decode)
+    return launch_federation(dataset, module, task, worker_num, train_cfg,
+                             server_factory, backend=backend,
+                             addresses=addresses, seed=seed,
+                             wire_codec=wire_codec, raise_on_timeout=True)

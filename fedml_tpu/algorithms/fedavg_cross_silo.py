@@ -340,12 +340,51 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     selected backend, so the same protocol code also drives TCP/GRPC
     processes for true multi-host runs.
     """
+    checkpoint_mgr = None
+    if checkpoint_dir:
+        from fedml_tpu.utils.checkpoint import CheckpointManager
+        checkpoint_mgr = CheckpointManager(checkpoint_dir)
+
+    def server_factory(size, server_com, aggregator, global_model,
+                       on_round_done):
+        common = dict(on_round_done=on_round_done,
+                      checkpoint_mgr=checkpoint_mgr, resume=resume)
+        if server_optimizer:
+            return FedOptServerManager(
+                0, size, server_com, aggregator, comm_round,
+                dataset.client_num, global_model,
+                server_optimizer=server_optimizer, server_lr=server_lr,
+                server_momentum=server_momentum, **common)
+        return FedAvgServerManager(0, size, server_com, aggregator,
+                                   comm_round, dataset.client_num,
+                                   global_model, **common)
+
+    model, history, _ = launch_federation(
+        dataset, module, task, worker_num, train_cfg, server_factory,
+        backend=backend, addresses=addresses, wire_codec=wire_codec,
+        compress=compress, token=token)
+    return model, history
+
+
+def launch_federation(dataset: FederatedDataset, module, task: str,
+                      worker_num: int, train_cfg: Optional[TrainConfig],
+                      server_factory, backend: str = "INPROC",
+                      addresses=None, wire_codec: bool = True,
+                      compress: bool = False, token=None, seed: int = 0,
+                      join_timeout_s: float = 600.0,
+                      raise_on_timeout: bool = False):
+    """Shared federation scaffolding for every server flavor (sync,
+    FedOpt, quorum, FedAsync): init the global model, build the
+    per-round eval hook, wire comm managers + client silos, run the
+    protocol threads, bounded-join. ``server_factory(size, server_com,
+    aggregator, global_model, on_round_done)`` returns the server
+    manager. Returns ``(final global model, history, server)``."""
     train_cfg = train_cfg or TrainConfig()
     size = worker_num + 1
     router = InProcRouter() if backend.upper() in ("INPROC", "MPI") else None
 
     sample_x = dataset.train_data_global[0][:1]
-    global_model = module.init(jax.random.key(0), jnp.asarray(sample_x),
+    global_model = module.init(jax.random.key(seed), jnp.asarray(sample_x),
                                train=False)
     history: List[Dict] = []
     eval_fn = jax.jit(make_eval(module, task))
@@ -363,34 +402,19 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                 max(1.0, float(stats["count"])),
             })
 
-    checkpoint_mgr = None
-    if checkpoint_dir:
-        from fedml_tpu.utils.checkpoint import CheckpointManager
-        checkpoint_mgr = CheckpointManager(checkpoint_dir)
-
     aggregator = FedAvgAggregator(worker_num)
     server_com = create_comm_manager(backend, 0, size, router=router,
                                      addresses=addresses,
                                      wire_codec=wire_codec, token=token)
-    common = dict(on_round_done=on_round_done,
-                  checkpoint_mgr=checkpoint_mgr, resume=resume)
-    if server_optimizer:
-        server = FedOptServerManager(
-            0, size, server_com, aggregator, comm_round,
-            dataset.client_num, global_model,
-            server_optimizer=server_optimizer, server_lr=server_lr,
-            server_momentum=server_momentum, **common)
-    else:
-        server = FedAvgServerManager(0, size, server_com, aggregator,
-                                     comm_round, dataset.client_num,
-                                     global_model, **common)
+    server = server_factory(size, server_com, aggregator, global_model,
+                            on_round_done)
     clients = []
     for rank in range(1, size):
         com = create_comm_manager(backend, rank, size, router=router,
                                   addresses=addresses, wire_codec=wire_codec,
                                   token=token)
         clients.append(FedAvgClientManager(rank, size, com, dataset, module,
-                                           task, train_cfg,
+                                           task, train_cfg, seed=seed,
                                            compress=compress))
 
     threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
@@ -399,7 +423,11 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         t.start()
     server_thread.start()
     server.send_init_msg()
-    server_thread.join(timeout=600)
+    server_thread.join(timeout=join_timeout_s)
+    if raise_on_timeout and server_thread.is_alive():
+        raise RuntimeError(
+            f"federation did not finish within {join_timeout_s:.0f}s "
+            "(dead worker or quorum never reached?)")
     for t in threads:
         t.join(timeout=60)
-    return server.global_model, history
+    return server.global_model, history, server

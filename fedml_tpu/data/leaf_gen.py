@@ -90,6 +90,9 @@ def build_leaf_mnist_federation(client_num: int = 1000, seed: int = 0,
     protos = _digit_prototypes(rng, class_num)
     p_noise = (label_noise_for_ceiling(target_acc, class_num)
                if target_acc is not None else 0.0)
+    # a separate stream for the label flips: calibration changes LABELS
+    # only — features (and the legacy no-noise content) stay bit-identical
+    rng_noise = np.random.RandomState(seed + 99991)
     sizes = np.minimum(
         (min_samples + rng.lognormal(size_mean, size_sigma,
                                      client_num)).astype(int),
@@ -103,7 +106,7 @@ def build_leaf_mnist_federation(client_num: int = 1000, seed: int = 0,
         y = rng.choice(class_num, int(n), p=probs).astype(np.int32)
         x = protos[y] + noise * rng.randn(int(n), protos.shape[1])
         x = np.clip(x, 0.0, 1.0).astype(np.float32)
-        y = apply_label_noise(y, p_noise, class_num, rng)
+        y = apply_label_noise(y, p_noise, class_num, rng_noise)
         n_test = max(1, int(n * test_fraction))
         test_local[i] = (x[:n_test], y[:n_test])
         train_local[i] = (x[n_test:], y[n_test:])

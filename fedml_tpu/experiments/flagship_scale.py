@@ -107,6 +107,10 @@ def main(argv=None):
     model_name, task = DEFAULT_MODEL_AND_TASK[args.dataset]
     os.makedirs(args.out, exist_ok=True)
 
+    drivers = args.drivers.split(",")
+    bad = set(drivers) - {"sim", "spmd"}
+    if bad:
+        raise SystemExit(f"--drivers tokens must be sim|spmd; got {bad}")
     summary = {
         "dataset": args.dataset,
         "model": model_name,
@@ -119,7 +123,7 @@ def main(argv=None):
         "train_samples": ds.train_data_num,
     }
     results = {}
-    for kind in args.drivers.split(","):
+    for kind in drivers:
         model = create_model(model_name, output_dim=ds.class_num)
         hist, variables, stats = run_driver(
             kind, ds, model, task, args.rounds, args.client_num_per_round,
