@@ -33,8 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.core import pytree as pt
-from fedml_tpu.core.sampling import (DEVICE_SAMPLE_SENTINEL, round_keys,
-                                     sample_clients)
+from fedml_tpu.core.sampling import (DEVICE_SAMPLE_SENTINEL, eval_subsample,
+                                     round_keys, sample_clients)
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train)
@@ -81,6 +81,11 @@ class FedAvgConfig:
     # subsamples evaluation the same way for its largest federation,
     # fedavg_api.py:115 _generate_validation_set). None = full union.
     eval_train_subsample: Optional[int] = None
+    # same knob for the test union (reference subsamples only train, but
+    # its test sets fit a GPU; the flagship-scale generated test unions do
+    # not fit a CPU eval budget — seeded via core.sampling.eval_subsample
+    # so sim and mesh drivers score the identical subset). None = full.
+    eval_test_subsample: Optional[int] = None
     # padding policy for the per-round client pack: "cohort" pads to the
     # sampled cohort's pow-2 bucket (data/base.py cohort_padded_len — big
     # FLOP win on power-law federations, a few extra compiles), "global"
@@ -251,14 +256,16 @@ class FedAvgAPI:
         optional seeded train subsample)."""
         if self._eval_cache is None or self._eval_cache[0] is not self.dataset:
             xg, yg = self.dataset.train_data_global
-            sub = self.config.eval_train_subsample
-            if sub and len(xg) > sub:
-                sel = np.random.RandomState(self.config.seed).choice(
-                    len(xg), sub, replace=False)
-                xg, yg = xg[sel], yg[sel]
+            xg, yg = eval_subsample(xg, yg,
+                                    self.config.eval_train_subsample,
+                                    self.config.seed)
             train = (jnp.asarray(xg), jnp.asarray(yg),
                      jnp.ones(len(xg), jnp.float32))
             xt, yt = self.dataset.test_data_global
+            if len(xt):
+                xt, yt = eval_subsample(xt, yt,
+                                        self.config.eval_test_subsample,
+                                        self.config.seed)
             test = ((jnp.asarray(xt), jnp.asarray(yt),
                      jnp.ones(len(xt), jnp.float32)) if len(xt) else None)
             self._eval_cache = (self.dataset, train, test)

@@ -67,3 +67,21 @@ def sample_clients(
         candidates = [c for c in range(client_num_in_total) if c != delete_client]
         num_clients = min(num_clients, len(candidates))
     return np.random.choice(candidates, num_clients, replace=False)
+
+
+def eval_subsample(x, y, limit: Optional[int], seed: int):
+    """Seeded eval-set subsample, ONE formula for every driver.
+
+    Full-union eval at flagship scale costs more than the training rounds
+    it measures (FEMNIST-shape: ~90k test images per eval on the host CPU
+    fallback), so drivers accept an eval subsample limit. Both drivers
+    must draw the identical subset or the sim==SPMD history parity tests
+    would compare different eval sets — hence one shared helper keyed
+    only on (len, limit, seed). Returns (x, y) unchanged when ``limit``
+    is falsy or already covers the set.
+    """
+    if limit and len(x) > limit:
+        sel = np.random.RandomState(seed).choice(len(x), limit,
+                                                 replace=False)
+        return x[sel], y[sel]
+    return x, y

@@ -159,6 +159,13 @@ def _fed_cifar100_gen(data_dir, **kw):
         client_num=kw.get("client_num_in_total", 500))
 
 
+def _mnist_gen(data_dir, **kw):
+    from fedml_tpu.data.leaf_gen import build_leaf_mnist_federation
+    return build_leaf_mnist_federation(
+        client_num=kw.get("client_num_in_total", 1000),
+        target_acc=kw.get("target_acc", 0.85))
+
+
 def _landmarks(data_dir, **kw):
     from fedml_tpu.data.images import load_partition_data_landmarks
     return load_partition_data_landmarks(
@@ -194,6 +201,7 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     # loaders' exact shape facts and calibrated accuracy ceilings)
     "femnist_gen": _femnist_gen,          # 3400 clients, 62c, ceil 84.9%
     "fed_cifar100_gen": _fed_cifar100_gen,  # 500 clients, 100c, ceil 44.7%
+    "mnist_gen": _mnist_gen,              # 1000 clients, 10c, ceil 85%
 }
 
 # reference --dataset name -> (model factory name, task head)
@@ -224,6 +232,7 @@ DEFAULT_MODEL_AND_TASK = {
     "gld160k": ("efficientnet-b0", "classification"),
     "femnist_gen": ("cnn", "classification"),
     "fed_cifar100_gen": ("resnet18_gn", "classification"),
+    "mnist_gen": ("lr", "classification"),
 }
 
 

@@ -476,6 +476,8 @@ def run_algo(args):
 
 
 def main(argv=None):
+    from fedml_tpu.utils import force_platform_from_env
+    force_platform_from_env()
     from fedml_tpu.experiments.main_fedavg import apply_ci_truncation
 
     parser = argparse.ArgumentParser("fedml_tpu fed_launch")

@@ -7,6 +7,9 @@ scale facts on the calibrated generated corpora (data/flagship_gen):
   (FederatedEMNIST/data_loader.py:15-17, benchmark/README.md:54)
 - fed-CIFAR100-shape: 500 clients, ResNet-18 GroupNorm, B=20
   (fed_cifar100/data_loader.py:17-19, benchmark/README.md:55)
+- MNIST-LR (``mnist_gen``): 1000 power-law clients, LR, ceiling 85% —
+  the reference's >75% anchor (benchmark/README.md:12) on the calibrated
+  corpus (run with ``--batch_size 10`` for the reference config)
 
 through the vmapped simulation (FedAvgAPI) AND the mesh driver
 (DistributedFedAvgAPI), with cohort packing, recording per-round accuracy
@@ -40,7 +43,8 @@ def _max_rss_mb() -> float:
 
 
 def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
-               eval_every: int, batch_size: int, lr: float, seed: int):
+               eval_every: int, batch_size: int, lr: float, seed: int,
+               eval_test_sub: int = None):
     """One driver end to end; returns (history, variables, stats)."""
     import jax
 
@@ -57,7 +61,8 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
         api = FedAvgAPI(ds, model, task=task, config=FedAvgConfig(
             comm_round=rounds, client_num_per_round=per_round,
             frequency_of_the_test=eval_every, seed=seed,
-            eval_train_subsample=2000, train=tcfg))
+            eval_train_subsample=2000, eval_test_subsample=eval_test_sub,
+            train=tcfg))
         api.train()
         phase = api.timer.means()
     else:
@@ -68,7 +73,9 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                                        comm_round=rounds,
                                        client_num_per_round=per_round,
                                        frequency_of_the_test=eval_every,
-                                       seed=seed, train=tcfg))
+                                       seed=seed,
+                                       eval_test_subsample=eval_test_sub,
+                                       train=tcfg))
         api.train()
         phase = {}
     jax.block_until_ready(api.variables)
@@ -84,7 +91,7 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
 def main(argv=None):
     p = argparse.ArgumentParser("fedml_tpu flagship_scale")
     p.add_argument("--dataset", required=True,
-                   choices=["femnist_gen", "fed_cifar100_gen"])
+                   choices=["femnist_gen", "fed_cifar100_gen", "mnist_gen"])
     p.add_argument("--clients", type=int, default=None,
                    help="default: the reference scale (3400 / 500)")
     p.add_argument("--rounds", type=int, default=60)
@@ -94,14 +101,21 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.03)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--drivers", type=str, default="sim,spmd")
+    p.add_argument("--eval_test_subsample", type=int, default=None,
+                   help="seeded test-union eval subsample (CPU fallback: "
+                        "full flagship test unions cost more than the "
+                        "rounds; recorded in summary.json)")
     p.add_argument("--out", type=str, required=True)
     args = p.parse_args(argv)
 
+    from fedml_tpu.utils import force_platform_from_env
+    force_platform_from_env()
     from fedml_tpu.core import pytree as pt
     from fedml_tpu.data.registry import DEFAULT_MODEL_AND_TASK, load_data
     from fedml_tpu.models import create_model
 
-    ref_scale = {"femnist_gen": 3400, "fed_cifar100_gen": 500}
+    ref_scale = {"femnist_gen": 3400, "fed_cifar100_gen": 500,
+                 "mnist_gen": 1000}
     clients = args.clients or ref_scale[args.dataset]
     ds = load_data(args.dataset, "", client_num_in_total=clients)
     model_name, task = DEFAULT_MODEL_AND_TASK[args.dataset]
@@ -121,13 +135,15 @@ def main(argv=None):
         "client_num_per_round": args.client_num_per_round,
         "batch_size": args.batch_size,
         "train_samples": ds.train_data_num,
+        "eval_test_subsample": args.eval_test_subsample,
     }
     results = {}
     for kind in drivers:
         model = create_model(model_name, output_dim=ds.class_num)
         hist, variables, stats = run_driver(
             kind, ds, model, task, args.rounds, args.client_num_per_round,
-            args.eval_every, args.batch_size, args.lr, args.seed)
+            args.eval_every, args.batch_size, args.lr, args.seed,
+            eval_test_sub=args.eval_test_subsample)
         with open(os.path.join(args.out, f"{kind}_history.jsonl"),
                   "w") as f:
             for rec in hist:

@@ -149,6 +149,8 @@ BACKEND_RUNNERS = {"simulation": run_simulation, "spmd": run_spmd,
 
 
 def main(argv=None):
+    from fedml_tpu.utils import force_platform_from_env
+    force_platform_from_env()
     parser = argparse.ArgumentParser("fedml_tpu fedavg")
     add_federated_args(parser)
     args = apply_ci_truncation(parser.parse_args(argv))

@@ -30,7 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fedml_tpu.core.sampling import round_keys, sample_clients
+from fedml_tpu.core.sampling import (eval_subsample, round_keys,
+                                     sample_clients)
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train)
@@ -289,6 +290,9 @@ class DistributedFedAvgConfig:
     # the sampled cohort's max — mesh-padded duplicate slots never raise the
     # max) or "global" (dataset-wide static shape)
     pack: str = "cohort"
+    # seeded test-union eval subsample, same stream as
+    # FedAvgConfig.eval_test_subsample so histories stay comparable
+    eval_test_subsample: Optional[int] = None
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     # model parallelism INSIDE each client slot: shard the model over a
     # second mesh axis — "tp" (Megatron, transformer models) or "fsdp"
@@ -378,6 +382,9 @@ class DistributedFedAvgAPI:
             return None
         if (self._eval_cache is None
                 or self._eval_cache[0] is not self.dataset):
+            xt, yt = eval_subsample(xt, yt,
+                                    self.config.eval_test_subsample,
+                                    self.config.seed)
             n = len(xt)
             n_pad = ((n + self.n_dev - 1) // self.n_dev) * self.n_dev
             pad = n_pad - n

@@ -1,1 +1,22 @@
 """Runtime utilities: checkpointing, metrics sinks, tracing."""
+
+import os
+
+
+def force_platform_from_env() -> None:
+    """Make ``JAX_PLATFORMS`` actually bind on this environment.
+
+    The hosting image's sitecustomize sets ``jax_platforms``
+    programmatically after the env var is read, silently overriding
+    ``JAX_PLATFORMS=cpu`` — a CLI run the operator believes is on CPU
+    then dials the (possibly wedged) TPU tunnel and blocks forever in a
+    TCP recv (observed live, round 4). Every CLI entrypoint calls this
+    before its first device use; tests do the equivalent in conftest.
+
+    No-op when the env var is unset: the normal TPU path stays default.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)

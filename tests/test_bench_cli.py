@@ -1,0 +1,129 @@
+"""bench.py wedge-recovery CLI: stage selection + partial merge.
+
+The bench runs on a tunnel that wedges mid-suite in practice (three
+rounds of evidence lost to it); --stages / --resume-partial let a
+revived window re-run only what a wedge cost. These tests cover the
+selection parser and the suite table it indexes — pure host-side logic,
+no device."""
+
+import json
+
+import pytest
+
+import bench
+
+
+def test_stage_table_keys_unique_and_ordered():
+    keys = [key for key, _, _, _ in bench._STAGES]
+    assert len(keys) == len(set(keys))
+    # the suite order is heaviest-evidence-first contract: headline
+    # before the long tail
+    assert keys[0] == "fedavg_femnist_cnn"
+
+
+def test_selection_none_without_flag():
+    assert bench._parse_stage_selection(["bench.py"]) is None
+
+
+def test_selection_by_key_and_alias():
+    got = bench._parse_stage_selection(["--stages=resnet,flash"])
+    assert got == {"resnet18_gn_fedcifar100", "transformer_flash_s2048"}
+    got = bench._parse_stage_selection(
+        ["--stages=fedavg_powerlaw_1000,tta_mnist"])
+    assert got == {"fedavg_powerlaw_1000", "time_to_target_mnist_lr"}
+
+
+def test_selection_smoke_alias():
+    assert bench._parse_stage_selection(["--stages=smoke"]) == {"smoke_chip"}
+
+
+def test_selection_rejects_unknown_token():
+    with pytest.raises(SystemExit):
+        bench._parse_stage_selection(["--stages=resnet,nope"])
+
+
+def test_every_alias_resolves():
+    for key, _, _, aliases in bench._STAGES:
+        for alias in aliases:
+            assert bench._parse_stage_selection([f"--stages={alias}"]) == \
+                {key}, alias
+
+
+def _utc(ts: float) -> str:
+    import time
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def test_resume_partial_runs_only_selected_and_merges(tmp_path, monkeypatch):
+    # end-to-end through main(): a prior wedge left smoke + headline rows;
+    # --resume-partial --stages=resnet must run ONLY resnet, keep the old
+    # rows, and pull the headline value from the resumed partial
+    import sys
+    import time
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "runs").mkdir()
+    now = _utc(time.time())
+    prior = {
+        "smoke_chip": {"rounds_per_sec": 1.0, "host": "tpu:x",
+                       "captured_at_utc": now},
+        "fedavg_femnist_cnn": {"rounds_per_sec": 5.0, "host": "tpu:x",
+                               "captured_at_utc": now},
+    }
+    (tmp_path / "runs" / "bench_partial.json").write_text(json.dumps(prior))
+    ran = []
+    monkeypatch.setattr(bench, "_probe_device",
+                        lambda timeout_s=0: {"backend": "cpu",
+                                             "device": "cpu"})
+    monkeypatch.setattr(bench, "_STAGES", (
+        ("resnet18_gn_fedcifar100", "resnet",
+         lambda: ran.append("resnet") or {"rounds_per_sec": 2.0},
+         ("resnet",)),
+        ("fedavg_powerlaw_1000", "powerlaw",
+         lambda: ran.append("powerlaw") or {"rounds_per_sec": 3.0},
+         ("powerlaw",)),
+    ))
+    monkeypatch.setattr(bench, "bench_torch_baseline", lambda: 1.0)
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--stages=resnet", "--resume-partial"])
+    bench.main()
+    assert ran == ["resnet"]  # powerlaw not selected, smoke not re-run
+    with open("runs/bench_partial.json") as f:
+        merged = json.load(f)
+    assert merged["smoke_chip"]["rounds_per_sec"] == 1.0
+    assert merged["resnet18_gn_fedcifar100"]["rounds_per_sec"] == 2.0
+    assert "fedavg_powerlaw_1000" not in merged
+    with open("runs/bench_details.json") as f:
+        line = json.load(f)
+    assert line["value"] == 5.0  # headline carried from the resumed rows
+
+
+def test_probe_failure_carries_only_fresh_chip_rows(tmp_path, monkeypatch):
+    # dead tunnel at emit time: rows captured live this round (fresh
+    # captured_at_utc, host=tpu) are carried as the headline; rows from an
+    # old session, without a stamp, or cpu-tagged are NOT
+    import sys
+    import time
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "runs").mkdir()
+    prior = {
+        "fedavg_femnist_cnn": {"rounds_per_sec": 7.0, "host": "tpu:x",
+                               "captured_at_utc": _utc(time.time() - 60)},
+        "resnet18_gn_fedcifar100": {"rounds_per_sec": 9.0, "host": "tpu:x",
+                                    "captured_at_utc":
+                                        _utc(time.time() - 48 * 3600)},
+        "fedavg_powerlaw_1000": {"rounds_per_sec": 4.0, "host": "tpu:x"},
+        "time_to_target_acc": {"rounds_per_sec": 2.0, "host": "cpu-smoke",
+                               "captured_at_utc": _utc(time.time() - 60)},
+    }
+    (tmp_path / "runs" / "bench_partial.json").write_text(json.dumps(prior))
+    monkeypatch.setattr(bench, "_probe_device",
+                        lambda timeout_s=0: {"error": "tunnel stalled"})
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    with open("runs/bench_details.json") as f:
+        line = json.load(f)
+    assert line["value"] == 7.0
+    carried = line["extra"]["chip_capture"]
+    assert set(carried) == {"fedavg_femnist_cnn"}

@@ -91,6 +91,19 @@ class TestRegistryWiring:
         ds = load_data("fed_cifar100_gen", "", client_num_in_total=4)
         assert ds.client_num == 4
 
+    def test_mnist_gen_is_calibrated_and_cli_paired(self):
+        # the third anchor (MNIST+LR >75%, benchmark/README.md:12) is
+        # registry-reachable with the 85% ceiling ON by default
+        from fedml_tpu.data.registry import DEFAULT_MODEL_AND_TASK, load_data
+        ds = load_data("mnist_gen", "", client_num_in_total=6)
+        assert ds.client_num == 6 and ds.class_num == 10
+        assert DEFAULT_MODEL_AND_TASK["mnist_gen"] == ("lr",
+                                                       "classification")
+        from fedml_tpu.data.leaf_gen import build_leaf_mnist_federation
+        legacy = build_leaf_mnist_federation(client_num=6, seed=0)
+        assert not np.array_equal(ds.train_data_global[1],
+                                  legacy.train_data_global[1])
+
 
 class TestLeafGenCalibration:
     def test_target_acc_none_is_bit_identical_to_legacy(self):
