@@ -77,7 +77,7 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                                        eval_test_subsample=eval_test_sub,
                                        train=tcfg))
         api.train()
-        phase = {}
+        phase = api.timer.means()
     jax.block_until_ready(api.variables)
     stats = {
         "wall_s": round(time.time() - t0, 2),
@@ -107,6 +107,9 @@ def main(argv=None):
                         "rounds; recorded in summary.json)")
     p.add_argument("--out", type=str, required=True)
     args = p.parse_args(argv)
+
+    import logging
+    logging.basicConfig(level=logging.INFO)  # per-round eval records
 
     from fedml_tpu.utils import force_platform_from_env
     force_platform_from_env()

@@ -369,6 +369,8 @@ class DistributedFedAvgAPI:
         if self._shard_params is not None:  # place into the TP/FSDP layout
             self.variables = self._shard_params(self.variables)
         self.history: List[Dict] = []
+        from fedml_tpu.utils.tracing import RoundTimer
+        self.timer = RoundTimer()  # pack/dispatch means, as FedAvgAPI
         # same-cohort device cache as FedAvgAPI._pack_cache: full
         # participation re-samples the identical set each round, so the
         # sharded x/y/mask/weights can stay resident across rounds
@@ -415,31 +417,34 @@ class DistributedFedAvgAPI:
                               cfg.client_num_per_round)
         put = lambda a: jax.device_put(a, self._data_sharding)
         cohort = tuple(int(i) for i in idxs)
-        if (self._pack_cache is not None
-                and self._pack_cache[0] is self.dataset
-                and self._pack_cache[1] == cohort):
-            padded, xd, yd, maskd, wd = self._pack_cache[2]
-        else:
-            self._pack_cache = None
-            padded, alive = self._pad_round(np.asarray(idxs))
-            n_pad = (self.dataset.cohort_padded_len(padded,
-                                                    cfg.train.batch_size)
-                     if cfg.pack == "cohort" else self._n_pad)
-            x, y, mask = self.dataset.pack_clients(
-                padded, cfg.train.batch_size, n_pad=n_pad)
-            mask = mask * alive[:, None]
-            weights = self.dataset.client_weights(padded) * alive
-            xd, yd, maskd, wd = (put(jnp.asarray(x)), put(jnp.asarray(y)),
-                                 put(jnp.asarray(mask)),
-                                 put(jnp.asarray(weights)))
-            if len(idxs) == self.dataset.client_num:
-                self._pack_cache = (self.dataset, cohort,
-                                    (padded, xd, yd, maskd, wd))
-        _, keys, _ = round_keys(
-            self._base_key, round_idx,
-            jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
-        self.variables, stats = self._round_fn(
-            self.variables, xd, yd, maskd, put(keys), wd)
+        with self.timer.phase("pack"):
+            if (self._pack_cache is not None
+                    and self._pack_cache[0] is self.dataset
+                    and self._pack_cache[1] == cohort):
+                padded, xd, yd, maskd, wd = self._pack_cache[2]
+            else:
+                self._pack_cache = None
+                padded, alive = self._pad_round(np.asarray(idxs))
+                n_pad = (self.dataset.cohort_padded_len(
+                    padded, cfg.train.batch_size)
+                    if cfg.pack == "cohort" else self._n_pad)
+                x, y, mask = self.dataset.pack_clients(
+                    padded, cfg.train.batch_size, n_pad=n_pad)
+                mask = mask * alive[:, None]
+                weights = self.dataset.client_weights(padded) * alive
+                xd, yd, maskd, wd = (put(jnp.asarray(x)),
+                                     put(jnp.asarray(y)),
+                                     put(jnp.asarray(mask)),
+                                     put(jnp.asarray(weights)))
+                if len(idxs) == self.dataset.client_num:
+                    self._pack_cache = (self.dataset, cohort,
+                                        (padded, xd, yd, maskd, wd))
+        with self.timer.phase("dispatch"):
+            _, keys, _ = round_keys(
+                self._base_key, round_idx,
+                jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
+            self.variables, stats = self._round_fn(
+                self.variables, xd, yd, maskd, put(keys), wd)
         return idxs, stats
 
     def run_rounds_fused(self, r0: int, rounds: int):
