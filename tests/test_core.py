@@ -102,6 +102,27 @@ class TestSampling:
             assert 7 not in got
             assert len(got) == 10
 
+    def test_eval_subsample_shared_formula(self):
+        # sim and mesh drivers must score the IDENTICAL subset: the helper
+        # is deterministic in (len, limit, seed) and a no-op when the
+        # limit already covers the set
+        from fedml_tpu.core.sampling import eval_subsample
+        x = np.arange(100, dtype=np.float32).reshape(50, 2)
+        y = np.arange(50, dtype=np.int32)
+        xa, ya = eval_subsample(x, y, 10, seed=3)
+        xb, yb = eval_subsample(x, y, 10, seed=3)
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+        assert len(xa) == 10 and len(ya) == 10
+        # rows stay paired
+        np.testing.assert_array_equal(xa[:, 0].astype(np.int32), ya * 2)
+        xc, yc = eval_subsample(x, y, None, seed=3)
+        assert xc is x and yc is y
+        xd, yd = eval_subsample(x, y, 50, seed=3)
+        assert xd is x and yd is y
+        xe, _ = eval_subsample(x, y, 10, seed=4)
+        assert not np.array_equal(xa, xe)
+
 
 class TestPartition:
     def test_dirichlet_partition_properties(self):
