@@ -38,6 +38,11 @@ from fedml_tpu.core.sampling import (DEVICE_SAMPLE_SENTINEL, eval_subsample,
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train)
+
+#: per-round heartbeat for long host loops (the eval records land only every
+#: frequency_of_the_test rounds, which leaves multi-minute CPU rounds
+#: invisible); scoped to its own logger so callers can silence it alone
+_progress_log = logging.getLogger("fedml_tpu.progress")
 def make_vmapped_body(local_train):
     """vmap local training over the client axis and sum stats — the shared
     round body every FedAvg-family algorithm composes with its own
@@ -233,6 +238,12 @@ class FedAvgAPI:
         t0 = time.time()
         for round_idx in range(cfg.comm_round):
             _, train_stats = self.run_round(round_idx)
+            # dispatch is an async enqueue; the wall clock here still tracks
+            # real progress because the host blocks once the device queue
+            # fills (and at every eval)
+            _progress_log.info("round %d/%d dispatched (wall %.1fs)",
+                               round_idx + 1, cfg.comm_round,
+                               time.time() - t0)
             last = round_idx == cfg.comm_round - 1
             if round_idx % cfg.frequency_of_the_test == 0 or last:
                 with self.timer.phase("eval"):

@@ -34,6 +34,10 @@ ceiling.
 
 from __future__ import annotations
 
+import hashlib
+import logging
+import os
+
 import numpy as np
 
 
@@ -87,10 +91,77 @@ def _class_prototypes(rng: np.random.RandomState, class_num: int, hw: int,
     return protos
 
 
+#: bump when _build/_class_prototypes/apply_label_noise change generated
+#: CONTENT — the cache key must reflect the algorithm, not only its params
+_GEN_VERSION = 1
+
+
+def _cache_path(key_parts) -> str:
+    """Content-keyed npz path for a generated federation. Generation costs
+    minutes of host CPU at flagship scale (3400 clients x ~160 images of
+    randn); a short TPU-tunnel live window cannot afford to pay it, so
+    every build lands in a cache keyed by ALL content-determining params.
+    Override the location with ``FEDML_GEN_CACHE``; empty string disables."""
+    root = os.environ.get(
+        "FEDML_GEN_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "fedml_tpu_gen"))
+    if not root:
+        return ""
+    digest = hashlib.sha1(
+        "|".join(str(p) for p in (_GEN_VERSION,) + tuple(key_parts))
+        .encode()).hexdigest()[:16]
+    return os.path.join(root, f"gen_{digest}.npz")
+
+
+def _load_cached(path: str):
+    from fedml_tpu.data.base import FederatedDataset
+
+    with np.load(path) as z:
+        class_num = int(z["class_num"])
+        tr_off, te_off = z["tr_off"], z["te_off"]
+        xtr, ytr, xte, yte = z["xtr"], z["ytr"], z["xte"], z["yte"]
+    train_local = {i: (xtr[tr_off[i]:tr_off[i + 1]],
+                       ytr[tr_off[i]:tr_off[i + 1]])
+                   for i in range(len(tr_off) - 1)}
+    test_local = {i: (xte[te_off[i]:te_off[i + 1]],
+                      yte[te_off[i]:te_off[i + 1]])
+                  for i in range(len(te_off) - 1)}
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               class_num)
+
+
+def _save_cache(path: str, train_local, test_local, class_num: int):
+    clients = sorted(train_local)
+    tr_sizes = [len(train_local[c][0]) for c in clients]
+    te_sizes = [len(test_local[c][0]) for c in clients]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp.npz"  # .npz suffix: savez appends it otherwise
+    np.savez(tmp,
+             class_num=np.int64(class_num),
+             tr_off=np.cumsum([0] + tr_sizes),
+             te_off=np.cumsum([0] + te_sizes),
+             xtr=np.concatenate([train_local[c][0] for c in clients]),
+             ytr=np.concatenate([train_local[c][1] for c in clients]),
+             xte=np.concatenate([test_local[c][0] for c in clients]),
+             yte=np.concatenate([test_local[c][1] for c in clients]))
+    os.replace(tmp, path)
+
+
 def _build(client_num: int, class_num: int, hw: int, chans: int,
            sizes: np.ndarray, seed: int, noise: float,
            label_noise_p: float, test_fraction: float, dominant: int = 2):
     from fedml_tpu.data.base import FederatedDataset
+
+    cache = _cache_path((client_num, class_num, hw, chans, seed, noise,
+                         round(label_noise_p, 9), test_fraction, dominant,
+                         hashlib.sha1(np.ascontiguousarray(sizes)
+                                      .tobytes()).hexdigest()))
+    if cache and os.path.exists(cache):
+        try:
+            return _load_cached(cache)
+        except Exception as exc:  # noqa: BLE001 — fall through to regenerate
+            logging.warning("gen cache %s unreadable (%s); regenerating",
+                            cache, exc)
 
     rng = np.random.RandomState(seed)
     protos = _class_prototypes(rng, class_num, hw, chans)
@@ -108,6 +179,13 @@ def _build(client_num: int, class_num: int, hw: int, chans: int,
         n_test = max(1, int(n * test_fraction))
         test_local[i] = (x[:n_test], y[:n_test])
         train_local[i] = (x[n_test:], y[n_test:])
+    if cache:
+        try:
+            _save_cache(cache, train_local, test_local, class_num)
+        except Exception as exc:  # noqa: BLE001 — the cache is a pure
+            # optimization; a failed save (OSError, MemoryError on the
+            # full-federation concatenate, ...) must never fail the build
+            logging.warning("gen cache %s not saved (%s)", cache, exc)
     return FederatedDataset.from_client_arrays(train_local, test_local,
                                                class_num)
 

@@ -161,9 +161,15 @@ def _fed_cifar100_gen(data_dir, **kw):
 
 def _mnist_gen(data_dir, **kw):
     from fedml_tpu.data.leaf_gen import build_leaf_mnist_federation
+    # noise=1.2 makes the >75% anchor (benchmark/README.md:12) cross after
+    # ~65 rounds and plateau ~0.83 under the calibrated 85% ceiling —
+    # matching the reference's ">100 rounds" curve shape instead of
+    # saturating by round 10 (measured sweep: noise 0.25 crosses <10,
+    # 0.6 ~18, 1.0 ~38, 1.2 ~67 rounds)
     return build_leaf_mnist_federation(
         client_num=kw.get("client_num_in_total", 1000),
-        target_acc=kw.get("target_acc", 0.85))
+        target_acc=kw.get("target_acc", 0.85),
+        noise=kw.get("noise", 1.2))
 
 
 def _landmarks(data_dir, **kw):

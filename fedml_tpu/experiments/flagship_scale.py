@@ -42,9 +42,46 @@ def _max_rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+def _incremental_history(api, path: str, period_s: float = 20.0):
+    """Background flusher: append new ``api.history`` records to ``path`` as
+    they land, so a killed or tunnel-wedged run keeps every eval record
+    captured so far (the summary write at the end only ever adds the final
+    stats). Returns a stop() that does the final flush."""
+    import threading
+
+    state = {"written": 0}
+    lock = threading.Lock()  # stop()'s final flush can race a slow in-flight
+    # periodic flush (join timeout) — serialize so records never duplicate
+
+    def flush():
+        with lock:
+            recs = api.history
+            if len(recs) > state["written"]:
+                with open(path, "a") as f:
+                    for rec in recs[state["written"]:]:
+                        f.write(json.dumps(rec) + "\n")
+                state["written"] = len(recs)
+
+    stop_evt = threading.Event()
+
+    def loop():
+        while not stop_evt.wait(period_s):
+            flush()
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def stop():
+        stop_evt.set()
+        t.join(timeout=5)
+        flush()
+
+    return stop
+
+
 def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                eval_every: int, batch_size: int, lr: float, seed: int,
-               eval_test_sub: int = None):
+               eval_test_sub: int = None, history_path: str = None):
     """One driver end to end; returns (history, variables, stats)."""
     import jax
 
@@ -63,8 +100,6 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
             frequency_of_the_test=eval_every, seed=seed,
             eval_train_subsample=2000, eval_test_subsample=eval_test_sub,
             train=tcfg))
-        api.train()
-        phase = api.timer.means()
     else:
         from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
                                              DistributedFedAvgConfig)
@@ -76,8 +111,13 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                                        seed=seed,
                                        eval_test_subsample=eval_test_sub,
                                        train=tcfg))
+    stop_flush = (_incremental_history(api, history_path)
+                  if history_path else lambda: None)
+    try:
         api.train()
-        phase = api.timer.means()
+    finally:
+        stop_flush()
+    phase = api.timer.means()
     jax.block_until_ready(api.variables)
     stats = {
         "wall_s": round(time.time() - t0, 2),
@@ -143,14 +183,19 @@ def main(argv=None):
     results = {}
     for kind in drivers:
         model = create_model(model_name, output_dim=ds.class_num)
+        hist_path = os.path.join(args.out, f"{kind}_history.jsonl")
+        if os.path.exists(hist_path) and os.path.getsize(hist_path):
+            # a previous attempt (e.g. tunnel-wedged mid-run) left partial
+            # evidence — keep it instead of truncating over it
+            n = 1
+            while os.path.exists(f"{hist_path}.prev{n}"):
+                n += 1
+            os.replace(hist_path, f"{hist_path}.prev{n}")
+        open(hist_path, "w").close()  # incremental flusher appends
         hist, variables, stats = run_driver(
             kind, ds, model, task, args.rounds, args.client_num_per_round,
             args.eval_every, args.batch_size, args.lr, args.seed,
-            eval_test_sub=args.eval_test_subsample)
-        with open(os.path.join(args.out, f"{kind}_history.jsonl"),
-                  "w") as f:
-            for rec in hist:
-                f.write(json.dumps(rec) + "\n")
+            eval_test_sub=args.eval_test_subsample, history_path=hist_path)
         results[kind] = (hist, variables)
         summary[kind] = {**stats,
                          "final": hist[-1] if hist else {}}

@@ -564,8 +564,11 @@ class DistributedFedAvgAPI:
         sampling and per-client RNG are (seed, round)-derived, so restarting
         from ``(round_idx, variables)`` is bit-identical to never stopping
         (utils/checkpoint.py)."""
-        from fedml_tpu.algorithms.fedavg import _normalized
+        import time
+
+        from fedml_tpu.algorithms.fedavg import _normalized, _progress_log
         cfg = self.config
+        t0 = time.time()
         start = 0
         if checkpoint_mgr is not None and resume:
             restored = checkpoint_mgr.restore_latest(
@@ -576,6 +579,9 @@ class DistributedFedAvgAPI:
                 start = meta["round_idx"]
         for round_idx in range(start, cfg.comm_round):
             _, stats = self.run_round(round_idx)
+            _progress_log.info("round %d/%d dispatched (wall %.1fs)",
+                               round_idx + 1, cfg.comm_round,
+                               time.time() - t0)
             last = round_idx == cfg.comm_round - 1
             if round_idx % cfg.frequency_of_the_test == 0 or last:
                 rec = {"round": round_idx,

@@ -1,33 +1,104 @@
 #!/bin/bash
-# 20-min TPU probe cadence (VERDICT r3 #3). On a live window, immediately
-# run ONLY the chip stages still missing (fused composition is the r3 #1
-# contract number), merging next to already-captured rows.
+# 20-min TPU probe cadence (VERDICT r3 #3). On a live window, capture in
+# order of unique evidence value:
+#   1. bench --stages=fused,fused_device   (the r3 #1 composed-lever contract)
+#   2. femnist flagship at reference scale ON CHIP (1500-round TTA curve)
+#   3. remaining bench stages (axes, tta rows)
+#   4. fed_cifar100 + mnist flagships on chip
+# Every step persists incrementally (bench_partial.json / *_history.jsonl —
+# flagship_scale preserves partial history across retries), and steps are
+# attempted independently each window: a step that keeps timing out cannot
+# starve the ones after it. After any failed step the tunnel is re-probed
+# and the window is abandoned if dead.
 cd /root/repo || exit 1
 LOG=runs/tpu_probe_r4.log
-TARGET_STAGES="fused,fused_device,axes,tta_mnist,tta"
-while true; do
-  # stop once every target stage carries a tpu host tag
-  python3 - <<'EOF' && break
+
+probe() {  # $1 = timeout; exit 0 when the tunnel answers with a tpu backend
+  local out
+  out=$(timeout "$1" python3 -c "import os,jax; p=os.environ.get('JAX_PLATFORMS'); p and jax.config.update('jax_platforms', p); print(jax.default_backend(), jax.devices()[0].device_kind)" 2>&1)
+  [ $? -eq 0 ] && echo "$out" | grep -q tpu
+}
+
+bench_done() {  # $@ = partial keys; exit 0 when all tpu-tagged
+  python3 - "$@" <<'EOF'
 import json, sys
-d = json.load(open("runs/bench_partial.json"))
-keys = ["fedavg_fused_rounds", "fedavg_fused_device_sampling",
-        "federated_parallel_axes", "time_to_target_mnist_lr",
-        "time_to_target_acc"]
-done = all(str(d.get(k, {}).get("host", "")).startswith("tpu") for k in keys)
-sys.exit(0 if done else 1)
+try:
+    d = json.load(open("runs/bench_partial.json"))
+except Exception:
+    sys.exit(1)
+ok = all(str(d.get(k, {}).get("host", "")).startswith("tpu")
+         for k in sys.argv[1:])
+sys.exit(0 if ok else 1)
 EOF
+}
+
+bench_step() {  # $1 = --stages list
+  FEDML_BENCH_TOTAL_TIMEOUT_S=900 timeout 1000 \
+    python3 bench.py "--stages=$1" --resume-partial \
+    >> runs/bench_r4_live.log 2>&1
+  local rc=$?
+  echo "$(date -u +%FT%TZ) bench --stages=$1 exited rc=$rc" >> "$LOG"
+  return $rc
+}
+
+flagship() {  # $1 dataset, $2 out dir, $3 rounds, $4 eval_every, extra args...
+  local ds=$1 out=$2 rounds=$3 ev=$4; shift 4
+  echo "$(date -u +%FT%TZ) chip flagship $ds rounds=$rounds -> $out" >> "$LOG"
+  timeout 540 python3 -m fedml_tpu.experiments.flagship_scale \
+    --dataset "$ds" --rounds "$rounds" --eval_every "$ev" \
+    --eval_test_subsample 10000 "$@" --out "$out" \
+    >> "runs/${out##*/}.log" 2>&1
+  local rc=$?
+  echo "$(date -u +%FT%TZ) chip flagship $ds exited rc=$rc" >> "$LOG"
+  return $rc
+}
+
+all_done() {
+  bench_done fedavg_fused_rounds fedavg_fused_device_sampling \
+             federated_parallel_axes time_to_target_mnist_lr \
+             time_to_target_acc || return 1
+  [ -f runs/flagship_femnist_tpu/summary.json ] || return 1
+  [ -f runs/flagship_fedcifar100_tpu/summary.json ] || return 1
+  [ -f runs/flagship_mnist_lr_tpu/summary.json ] || return 1
+  return 0
+}
+
+window_over() {  # after a failed step: quick re-probe, abandon if dead
+  if probe 30; then return 1; fi
+  echo "$(date -u +%FT%TZ) tunnel dead on re-probe — window over" >> "$LOG"
+  return 0
+}
+
+while true; do
+  all_done && break
   ts=$(date -u +%FT%TZ)
-  out=$(timeout 60 python3 -c "import os,jax; p=os.environ.get('JAX_PLATFORMS'); p and jax.config.update('jax_platforms', p); print(jax.default_backend(), jax.devices()[0].device_kind)" 2>&1)
-  rc=$?
-  if [ $rc -eq 0 ] && echo "$out" | grep -q tpu; then
-    echo "$ts probe LIVE ($out) — running bench --stages=$TARGET_STAGES" >> "$LOG"
-    FEDML_BENCH_TOTAL_TIMEOUT_S=1500 timeout 1800 \
-      python3 bench.py "--stages=$TARGET_STAGES" --resume-partial \
-      >> runs/bench_r4_live.log 2>&1
-    echo "$(date -u +%FT%TZ) bench stage run exited rc=$?" >> "$LOG"
+  if probe 60; then
+    echo "$ts probe LIVE — capture sequence starts" >> "$LOG"
+    while true; do  # single-pass step list; break = end of window
+      if ! bench_done fedavg_fused_rounds fedavg_fused_device_sampling; then
+        bench_step fused,fused_device || { window_over && break; }
+      fi
+      if [ ! -f runs/flagship_femnist_tpu/summary.json ]; then
+        flagship femnist_gen runs/flagship_femnist_tpu 1500 100 \
+          || { window_over && break; }
+      fi
+      if ! bench_done federated_parallel_axes time_to_target_mnist_lr \
+                      time_to_target_acc; then
+        bench_step axes,tta_mnist,tta || { window_over && break; }
+      fi
+      if [ ! -f runs/flagship_fedcifar100_tpu/summary.json ]; then
+        flagship fed_cifar100_gen runs/flagship_fedcifar100_tpu 4000 250 \
+          || { window_over && break; }
+      fi
+      if [ ! -f runs/flagship_mnist_lr_tpu/summary.json ]; then
+        flagship mnist_gen runs/flagship_mnist_lr_tpu 200 10 \
+          --batch_size 10 --lr 0.03 || { window_over && break; }
+      fi
+      break
+    done
   else
-    echo "$ts probe HUNG/DEAD rc=$rc (${out:0:80})" >> "$LOG"
+    echo "$ts probe HUNG/DEAD" >> "$LOG"
   fi
   sleep 1200
 done
-echo "$(date -u +%FT%TZ) probe loop: all target stages chip-captured — exiting" >> "$LOG"
+echo "$(date -u +%FT%TZ) probe loop: ALL chip targets captured — exiting" >> "$LOG"

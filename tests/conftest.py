@@ -18,6 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
 # parity/equivalence tests need f32 math, not TPU-default bf16 matmuls
 os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
+# isolate the generated-federation disk cache (data/flagship_gen): tests
+# must exercise the generators, never a stale ~/.cache hit from older code
+import tempfile  # noqa: E402
+
+_gen_cache_dir = tempfile.TemporaryDirectory(prefix="fedml_gen_cache_test_")
+os.environ["FEDML_GEN_CACHE"] = _gen_cache_dir.name
+
 import pytest  # noqa: E402
 
 # the environment's axon plugin (sitecustomize) sets jax_platforms

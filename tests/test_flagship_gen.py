@@ -125,3 +125,55 @@ class TestLeafGenCalibration:
                               c.train_data_global[0])
         assert not np.array_equal(a.train_data_global[1],
                                   c.train_data_global[1])
+
+
+class TestGenCache:
+    def test_cache_round_trip_is_identical(self, tmp_path, monkeypatch):
+        # chip-window runs load from cache (generation costs minutes at
+        # flagship scale); the cached federation must be exactly the
+        # generated one, client by client
+        monkeypatch.setenv("FEDML_GEN_CACHE", str(tmp_path))
+        a = build_femnist_federation(client_num=5)
+        import os
+        files = os.listdir(tmp_path)
+        assert len(files) == 1 and files[0].endswith(".npz")
+        b = build_femnist_federation(client_num=5)
+        assert a.train_data_local_num_dict == b.train_data_local_num_dict
+        for c in range(5):
+            assert np.array_equal(a.train_data_local_dict[c][0],
+                                  b.train_data_local_dict[c][0])
+            assert np.array_equal(a.train_data_local_dict[c][1],
+                                  b.train_data_local_dict[c][1])
+            assert np.array_equal(a.test_data_local_dict[c][0],
+                                  b.test_data_local_dict[c][0])
+        assert a.class_num == b.class_num
+        assert a.test_data_num == b.test_data_num
+
+    def test_cache_key_separates_configs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FEDML_GEN_CACHE", str(tmp_path))
+        a = build_femnist_federation(client_num=5)
+        b = build_femnist_federation(client_num=5, seed=1)
+        import os
+        assert len(os.listdir(tmp_path)) == 2
+        assert not np.array_equal(a.train_data_global[0],
+                                  b.train_data_global[0])
+
+    def test_cache_disabled_by_empty_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FEDML_GEN_CACHE", "")
+        # HOME redirected so a regression to the default root is visible
+        monkeypatch.setenv("HOME", str(tmp_path))
+        build_femnist_federation(client_num=3)
+        import os
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), ".cache", "fedml_tpu_gen"))
+
+    def test_corrupt_cache_regenerates(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FEDML_GEN_CACHE", str(tmp_path))
+        a = build_femnist_federation(client_num=4)
+        import os
+        path = os.path.join(tmp_path, os.listdir(tmp_path)[0])
+        with open(path, "wb") as f:
+            f.write(b"not an npz")
+        b = build_femnist_federation(client_num=4)
+        assert np.array_equal(a.train_data_global[0],
+                              b.train_data_global[0])
