@@ -51,6 +51,8 @@ SLOW_MODULES = {
 }
 
 SLOW_TESTS = {
+    "test_spmd.py::TestCnnParityPerRound::"
+    "test_cnn_dropout_round_matches_sim_to_f32_rounding",
     "test_fedavg.py::TestFedAvgEndToEnd::test_cnn_on_image_federation",
     "test_fedavg.py::TestFedAvgEndToEnd::test_learns_blobs_with_sampling",
     "test_fedavg.py::TestCentralizedEquivalence::"
