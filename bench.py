@@ -810,6 +810,12 @@ def _fresh_chip_rows(partial: dict, max_age_s: float = 18 * 3600) -> dict:
         if not (isinstance(row, dict)
                 and str(row.get("host", "")).startswith("tpu")):
             continue
+        if "error" in row or "skipped" in row:
+            # staged() stamps host/captured_at_utc on every dict,
+            # including timeout/error rows — those are not evidence
+            # (ADVICE r4: a timed-out headline must not be carried as a
+            # fresh-capture 0.0)
+            continue
         import calendar
         try:
             # timegm, not mktime: the stamp is UTC (mktime would apply the
@@ -842,7 +848,33 @@ def _emit(line: dict) -> None:
     print(json.dumps(line), flush=True)
 
 
-def _arm_global_watchdog(deadline_s: int, partial: dict) -> None:
+def _label_resumed(partial: dict, ran_now: set) -> dict:
+    """Copy of ``partial`` with every row NOT produced by this invocation
+    labeled ``resumed: true`` (ADVICE r4: old per-stage evidence must never
+    masquerade as this run's). Rows this invocation ran are passed through
+    untouched."""
+    return {key: ({**row, "resumed": True}
+                  if key not in ran_now and isinstance(row, dict) else row)
+            for key, row in partial.items()}
+
+
+def _headline_provenance(flagship: dict, ran_now: set) -> dict:
+    """Top-level flags for an emit whose ``value`` comes from a resumed
+    headline row: ``resumed: true`` always, plus a freshness verdict (the
+    18h ``_fresh_chip_rows`` window) so a consumer reading only the flat
+    fields sees that the number is not this invocation's capture."""
+    if "fedavg_femnist_cnn" in ran_now or not flagship:
+        return {}
+    fresh = bool(_fresh_chip_rows({"fedavg_femnist_cnn": flagship}))
+    window_h = float(os.environ.get("FEDML_BENCH_CARRY_MAX_AGE_S",
+                                    18 * 3600)) / 3600.0
+    return {"resumed": True,
+            "headline_freshness": (f"chip-fresh(<{window_h:g}h)" if fresh
+                                   else "stale-or-non-chip")}
+
+
+def _arm_global_watchdog(deadline_s: int, partial: dict,
+                         ran_now: set) -> None:
     """Last line of defense: a daemon thread that force-exits the process
     if the whole suite overruns. SIGALRM cannot interrupt a main thread
     wedged inside the native device client (observed live), but a sibling
@@ -851,18 +883,28 @@ def _arm_global_watchdog(deadline_s: int, partial: dict) -> None:
     import threading
 
     def fire():
-        _log(f"GLOBAL TIMEOUT after {deadline_s}s — emitting partial line")
-        flagship = partial.get("fedavg_femnist_cnn") or {}
-        _emit({
-            "metric": "fedavg_rounds_per_sec_femnist_cnn",
-            "value": flagship.get("rounds_per_sec", 0.0),
-            "unit": "rounds/s",
-            "vs_baseline": None,
-            "extra": {**partial,
-                      "error": f"global bench timeout after {deadline_s}s "
-                               "(device stalled mid-suite)"},
-        })
-        os._exit(1)
+        try:
+            _log(f"GLOBAL TIMEOUT after {deadline_s}s — emitting partial "
+                 "line")
+            # snapshot first: the main thread's staged() may insert keys
+            # concurrently and a mid-iteration RuntimeError here would
+            # defeat the force-exit
+            snap = dict(partial)
+            labeled = _label_resumed(snap, ran_now)
+            flagship = labeled.get("fedavg_femnist_cnn") or {}
+            _emit({
+                "metric": "fedavg_rounds_per_sec_femnist_cnn",
+                "value": flagship.get("rounds_per_sec", 0.0),
+                "unit": "rounds/s",
+                "vs_baseline": None,
+                **_headline_provenance(flagship, ran_now),
+                "extra": {**labeled,
+                          "error": f"global bench timeout after "
+                                   f"{deadline_s}s "
+                                   "(device stalled mid-suite)"},
+            })
+        finally:
+            os._exit(1)
 
     t = threading.Timer(deadline_s, fire)
     t.daemon = True
@@ -970,18 +1012,26 @@ def main():
         # and the probe failure all travel in extra.
         _log(f"device probe failed: {info['error']}")
         carried = _fresh_chip_rows(_load_partial())
+        headline_carried = "fedavg_femnist_cnn" in carried
         headline = carried.get("fedavg_femnist_cnn", {}).get(
             "rounds_per_sec", 0.0)
+        # ADVICE r4 (medium): `carried: true` travels at top level whenever
+        # the value is a prior invocation's capture, and value_source is
+        # attached ONLY when the headline row itself is in the carried set —
+        # a carried set lacking the headline must read as value 0.0 with no
+        # fresh-capture claim.
         _emit({"metric": "fedavg_rounds_per_sec_femnist_cnn",
                "value": headline,
                "unit": "rounds/s", "vs_baseline": None,
+               **({"carried": True} if headline_carried else {}),
                "extra": {"error": info["error"],
                          **({"value_source":
                              "chip stages captured live earlier this round "
                              "before the tunnel wedged (per-row "
                              "captured_at_utc; <18h old, "
-                             "runs/bench_partial.json)",
-                             "chip_capture": carried} if carried else {})}})
+                             "runs/bench_partial.json)"}
+                            if headline_carried else {}),
+                         **({"chip_capture": carried} if carried else {})}})
         return 0
     _log(f"backend={info['backend']} device={info['device']!r}")
     # every row carries where it ran, so chip numbers can never be
@@ -996,15 +1046,22 @@ def main():
         # rows from bench_partial.json would destroy exactly the evidence
         # the flag exists to recover.
         partial = _load_partial()
+    ran_now: set = set()
     _arm_global_watchdog(
-        int(os.environ.get("FEDML_BENCH_TOTAL_TIMEOUT_S", 2400)), partial)
+        int(os.environ.get("FEDML_BENCH_TOTAL_TIMEOUT_S", 2400)), partial,
+        ran_now)
 
     def staged(key, name, fn):
         out = _run(name, fn)
         if isinstance(out, dict):
-            out.setdefault("host", host_tag)
-            out.setdefault("captured_at_utc", time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            if "error" not in out and "skipped" not in out:
+                # host/captured_at_utc are evidence stamps; error rows
+                # are not evidence (ADVICE r4)
+                out.setdefault("host", host_tag)
+                out.setdefault("captured_at_utc", time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            out.pop("resumed", None)  # re-run supersedes a resumed copy
+        ran_now.add(key)
         partial[key] = out
         _persist_partial(partial)
         return partial[key]
@@ -1045,22 +1102,30 @@ def main():
         if selected is not None and key not in selected:
             continue
         if bailed:
-            partial.setdefault(key, {"skipped": "tunnel dead mid-suite"})
-            _persist_partial(partial)
+            if key not in partial:
+                partial[key] = {"skipped": "tunnel dead mid-suite"}
+                ran_now.add(key)  # this run's own marker, not resumed
+                _persist_partial(partial)
             continue
         out = staged(key, name, fn)
         bailed = tunnel_died(out)
 
-    flagship = partial.get("fedavg_femnist_cnn", {})
-    flagship_bf16 = partial.get("fedavg_femnist_cnn_bf16", {})
-    resnet = partial.get("resnet18_gn_fedcifar100", {})
-    transformer = partial.get("transformer_flash_s2048", {})
-    powerlaw = partial.get("fedavg_powerlaw_1000", {})
-    fused = partial.get("fedavg_fused_rounds", {})
-    fused_dev = partial.get("fedavg_fused_device_sampling", {})
-    par_axes = partial.get("federated_parallel_axes", {})
-    tta_mnist = partial.get("time_to_target_mnist_lr", {})
-    tta = partial.get("time_to_target_acc", {})
+    # ADVICE r4: any row pulled from a resumed partial rather than produced
+    # by THIS invocation is labeled `resumed: true` at the final emit, so
+    # old per-stage evidence can never masquerade as this run's. Bindings
+    # (incl. smoke, re-bound here) come from the labeled copy.
+    labeled = _label_resumed(partial, ran_now)
+    smoke = labeled.get("smoke_chip", {})
+    flagship = labeled.get("fedavg_femnist_cnn", {})
+    flagship_bf16 = labeled.get("fedavg_femnist_cnn_bf16", {})
+    resnet = labeled.get("resnet18_gn_fedcifar100", {})
+    transformer = labeled.get("transformer_flash_s2048", {})
+    powerlaw = labeled.get("fedavg_powerlaw_1000", {})
+    fused = labeled.get("fedavg_fused_rounds", {})
+    fused_dev = labeled.get("fedavg_fused_device_sampling", {})
+    par_axes = labeled.get("federated_parallel_axes", {})
+    tta_mnist = labeled.get("time_to_target_mnist_lr", {})
+    tta = labeled.get("time_to_target_acc", {})
     if bailed:
         base_out = {"error": "skipped: tunnel dead mid-suite"}
     else:
@@ -1112,6 +1177,7 @@ def main():
         "vs_baseline": (round(headline / base, 2)
                         if _is_tpu() and base == base and base > 0
                         else None),
+        **_headline_provenance(flagship, ran_now),
         "extra": extra,
     }
     _emit(line)

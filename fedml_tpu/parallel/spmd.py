@@ -553,7 +553,8 @@ class DistributedFedAvgAPI:
                    "train_loss_local": (
                        float(stats["loss_sum"][-1])
                        / max(1.0, float(stats["count"][-1])))}
-            test_stats = self._eval_global()
+            with self.timer.phase("eval"):
+                test_stats = self._eval_global()
             if test_stats is not None:
                 rec.update(_normalized(test_stats, "test"))
             self.history.append(rec)
@@ -587,7 +588,8 @@ class DistributedFedAvgAPI:
                 rec = {"round": round_idx,
                        "train_loss_local": float(stats["loss_sum"]) / max(
                            1.0, float(stats["count"]))}
-                test_stats = self._eval_global()
+                with self.timer.phase("eval"):
+                    test_stats = self._eval_global()
                 if test_stats is not None:
                     rec.update(_normalized(test_stats, "test"))
                 self.history.append(rec)

@@ -127,3 +127,49 @@ def test_probe_failure_carries_only_fresh_chip_rows(tmp_path, monkeypatch):
     assert line["value"] == 7.0
     carried = line["extra"]["chip_capture"]
     assert set(carried) == {"fedavg_femnist_cnn"}
+
+
+def test_label_resumed_marks_only_foreign_rows():
+    partial = {"a": {"x": 1}, "b": {"y": 2}, "c": "not-a-dict"}
+    out = bench._label_resumed(partial, ran_now={"a"})
+    assert "resumed" not in out["a"]
+    assert out["b"] == {"y": 2, "resumed": True}
+    assert out["c"] == "not-a-dict"
+    # input untouched (persisted partial must keep raw rows)
+    assert "resumed" not in partial["b"]
+
+
+def test_headline_provenance_flags_resumed_headline():
+    import time
+    fresh_row = {"rounds_per_sec": 10.0, "host": "tpu:TPU v5 lite",
+                 "captured_at_utc": _utc(time.time() - 60)}
+    stale_row = {"rounds_per_sec": 10.0, "host": "tpu:TPU v5 lite",
+                 "captured_at_utc": _utc(time.time() - 30 * 3600)}
+    cpu_row = {"rounds_per_sec": 10.0, "host": "cpu-smoke",
+               "captured_at_utc": _utc(time.time() - 60)}
+    # headline produced this run: no flags
+    assert bench._headline_provenance(fresh_row,
+                                      {"fedavg_femnist_cnn"}) == {}
+    # resumed fresh chip row: resumed + chip-fresh
+    out = bench._headline_provenance(fresh_row, set())
+    assert out["resumed"] is True and "chip-fresh" in out["headline_freshness"]
+    # resumed but stale / non-chip: flagged as such
+    assert bench._headline_provenance(
+        stale_row, set())["headline_freshness"] == "stale-or-non-chip"
+    assert bench._headline_provenance(
+        cpu_row, set())["headline_freshness"] == "stale-or-non-chip"
+    assert bench._headline_provenance({}, set()) == {}
+
+
+def test_fresh_chip_rows_skips_error_and_skip_markers():
+    import time
+    now = _utc(time.time() - 60)
+    partial = {
+        "good": {"rounds_per_sec": 1.0, "host": "tpu:x",
+                 "captured_at_utc": now},
+        "err": {"error": "timeout after 120s", "host": "tpu:x",
+                "captured_at_utc": now},
+        "skip": {"skipped": "tunnel dead mid-suite", "host": "tpu:x",
+                 "captured_at_utc": now},
+    }
+    assert set(bench._fresh_chip_rows(partial)) == {"good"}

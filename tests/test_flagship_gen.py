@@ -177,3 +177,31 @@ class TestGenCache:
         b = build_femnist_federation(client_num=4)
         assert np.array_equal(a.train_data_global[0],
                               b.train_data_global[0])
+
+
+class TestGenVersionGuard:
+    """ADVICE r4: cache correctness rests on bumping ``_GEN_VERSION`` when
+    the generating functions change. This guard pins a hash of their source
+    against the version so a semantic edit without a bump fails loudly here
+    instead of silently serving stale corpora from ``~/.cache``."""
+
+    # (version, sha256-of-generator-source). When this test fails: if you
+    # changed any generator function in data/flagship_gen.py, bump
+    # _GEN_VERSION AND update this pin (both halves) in the same commit.
+    PIN = (1, "30ad5cb289073b24421bc31d8f549e748cf3b3dbd00d7924bfbcecd92d15d078")
+
+    def test_source_hash_matches_pinned_version(self):
+        import hashlib
+        import inspect
+
+        import fedml_tpu.data.flagship_gen as fg
+        src = "".join(inspect.getsource(f) for f in (
+            fg._build, fg._class_prototypes, fg.apply_label_noise,
+            fg.label_noise_for_ceiling, fg.build_femnist_federation,
+            fg.build_fedcifar100_federation))
+        digest = hashlib.sha256(src.encode()).hexdigest()
+        version, pinned = self.PIN
+        assert fg._GEN_VERSION == version and digest == pinned, (
+            "flagship_gen generator source changed: bump _GEN_VERSION "
+            f"(now {fg._GEN_VERSION}) and re-pin TestGenVersionGuard.PIN "
+            f"to ({fg._GEN_VERSION}, {digest!r})")
