@@ -460,6 +460,26 @@ class FusedRounds:
         self._store_carry(carry)
         return stats
 
+    def cost_analysis(self, r0: int = 0, rounds: int = 1) -> Dict:
+        """XLA cost model of the fused block program itself (whole-block
+        totals — divide by ``rounds`` for per-round figures). Lowers and
+        compiles the same jitted scan ``run_rounds`` dispatches, so the
+        flops/"bytes accessed" accounting describes the program that is
+        actually timed (scan-carry residency and cross-round fusion
+        included), not the standalone single-round program. Costs one
+        compile; lowering does not execute (donated args are safe)."""
+        if self.mode == "block":
+            inputs = self._block_inputs(r0, rounds)
+            lowered = self._run_block.lower(self._init_carry(), *inputs,
+                                            jnp.uint32(r0))
+        else:
+            lowered = self._run.lower(self._init_carry(), *self._data,
+                                      jnp.uint32(r0), rounds)
+        analysis = lowered.compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+            analysis = analysis[0] if analysis else {}
+        return dict(analysis or {})
+
     def train(self, max_rounds_per_dispatch: Optional[int] = None) -> Dict:
         """The FedAvgAPI.train loop with the scan chunked at eval points:
         one device dispatch per test interval instead of per round.

@@ -139,8 +139,10 @@ def test_label_resumed_marks_only_foreign_rows():
     assert "resumed" not in partial["b"]
 
 
-def test_headline_provenance_flags_resumed_headline():
+def test_headline_provenance_flags_resumed_headline(monkeypatch):
     import time
+    # the freshness window is env-tunable; pin the default for the verdicts
+    monkeypatch.delenv("FEDML_BENCH_CARRY_MAX_AGE_S", raising=False)
     fresh_row = {"rounds_per_sec": 10.0, "host": "tpu:TPU v5 lite",
                  "captured_at_utc": _utc(time.time() - 60)}
     stale_row = {"rounds_per_sec": 10.0, "host": "tpu:TPU v5 lite",
@@ -161,8 +163,9 @@ def test_headline_provenance_flags_resumed_headline():
     assert bench._headline_provenance({}, set()) == {}
 
 
-def test_fresh_chip_rows_skips_error_and_skip_markers():
+def test_fresh_chip_rows_skips_error_and_skip_markers(monkeypatch):
     import time
+    monkeypatch.delenv("FEDML_BENCH_CARRY_MAX_AGE_S", raising=False)
     now = _utc(time.time() - 60)
     partial = {
         "good": {"rounds_per_sec": 1.0, "host": "tpu:x",
@@ -173,3 +176,22 @@ def test_fresh_chip_rows_skips_error_and_skip_markers():
                  "captured_at_utc": now},
     }
     assert set(bench._fresh_chip_rows(partial)) == {"good"}
+
+
+def test_roofline_math():
+    # FEMNIST-CNN-like figures: 16 GFLOP round, 8 GB touched, v5e chip
+    r = bench._roofline(flops=16e9, bytes_acc=8e9,
+                        peak=197e12, bw=819e9)
+    assert r["memory_bound"] is True  # AI=2 << ridge=240.5
+    assert r["arithmetic_intensity_flop_per_byte"] == 2.0
+    assert abs(r["ridge_flop_per_byte"] - 240.54) < 0.01
+    # ceiling = AI*BW/peak = 2*819e9/197e12 ~ 0.83%
+    assert abs(r["mfu_ceiling_at_measured_ai"] - 0.0083) < 5e-4
+    # compute-bound case caps at 1.0
+    r2 = bench._roofline(flops=1e12, bytes_acc=1e9,
+                         peak=197e12, bw=819e9)
+    assert r2["memory_bound"] is False
+    assert r2["mfu_ceiling_at_measured_ai"] == 1.0
+    # unavailable inputs -> None
+    assert bench._roofline(float("nan"), 1.0, 1.0, 1.0) is None
+    assert bench._roofline(1.0, 0.0, 1.0, 1.0) is None
