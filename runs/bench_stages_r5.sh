@@ -1,0 +1,19 @@
+#!/bin/bash
+# Bench stages to (re)capture on a live window, in value order. Called
+# fresh by probe_loop_r5.sh each window, so this file can be edited while
+# the loop sleeps (bash reads the loop script incrementally; this one is
+# re-read per invocation). $1 = step index to run (1..N); rc passthrough.
+cd /root/repo || exit 1
+
+bench_step() {
+  FEDML_BENCH_TOTAL_TIMEOUT_S=900 timeout 1000 \
+    python3 bench.py "--stages=$1" --resume-partial \
+    >> runs/bench_r5_live.log 2>&1
+}
+
+case "$1" in
+  1) bench_step headline,bf16,fused_headline,fused,fused_device ;;
+  2) bench_step resnet,flash,powerlaw ;;
+  3) bench_step axes,tta_mnist,tta ;;
+  *) exit 0 ;;
+esac
