@@ -1180,6 +1180,16 @@ def main():
                 out.setdefault("captured_at_utc", time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
             out.pop("resumed", None)  # re-run supersedes a resumed copy
+            prev = partial.get(key)
+            if (("error" in out or "skipped" in out)
+                    and isinstance(prev, dict)
+                    and str(prev.get("host", "")).startswith("tpu")
+                    and "error" not in prev and "skipped" not in prev):
+                # a re-run that wedged must not destroy good chip
+                # evidence already on disk — keep the captured row and
+                # note the failed re-run on it
+                _log(f"{name}: re-run failed; keeping prior chip row")
+                out = {**prev, "rerun_failed": out}
         ran_now.add(key)
         partial[key] = out
         _persist_partial(partial)

@@ -246,6 +246,12 @@ class FedAvgAPI:
                                time.time() - t0)
             last = round_idx == cfg.comm_round - 1
             if round_idx % cfg.frequency_of_the_test == 0 or last:
+                # run_round is an async enqueue: block on the pending round
+                # compute in its own phase so the eval timer measures eval,
+                # not the device queue draining (the r4 femnist flagship
+                # read 571s/eval that was really round compute)
+                with self.timer.phase("device_wait"):
+                    jax.block_until_ready(self.variables)
                 with self.timer.phase("eval"):
                     rec = self.evaluate(round_idx)
                 # mean local-optimization loss this round (distinct from the
@@ -506,6 +512,8 @@ class FusedRounds:
                     chunk = min(chunk, max_rounds_per_dispatch)
                 stats = self.run_rounds(r, chunk)
                 r += chunk
+            with api.timer.phase("device_wait"):
+                jax.block_until_ready(api.variables)
             with api.timer.phase("eval"):
                 rec = api.evaluate(r - 1)
             rec["train_loss_local"] = (

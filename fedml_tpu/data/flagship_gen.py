@@ -207,6 +207,99 @@ def build_femnist_federation(client_num: int = 3400, seed: int = 0,
                   test_fraction)
 
 
+def build_stackoverflow_nwp_federation(client_num: int = 342477,
+                                       seed: int = 0,
+                                       vocab_size: int = 10000,
+                                       seq_len: int = 20,
+                                       follow_p: float = 0.75,
+                                       topic_num: int = 100,
+                                       test_fraction: float = 0.1):
+    """StackOverflow-NWP-shape federation at the reference's full client
+    count (342,477 users, stackoverflow_nwp/data_loader.py,
+    benchmark/README.md:57) — THE client-virtualization stress shape:
+    50-client cohorts sampled from ~342k resident clients per round.
+
+    Sequences follow the exact wire layout of the real loader
+    (``so_tokenizer``: bos + word ids + eos, pad=0, words=1..V, oov=V+1,
+    bos=V+2, eos=V+3; x = w[:, :-1], y = w[:, 1:]) so the gen corpus is a
+    drop-in for model/driver paths. Content is a learnable first-order
+    chain: each next token follows a fixed random successor table with
+    probability ``follow_p``, else a fresh draw from the client's
+    topic-biased Zipf marginal — an LSTM that learns the table approaches
+    the ``follow_p`` token-accuracy ceiling, giving trend-able curves.
+    Generation is fully vectorized over all sequences (a per-client
+    Python loop would cost minutes at 342k clients)."""
+    cache = _cache_path(("so_nwp", client_num, vocab_size, seq_len,
+                         round(follow_p, 9), topic_num,
+                         round(test_fraction, 9), seed))
+    if cache and os.path.exists(cache):
+        try:
+            return _load_cached(cache)
+        except Exception as exc:  # noqa: BLE001 — regenerate below
+            logging.warning("gen cache %s unreadable (%s); regenerating",
+                            cache, exc)
+
+    from fedml_tpu.data.base import FederatedDataset
+
+    rng = np.random.RandomState(seed)
+    V = vocab_size
+    oov, bos, eos = V + 1, V + 2, V + 3
+    # SO-user-like heavy tail: median ~12 sequences, max 500
+    sizes = np.clip(rng.lognormal(2.5, 1.0, client_num), 1, 500).astype(int)
+    total = int(sizes.sum())
+    client_of_seq = np.repeat(np.arange(client_num), sizes)
+
+    # Zipf word marginal over 1..V, sampled by inverse CDF
+    zipf_p = 1.0 / np.arange(1, V + 1)
+    zipf_cdf = np.cumsum(zipf_p / zipf_p.sum())
+
+    def zipf_draw(n, r):
+        return (np.searchsorted(zipf_cdf, r.random_sample(n)) + 1
+                ).astype(np.int32)
+
+    # per-client topic = a contiguous vocab block its fresh draws favor
+    block = V // topic_num
+    topic0 = (rng.randint(0, topic_num, client_num) * block).astype(np.int32)
+    succ = rng.permutation(V).astype(np.int32) + 1  # successor table, 1..V
+
+    def fresh(n, topic_starts, r):
+        toks = zipf_draw(n, r)
+        biased = r.random_sample(n) < 0.5
+        toks = np.where(biased,
+                        topic_starts + (toks - 1) % block + 1, toks)
+        return toks.astype(np.int32)
+
+    seq_topics = topic0[client_of_seq]
+    w = np.empty((total, seq_len + 2), np.int32)
+    w[:, 0] = bos
+    w[:, 1] = fresh(total, seq_topics, rng)
+    for t in range(2, seq_len + 1):
+        follows = rng.random_sample(total) < follow_p
+        w[:, t] = np.where(follows, succ[w[:, t - 1] - 1],
+                           fresh(total, seq_topics, rng))
+    w[:, seq_len + 1] = eos
+
+    x, y = w[:, :-1], w[:, 1:]
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    train_local, test_local = {}, {}
+    for c in range(client_num):
+        lo, hi = int(offsets[c]), int(offsets[c + 1])
+        n_test = max(1, int((hi - lo) * test_fraction)) if hi - lo > 1 else 0
+        # single-sequence clients get an EMPTY test split (not None) so
+        # the dataset's shape is identical whether it was built fresh or
+        # loaded from cache (_load_cached reconstructs empties)
+        test_local[c] = (x[lo:lo + n_test], y[lo:lo + n_test])
+        train_local[c] = (x[lo + n_test:hi], y[lo + n_test:hi])
+    class_num = V + 4  # pad + words + oov + bos/eos == the nwp logits dim
+    if cache:
+        try:
+            _save_cache(cache, train_local, test_local, class_num)
+        except Exception as exc:  # noqa: BLE001 — cache is optional
+            logging.warning("gen cache %s not saved (%s)", cache, exc)
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               class_num)
+
+
 def build_fedcifar100_federation(client_num: int = 500, seed: int = 0,
                                  target_acc: float = 0.447,
                                  noise: float = 0.45,

@@ -207,6 +207,99 @@ def generate_leaf_shakespeare(out_dir: str, client_num: int = 20,
     return out_dir
 
 
+def build_shakespeare_federation(client_num: int = 715, seed: int = 0,
+                                 target_acc: float = 0.569,
+                                 seq_len: int = 80,
+                                 follow_p: float = 0.5,
+                                 min_windows: int = 10,
+                                 max_windows: int = 400,
+                                 test_fraction: float = 0.15):
+    """Shakespeare-shape federation at the reference's 715-client anchor
+    scale (benchmark/README.md:56, CI-script-fedavg.sh shakespeare row),
+    returned directly as a FederatedDataset in the char next-token layout
+    of ``leaf.load_partition_data_shakespeare`` (x = 80-char id windows,
+    y = x shifted left + next char, ids +1 so 0 stays PAD).
+
+    Per-token-accuracy ceiling calibrated to the reference's 56.9%:
+    text is a deterministic successor chain over the pseudo-Shakespeare
+    word list with probability ``follow_p`` (else a uniform word draw),
+    then symmetric char noise at rate ``p`` solves
+
+        target = [(1-p) + p/C] * (k + follow_p) / (k + 1)
+
+    where k = mean word length and C = corpus charset size: word-interior
+    chars and the space are deterministic given clean context (the
+    ``(k)/(k+1)`` structural term, first char of the next word correct
+    w.p. ~follow_p), and char noise scales the whole thing. The ceiling
+    is a Bayes bound — a model approaches it from below — and is
+    approximate to a couple of points (window-leading partial words are
+    ambiguous; noised context slows chain tracking)."""
+    from fedml_tpu.data.base import FederatedDataset
+    from fedml_tpu.data.flagship_gen import _cache_path, _load_cached, \
+        _save_cache
+    from fedml_tpu.data.leaf import VOCAB_SIZE, word_to_indices
+
+    cache = _cache_path(("shakespeare", client_num, seed,
+                         round(target_acc, 9), seq_len,
+                         round(follow_p, 9), min_windows, max_windows,
+                         round(test_fraction, 9)))
+    if cache and os.path.exists(cache):
+        try:
+            return _load_cached(cache)
+        except Exception as exc:  # noqa: BLE001 — regenerate below
+            import logging
+            logging.warning("gen cache %s unreadable (%s); regenerating",
+                            cache, exc)
+
+    rng = np.random.RandomState(seed)
+    vocab = sorted(set(_WORDS))
+    succ = rng.permutation(len(vocab))
+    charset = sorted(set("".join(vocab)) | {" "})
+    C = len(charset)
+    k = float(np.mean([len(w) for w in vocab]))
+    structural = (k + follow_p) / (k + 1.0)
+    # solve [(1-p) + p/C] * structural = target for the char-noise rate
+    p_char = float(np.clip((1.0 - target_acc / structural) * C / (C - 1.0),
+                           0.0, 0.95))
+    char_ids = np.asarray([word_to_indices(c)[0] + 1 for c in charset],
+                          np.int32)
+
+    sizes = np.clip((min_windows
+                     + rng.lognormal(3.6, 0.9, client_num)).astype(int),
+                    min_windows, max_windows)
+    train_local, test_local = {}, {}
+    for i, n_windows in enumerate(sizes):
+        n_windows = int(n_windows)
+        n_chars = seq_len + n_windows + 1
+        w = rng.randint(len(vocab))
+        words = []
+        total = 0
+        while total < n_chars:
+            words.append(vocab[w])
+            total += len(vocab[w]) + 1
+            w = (succ[w] if rng.random_sample() < follow_p
+                 else rng.randint(len(vocab)))
+        ids = np.asarray(word_to_indices(" ".join(words)), np.int32) + 1
+        noise = rng.random_sample(len(ids)) < p_char
+        ids = np.where(noise, char_ids[rng.randint(C, size=len(ids))], ids)
+        # windows via stride tricks on the noisy stream (targets and
+        # contexts stay consistent, as in the real sliding-window corpus)
+        win = np.lib.stride_tricks.sliding_window_view(ids, seq_len + 1)
+        win = win[:n_windows]
+        x, y = win[:, :-1], win[:, 1:]
+        n_test = max(1, int(n_windows * test_fraction))
+        test_local[i] = (x[:n_test].copy(), y[:n_test].copy())
+        train_local[i] = (x[n_test:].copy(), y[n_test:].copy())
+    if cache:
+        try:
+            _save_cache(cache, train_local, test_local, VOCAB_SIZE)
+        except Exception as exc:  # noqa: BLE001 — cache is optional
+            import logging
+            logging.warning("gen cache %s not saved (%s)", cache, exc)
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               VOCAB_SIZE)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("fedml_tpu leaf_gen")
     p.add_argument("--out", type=str, required=True)

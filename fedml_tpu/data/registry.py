@@ -159,6 +159,18 @@ def _fed_cifar100_gen(data_dir, **kw):
         client_num=kw.get("client_num_in_total", 500))
 
 
+def _shakespeare_gen(data_dir, **kw):
+    from fedml_tpu.data.leaf_gen import build_shakespeare_federation
+    return build_shakespeare_federation(
+        client_num=kw.get("client_num_in_total") or 715)
+
+
+def _stackoverflow_nwp_gen(data_dir, **kw):
+    from fedml_tpu.data.flagship_gen import build_stackoverflow_nwp_federation
+    return build_stackoverflow_nwp_federation(
+        client_num=kw.get("client_num_in_total") or 342477)
+
+
 def _mnist_gen(data_dir, **kw):
     from fedml_tpu.data.leaf_gen import build_leaf_mnist_federation
     # noise=1.2 makes the >75% anchor (benchmark/README.md:12) cross after
@@ -208,6 +220,9 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "femnist_gen": _femnist_gen,          # 3400 clients, 62c, ceil 84.9%
     "fed_cifar100_gen": _fed_cifar100_gen,  # 500 clients, 100c, ceil 44.7%
     "mnist_gen": _mnist_gen,              # 1000 clients, 10c, ceil 85%
+    "stackoverflow_nwp_gen": _stackoverflow_nwp_gen,  # 342,477 clients,
+    # nwp wire layout — the client-virtualization stress shape
+    "shakespeare_gen": _shakespeare_gen,  # 715 clients, ceil 56.9%
 }
 
 # reference --dataset name -> (model factory name, task head)
@@ -237,6 +252,8 @@ DEFAULT_MODEL_AND_TASK = {
     "gld23k": ("efficientnet-b0", "classification"),
     "gld160k": ("efficientnet-b0", "classification"),
     "femnist_gen": ("cnn", "classification"),
+    "stackoverflow_nwp_gen": ("rnn_stackoverflow", "nwp"),
+    "shakespeare_gen": ("rnn_seq", "nwp"),
     "fed_cifar100_gen": ("resnet18_gn", "classification"),
     "mnist_gen": ("lr", "classification"),
 }

@@ -81,8 +81,14 @@ def _incremental_history(api, path: str, period_s: float = 20.0):
 
 def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                eval_every: int, batch_size: int, lr: float, seed: int,
-               eval_test_sub: int = None, history_path: str = None):
-    """One driver end to end; returns (history, variables, stats)."""
+               eval_test_sub: int = None, history_path: str = None,
+               fused: int = 0):
+    """One driver end to end; returns (history, variables, stats).
+
+    ``fused > 0`` routes the sim driver through ``FusedRounds.train``
+    (trajectory-identical multi-round scan blocks, at most ``fused``
+    rounds per device dispatch) — the per-round host dispatch overhead
+    that dominates small-round wall-clock amortizes R-fold."""
     import jax
 
     from fedml_tpu.core.sampling import sample_clients
@@ -114,7 +120,10 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
     stop_flush = (_incremental_history(api, history_path)
                   if history_path else lambda: None)
     try:
-        api.train()
+        if kind == "sim" and fused > 0:
+            api.fused_rounds().train(max_rounds_per_dispatch=fused)
+        else:
+            api.train()
     finally:
         stop_flush()
     phase = api.timer.means()
@@ -131,7 +140,8 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
 def main(argv=None):
     p = argparse.ArgumentParser("fedml_tpu flagship_scale")
     p.add_argument("--dataset", required=True,
-                   choices=["femnist_gen", "fed_cifar100_gen", "mnist_gen"])
+                   choices=["femnist_gen", "fed_cifar100_gen", "mnist_gen",
+                            "shakespeare_gen", "stackoverflow_nwp_gen"])
     p.add_argument("--clients", type=int, default=None,
                    help="default: the reference scale (3400 / 500)")
     p.add_argument("--rounds", type=int, default=60)
@@ -145,6 +155,10 @@ def main(argv=None):
                    help="seeded test-union eval subsample (CPU fallback: "
                         "full flagship test unions cost more than the "
                         "rounds; recorded in summary.json)")
+    p.add_argument("--fused", type=int, default=0, metavar="R",
+                   help="sim driver: fuse up to R rounds per device "
+                        "dispatch (FusedRounds.train; 0 = per-round host "
+                        "loop). Trajectory-identical to the host loop.")
     p.add_argument("--out", type=str, required=True)
     args = p.parse_args(argv)
 
@@ -158,7 +172,8 @@ def main(argv=None):
     from fedml_tpu.models import create_model
 
     ref_scale = {"femnist_gen": 3400, "fed_cifar100_gen": 500,
-                 "mnist_gen": 1000}
+                 "mnist_gen": 1000, "shakespeare_gen": 715,
+                 "stackoverflow_nwp_gen": 342477}
     clients = args.clients or ref_scale[args.dataset]
     ds = load_data(args.dataset, "", client_num_in_total=clients)
     model_name, task = DEFAULT_MODEL_AND_TASK[args.dataset]
@@ -179,6 +194,7 @@ def main(argv=None):
         "batch_size": args.batch_size,
         "train_samples": ds.train_data_num,
         "eval_test_subsample": args.eval_test_subsample,
+        "fused_rounds_per_dispatch": args.fused,
     }
     results = {}
     for kind in drivers:
@@ -195,7 +211,8 @@ def main(argv=None):
         hist, variables, stats = run_driver(
             kind, ds, model, task, args.rounds, args.client_num_per_round,
             args.eval_every, args.batch_size, args.lr, args.seed,
-            eval_test_sub=args.eval_test_subsample, history_path=hist_path)
+            eval_test_sub=args.eval_test_subsample, history_path=hist_path,
+            fused=args.fused)
         results[kind] = (hist, variables)
         summary[kind] = {**stats,
                          "final": hist[-1] if hist else {}}

@@ -553,6 +553,8 @@ class DistributedFedAvgAPI:
                    "train_loss_local": (
                        float(stats["loss_sum"][-1])
                        / max(1.0, float(stats["count"][-1])))}
+            with self.timer.phase("device_wait"):
+                jax.block_until_ready(self.variables)
             with self.timer.phase("eval"):
                 test_stats = self._eval_global()
             if test_stats is not None:
@@ -588,6 +590,8 @@ class DistributedFedAvgAPI:
                 rec = {"round": round_idx,
                        "train_loss_local": float(stats["loss_sum"]) / max(
                            1.0, float(stats["count"]))}
+                with self.timer.phase("device_wait"):
+                    jax.block_until_ready(self.variables)
                 with self.timer.phase("eval"):
                     test_stats = self._eval_global()
                 if test_stats is not None:

@@ -3,7 +3,24 @@
 # fresh by probe_loop_r5.sh each window, so this file can be edited while
 # the loop sleeps (bash reads the loop script incrementally; this one is
 # re-read per invocation). $1 = step index to run (1..N); rc passthrough.
+# Each step is gated on its partial keys being tpu-captured already —
+# re-running a captured stage would burn window budget and, on a wedge,
+# overwrite good chip rows with error rows.
 cd /root/repo || exit 1
+
+step_done() {  # $@ = partial keys; exit 0 when all tpu-tagged
+  python3 - "$@" <<'EOF'
+import json, sys
+try:
+    d = json.load(open("runs/bench_partial.json"))
+except Exception:
+    sys.exit(1)
+ok = all(str(d.get(k, {}).get("host", "")).startswith("tpu")
+         and "error" not in d.get(k, {}) and "skipped" not in d.get(k, {})
+         for k in sys.argv[1:])
+sys.exit(0 if ok else 1)
+EOF
+}
 
 bench_step() {
   FEDML_BENCH_TOTAL_TIMEOUT_S=900 timeout 1000 \
@@ -12,8 +29,15 @@ bench_step() {
 }
 
 case "$1" in
-  1) bench_step headline,bf16,fused_headline,fused,fused_device ;;
-  2) bench_step resnet,flash,powerlaw ;;
-  3) bench_step axes,tta_mnist,tta ;;
+  1) step_done fedavg_femnist_cnn fedavg_femnist_cnn_bf16 \
+               fedavg_femnist_cnn_fused fedavg_fused_rounds \
+               fedavg_fused_device_sampling \
+       || bench_step headline,bf16,fused_headline,fused,fused_device ;;
+  2) step_done resnet18_gn_fedcifar100 transformer_flash_s2048 \
+               fedavg_powerlaw_1000 \
+       || bench_step resnet,flash,powerlaw ;;
+  3) step_done federated_parallel_axes time_to_target_mnist_lr \
+               time_to_target_acc \
+       || bench_step axes,tta_mnist,tta ;;
   *) exit 0 ;;
 esac

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Extra chip targets after the core capture sequence (read fresh by
+# probe_loop_r5.sh each window, so this list is editable while the loop
+# sleeps). Each step persists incrementally and tolerates a wedge.
+cd /root/repo || exit 1
+
+if [ ! -f runs/flagship_shakespeare_tta_chip/summary.json ]; then
+  timeout 900 python3 -m fedml_tpu.experiments.flagship_scale \
+    --dataset shakespeare_gen --rounds 800 --eval_every 25 \
+    --drivers sim --eval_test_subsample 2000 --fused 25 \
+    --batch_size 10 --lr 0.8 \
+    --out runs/flagship_shakespeare_tta_chip \
+    >> runs/flagship_shakespeare_tta_chip.log 2>&1
+  echo "$(date -u +%FT%TZ) shakespeare chip flagship rc=$?"
+fi
+
+if [ ! -f runs/stackoverflow_nwp_stress_chip/summary.json ]; then
+  timeout 600 python3 -m fedml_tpu.experiments.virtualization_stress \
+    --dataset stackoverflow_nwp_gen --rounds 30 --eval_subsample 2000 \
+    --out runs/stackoverflow_nwp_stress_chip \
+    >> runs/stackoverflow_nwp_stress_chip.log 2>&1
+  echo "$(date -u +%FT%TZ) nwp 342k-client stress on chip rc=$?"
+fi

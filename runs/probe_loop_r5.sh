@@ -40,7 +40,7 @@ flagship() {  # $1 dataset, $2 out dir, $3 rounds, $4 eval_every, $5 timeout, ex
   echo "$(date -u +%FT%TZ) chip flagship $ds rounds=$rounds -> $out" >> "$LOG"
   timeout "$to" python3 -m fedml_tpu.experiments.flagship_scale \
     --dataset "$ds" --rounds "$rounds" --eval_every "$ev" \
-    --drivers sim --eval_test_subsample 2000 "$@" --out "$out" \
+    --drivers sim --eval_test_subsample 2000 --fused 50 "$@" --out "$out" \
     >> "runs/${out##*/}.log" 2>&1
   local rc=$?
   echo "$(date -u +%FT%TZ) chip flagship $ds exited rc=$rc" >> "$LOG"

@@ -101,3 +101,34 @@ class TestLeafGen:
                                               lr=0.03)))
         final = api.train()
         assert final["test_acc"] > 0.75, final
+
+
+class TestShakespeareFederation:
+    def test_shapes_layout_and_ceiling_params(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FEDML_GEN_CACHE", str(tmp_path))
+        import numpy as np
+        from fedml_tpu.data.leaf_gen import build_shakespeare_federation
+        ds = build_shakespeare_federation(client_num=30)
+        assert ds.client_num == 30
+        assert ds.class_num == 90  # leaf.VOCAB_SIZE
+        x, y = ds.train_data_local_dict[0]
+        assert x.shape[1] == 80 and y.shape[1] == 80
+        assert (y[:, :-1] == x[:, 1:]).all()  # next-char shift
+        assert x.min() >= 1  # ids +1, 0 reserved for PAD
+
+    def test_cache_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FEDML_GEN_CACHE", str(tmp_path))
+        import numpy as np
+        from fedml_tpu.data.leaf_gen import build_shakespeare_federation
+        a = build_shakespeare_federation(client_num=12)
+        b = build_shakespeare_federation(client_num=12)
+        assert np.array_equal(a.train_data_global[0],
+                              b.train_data_global[0])
+
+    def test_registry_entry(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FEDML_GEN_CACHE", str(tmp_path))
+        from fedml_tpu.data.registry import DEFAULT_MODEL_AND_TASK, load_data
+        ds = load_data("shakespeare_gen", client_num_in_total=10)
+        assert ds.client_num == 10
+        assert DEFAULT_MODEL_AND_TASK["shakespeare_gen"] == (
+            "rnn_seq", "nwp")
