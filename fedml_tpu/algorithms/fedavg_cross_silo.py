@@ -331,7 +331,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           resume: bool = False,
                           server_optimizer: Optional[str] = None,
                           server_lr: float = 1e-3,
-                          server_momentum: float = 0.0):
+                          server_momentum: float = 0.0,
+                          seed: int = 0):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -362,7 +363,7 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     model, history, _ = launch_federation(
         dataset, module, task, worker_num, train_cfg, server_factory,
         backend=backend, addresses=addresses, wire_codec=wire_codec,
-        compress=compress, token=token)
+        compress=compress, token=token, seed=seed)
     return model, history
 
 

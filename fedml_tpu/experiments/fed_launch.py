@@ -19,7 +19,8 @@ from fedml_tpu.utils.metrics import MetricsSink
 # every algorithm family dispatches end-to-end from the generic flags;
 # split_nn uses a dense bottom/top cut and vertical_fl an even feature-column
 # split across --party_num parties (their APIs take arbitrary splits)
-ALGOS = ["fedavg", "fedopt", "fednova", "fedavg_robust", "hierarchical",
+ALGOS = ["fedavg", "fedavg_cross_silo", "fedopt", "fednova",
+         "fedavg_robust", "hierarchical",
          "decentralized", "centralized", "fednas", "fedgkt",
          "turboaggregate", "fedseg", "split_nn", "vertical_fl",
          "contribution", "fedavg_async"]
@@ -185,9 +186,33 @@ def run_algo(args):
         final = BACKEND_RUNNERS[args.backend](args, ds, model, task, sink)
         sink.finish()
         return final
+    if args.algo == "fedavg_cross_silo":
+        # the cross-silo actor protocol (server + one client manager per
+        # silo over a comm backend), reference `mpirun -np k+1` topology
+        # (distributed/fedavg/FedAvgAPI.py:20-67). Every silo
+        # participates each round — the reference cross-silo CIFAR10
+        # anchor config (benchmark/README.md:105: 10 silos, LDA
+        # alpha=0.5, E=20, B=64, ResNet-56).
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        if args.frequency_of_the_test != 1:
+            logging.warning("--frequency_of_the_test is not wired for "
+                            "--algo fedavg_cross_silo (the actor protocol "
+                            "evaluates every round); ignoring %d",
+                            args.frequency_of_the_test)
+        _, history = run_fedavg_cross_silo(
+            ds, model, task=task,
+            worker_num=args.client_num_per_round,
+            comm_round=args.comm_round, train_cfg=tcfg, seed=args.seed,
+            checkpoint_dir=args.checkpoint_dir or None,
+            resume=args.resume)
+        for rec in history:
+            sink.log(rec, step=rec.get("round"))
+        sink.finish()
+        return history[-1] if history else {}
     if args.checkpoint_dir:
-        logging.warning("--checkpoint_dir is only wired for --algo fedavg; "
-                        "ignoring for %r", args.algo)
+        logging.warning("--checkpoint_dir is only wired for --algo fedavg "
+                        "and fedavg_cross_silo; ignoring for %r", args.algo)
     if args.algo == "fedopt":
         from fedml_tpu.algorithms.fedopt import FedOptAPI, FedOptConfig
         api = FedOptAPI(ds, model, task=task, config=FedOptConfig(

@@ -29,8 +29,12 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 
 
-def run_fedavg_round(multihost) -> None:
-    """One spmd FedAvg round with host-local data feeding."""
+def _federated_inputs(multihost, dim: int, class_num: int):
+    """Shared multi-host data contract: global mesh, seeded federation,
+    host-local pack + host_local_to_global stacking, fold_in key chain,
+    replicated init, and the compiled spmd round fn. Used by BOTH the
+    correctness round and the weak-scaling bench so they exercise the
+    identical protocol."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -44,7 +48,8 @@ def run_fedavg_round(multihost) -> None:
     n_clients = mesh.shape["clients"]
 
     # every host derives the SAME federation (seeded), feeds only its rows
-    ds = make_blob_federated(client_num=n_clients, dim=8, class_num=4,
+    ds = make_blob_federated(client_num=n_clients, dim=dim,
+                             class_num=class_num,
                              n_samples=32 * n_clients, seed=11)
     model = LogisticRegression(num_classes=ds.class_num)
     cfg = TrainConfig(epochs=1, batch_size=16, lr=0.1, shuffle=False)
@@ -60,13 +65,22 @@ def run_fedavg_round(multihost) -> None:
             jax.random.fold_in(jax.random.key(0), c)))
         for c in range(lo, hi)])
     kg = multihost.host_local_to_global(mesh, keys_local, n_clients)
+    keys = jax.vmap(jax.random.wrap_key_data)(kg)
 
-    variables = model.init(jax.random.key(1), jnp.zeros((1, 8)),
+    variables = model.init(jax.random.key(1), jnp.zeros((1, dim)),
                            train=False)
     round_fn = make_spmd_round(model, "classification", cfg, mesh)
-    new_vars, stats = round_fn(
-        variables, xg, yg, mg,
-        jax.vmap(jax.random.wrap_key_data)(kg), wg[:, 0])
+    return round_fn, variables, (xg, yg, mg, keys, wg[:, 0])
+
+
+def run_fedavg_round(multihost) -> None:
+    """One spmd FedAvg round with host-local data feeding."""
+    import jax
+    import jax.numpy as jnp
+
+    round_fn, variables, args = _federated_inputs(multihost, dim=8,
+                                                  class_num=4)
+    new_vars, stats = round_fn(variables, *args)
     jax.block_until_ready(new_vars)
     assert float(stats["count"]) > 0
 
@@ -75,6 +89,34 @@ def run_fedavg_round(multihost) -> None:
     # replicated output must agree across hosts
     assert multihost.all_hosts_agree(int(norm * 1e6))
     print(f"FEDAVG_OK {norm:.6f}", flush=True)
+
+
+def run_fedavg_bench(multihost, timed_rounds: int = 20) -> None:
+    """Weak-scaling measurement: repeated REAL FedAvg SPMD rounds over the
+    global mesh (4 virtual devices per process, one client per device —
+    per-host work fixed, total work grows with process count). Proc 0
+    prints ``BENCH_OK <rounds_per_sec> <ms_per_round>``.
+
+    On a 1-core host every process time-shares the same core, so absolute
+    rounds/s falls with P by construction; the number this measures is
+    the multi-process protocol (rendezvous + DCN collective) overhead
+    trend, which feeds the BASELINE.md v5e-256 projection."""
+    import time as _time
+
+    import jax
+
+    round_fn, variables, args = _federated_inputs(multihost, dim=64,
+                                                  class_num=10)
+    variables, _ = round_fn(variables, *args)
+    jax.block_until_ready(variables)  # compile
+    t0 = _time.perf_counter()
+    for _ in range(timed_rounds):
+        variables, _ = round_fn(variables, *args)
+    jax.block_until_ready(variables)
+    dt = _time.perf_counter() - t0
+    if jax.process_index() == 0:
+        print(f"BENCH_OK {timed_rounds / dt:.4f} "
+              f"{dt / timed_rounds * 1e3:.3f}", flush=True)
 
 
 def main() -> None:
@@ -98,6 +140,9 @@ def main() -> None:
 
     if mode == "fedavg":
         run_fedavg_round(multihost)
+        return
+    if mode == "bench":
+        run_fedavg_bench(multihost)
         return
 
     import jax.numpy as jnp
