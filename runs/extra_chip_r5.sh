@@ -14,6 +14,22 @@ if [ ! -f runs/flagship_shakespeare_tta_chip/summary.json ]; then
   echo "$(date -u +%FT%TZ) shakespeare chip flagship rc=$?"
 fi
 
+if [ ! -f runs/cross_silo_resnet56_chip/metrics.jsonl ]; then
+  # the cross-silo CIFAR10 anchor protocol at the FULL reference config
+  # (benchmark/README.md:105): 10 silos, LDA alpha=0.5, E=20, B=64,
+  # ResNet-56, 100 rounds. ~35 s/step on this host's CPU (8h) but ~2 ms
+  # on chip — the whole 100-round protocol is minutes of device time.
+  timeout 900 python3 -m fedml_tpu.experiments.fed_launch \
+    --algo fedavg_cross_silo --dataset cifar10 \
+    --data_dir "$HOME/.cache/fedml_tpu_gen/cifar10_synth" \
+    --model resnet56 --partition_method hetero --partition_alpha 0.5 \
+    --client_num_in_total 10 --client_num_per_round 10 \
+    --comm_round 100 --epochs 20 --batch_size 64 --lr 0.01 \
+    --run_dir runs/cross_silo_resnet56_chip \
+    >> runs/cross_silo_resnet56_chip.log 2>&1
+  echo "$(date -u +%FT%TZ) cross-silo resnet56 anchor on chip rc=$?"
+fi
+
 if [ ! -f runs/stackoverflow_nwp_stress_chip/summary.json ]; then
   timeout 600 python3 -m fedml_tpu.experiments.virtualization_stress \
     --dataset stackoverflow_nwp_gen --rounds 30 --eval_subsample 2000 \
