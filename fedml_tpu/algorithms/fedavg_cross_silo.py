@@ -23,6 +23,7 @@ zero-copy codec, not pickled dicts.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional
 
@@ -332,7 +333,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           server_optimizer: Optional[str] = None,
                           server_lr: float = 1e-3,
                           server_momentum: float = 0.0,
-                          seed: int = 0):
+                          seed: int = 0,
+                          join_timeout_s: float = 600.0):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -363,7 +365,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     model, history, _ = launch_federation(
         dataset, module, task, worker_num, train_cfg, server_factory,
         backend=backend, addresses=addresses, wire_codec=wire_codec,
-        compress=compress, token=token, seed=seed)
+        compress=compress, token=token, seed=seed,
+        join_timeout_s=join_timeout_s)
     return model, history
 
 
@@ -425,10 +428,18 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     server_thread.start()
     server.send_init_msg()
     server_thread.join(timeout=join_timeout_s)
-    if raise_on_timeout and server_thread.is_alive():
-        raise RuntimeError(
-            f"federation did not finish within {join_timeout_s:.0f}s "
-            "(dead worker or quorum never reached?)")
+    if server_thread.is_alive():
+        if raise_on_timeout:
+            raise RuntimeError(
+                f"federation did not finish within {join_timeout_s:.0f}s "
+                "(dead worker or quorum never reached?)")
+        # non-raising path: an empty/partial history otherwise looks like
+        # a silent success — say loudly what happened (observed: a slow
+        # XLA:CPU compile pushing the protocol past the join budget)
+        logging.error(
+            "federation still running after join_timeout_s=%.0f — "
+            "returning partial history (%d records); raise the timeout "
+            "for slow-compile hosts", join_timeout_s, len(history))
     for t in threads:
         t.join(timeout=60)
     return server.global_model, history, server

@@ -205,7 +205,15 @@ def run_algo(args):
             worker_num=args.client_num_per_round,
             comm_round=args.comm_round, train_cfg=tcfg, seed=args.seed,
             checkpoint_dir=args.checkpoint_dir or None,
-            resume=args.resume)
+            resume=args.resume,
+            # scale the join budget with the local work — on a 1-core
+            # host the silo threads SERIALIZE, so the budget grows with
+            # epochs x rounds x silos; the 1200 floor absorbs a
+            # multi-minute XLA:CPU compile. This is an upper bound, not a
+            # wait: fast hosts finish and join immediately.
+            join_timeout_s=max(1200.0, 30.0 * args.epochs
+                               * args.comm_round
+                               * max(1, args.client_num_per_round)))
         for rec in history:
             sink.log(rec, step=rec.get("round"))
         sink.finish()

@@ -19,7 +19,7 @@ if [ ! -f runs/cross_silo_resnet56_chip/metrics.jsonl ]; then
   # (benchmark/README.md:105): 10 silos, LDA alpha=0.5, E=20, B=64,
   # ResNet-56, 100 rounds. ~35 s/step on this host's CPU (8h) but ~2 ms
   # on chip — the whole 100-round protocol is minutes of device time.
-  timeout 900 python3 -m fedml_tpu.experiments.fed_launch \
+  timeout 2000 python3 -m fedml_tpu.experiments.fed_launch \
     --algo fedavg_cross_silo --dataset cifar10 \
     --data_dir "$HOME/.cache/fedml_tpu_gen/cifar10_synth" \
     --model resnet56 --partition_method hetero --partition_alpha 0.5 \

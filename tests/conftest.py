@@ -72,6 +72,8 @@ SLOW_TESTS = {
     "test_search_then_retrain_via_launcher",
     "test_experiments.py::TestCrossSiloLauncher::"
     "test_cross_silo_resnet56_anchor_config",
+    "test_experiments.py::TestCrossSiloLauncher::"
+    "test_cross_silo_e20_epochs_knob",
     "test_split_vertical.py::TestVerticalFL::"
     "test_party_gradient_matches_global_autograd",
     "test_contribution.py::TestLeaveOneOut::"
