@@ -35,9 +35,10 @@ jax.config.update("jax_platforms", "cpu")
 
 
 # -- fast/slow split --------------------------------------------------------
-# `pytest -m "not slow"` is the CI lane (< 5 min on a 2023 laptop-class box);
-# the full suite runs ~30 min. Measured with --durations; regenerate the
-# lists when a module's compile load changes (threshold: ~5 s per test).
+# `pytest -m "not slow"` is the CI lane — measured 8:00 for 364 tests on
+# this environment's 1-CORE host (r5 re-tier; ~2-3 min on a laptop-class
+# box). Measured with --durations; regenerate the lists when a module's
+# compile load changes (threshold: ~8 s per test on one core).
 
 SLOW_MODULES = {
     "test_models.py",         # whole zoo compiles (~4.5 min)
@@ -95,6 +96,38 @@ SLOW_TESTS = {
     "test_tensor_parallel.py::TestTpCli::test_cli_spmd_tp_smoke",
     "test_fsdp.py::TestFsdpFederatedRound::"
     "test_clients_x_fsdp_round_matches_single_device",
+    # r5 re-tier (VERDICT r4 #9: fast lane <= 8 min on a 1-core host).
+    # Every demotion keeps a cheaper sibling in the fast lane:
+    # registry train-smokes keep test_shakespeare; fused keeps
+    # test_block_matches_host_loop_trajectory; tp/seq parity keeps the
+    # shard_map unit tests; packing keeps the distributed-parity test.
+    "test_flagship_gen.py::TestRegistryWiring::"
+    "test_cli_pairings_train_one_round",
+    "test_registry_train_smoke.py::TestRegistryTrainSmoke::"
+    "test_generated_datasets",
+    "test_tensor_parallel.py::TestTpFederatedRound::"
+    "test_clients_x_tp_round_matches_single_device",
+    "test_leaf_gen.py::TestLeafGen::test_power_law_sizes",
+    "test_seq_federated.py::test_clients_x_seq_round_matches_single_device",
+    "test_experiments.py::TestFedAvgMain::test_spmd_fused_rounds_flag",
+    "test_bucket_packing.py::TestCohortPackOtherAlgorithms::"
+    "test_hierarchical_both_policies_learn",
+    "test_bucket_packing.py::TestCohortPackTrajectory::"
+    "test_partial_participation_learns_and_weights_match",
+    "test_fused_rounds.py::TestMeshFusedRounds::"
+    "test_train_fused_matches_train_cadence",
+    "test_fused_rounds.py::TestMeshFusedRounds::"
+    "test_fused_mesh_sampled_resume_mid_stream",
+    "test_fused_rounds.py::TestFusedFullParticipation::"
+    "test_max_rounds_per_dispatch_caps_scan",
+    "test_fused_rounds.py::TestFusedFullParticipation::"
+    "test_chunked_train_learns",
+    "test_fused_rounds.py::TestFusedDeviceSampling::"
+    "test_sampled_rounds_learn",
+    "test_fused_rounds.py::TestFusedPairings::"
+    "test_robust_hooks_fuse_with_rng_parity",
+    "test_torch_import.py::test_gkt_client_forward_matches_torch",
+    "test_experiments.py::TestFedLaunch::test_contribution",
 }
 
 
