@@ -129,8 +129,10 @@ def main(argv=None):
             if kind == "sim":
                 ev = api.evaluate(args.rounds - 1)
             else:
-                ev = api._eval_global() or {}
-            stats["final_eval"] = {k: v for k, v in ev.items()
+                from fedml_tpu.algorithms.fedavg import _normalized
+                raw = api._eval_global()
+                ev = _normalized(raw, "test") if raw is not None else {}
+            stats["final_eval"] = {k: float(v) for k, v in ev.items()
                                    if isinstance(v, (int, float))}
             stats["eval_wall_s"] = round(time.time() - t1, 2)
         summary[kind] = stats

@@ -25,6 +25,9 @@ class RNN_OriginalFedAvg(nn.Module):
     embedding_dim: int = 8
     hidden_size: int = 256
     seq_output: bool = False  # True for fed_shakespeare (score every step)
+    # nn.RNN's internal scan carry is created unvarying inside shard_map
+    # bodies; the spmd layer reads this flag to relax its vma check
+    flax_rnn_carry = True
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False):
@@ -42,6 +45,7 @@ class RNN_StackOverflow(nn.Module):
     embedding_size: int = 96
     latent_size: int = 670
     num_layers: int = 1
+    flax_rnn_carry = True  # see RNN_OriginalFedAvg
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False):

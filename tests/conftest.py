@@ -128,6 +128,8 @@ SLOW_TESTS = {
     "test_robust_hooks_fuse_with_rng_parity",
     "test_torch_import.py::test_gkt_client_forward_matches_torch",
     "test_experiments.py::TestFedLaunch::test_contribution",
+    "test_spmd.py::TestRnnOnMesh::"
+    "test_lstm_round_matches_vmapped_simulation",
 }
 
 

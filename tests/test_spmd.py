@@ -188,3 +188,41 @@ class TestCnnParityPerRound:
                                              dist.variables)))
         den = float(pt.tree_norm(sim.variables))
         assert num / den < 1e-6, num / den
+
+
+class TestRnnOnMesh:
+    """Recurrent models under the shard_map round (regression: flax
+    nn.RNN's internal scan carry is created unvarying inside the body, and
+    jax's varying-manual-axes checker rejected it — check_vma=False on the
+    spmd programs with correctness held by the sim==mesh parity below).
+    Found by running the stackoverflow_nwp stress through the mesh
+    driver (VERDICT r4 #4 'through both drivers')."""
+
+    def test_lstm_round_matches_vmapped_simulation(self, mesh8):
+        from fedml_tpu.data.base import FederatedDataset
+        from fedml_tpu.models.rnn import RNN_OriginalFedAvg
+
+        rng = np.random.RandomState(0)
+        V, S = 30, 12
+        train_local = {}
+        for c in range(8):
+            w = rng.randint(1, V, (6, S + 1)).astype(np.int32)
+            train_local[c] = (w[:, :-1], w[:, 1:])
+        ds = FederatedDataset.from_client_arrays(
+            train_local, {c: None for c in range(8)}, V)
+        model = RNN_OriginalFedAvg(vocab_size=V, embedding_dim=4,
+                                   hidden_size=8, seq_output=True)
+        tc = TrainConfig(epochs=1, batch_size=4, lr=0.3)
+        cfg = dict(comm_round=2, client_num_per_round=8,
+                   frequency_of_the_test=100)
+        sim = FedAvgAPI(ds, model, task="nwp",
+                        config=FedAvgConfig(train=tc, **cfg))
+        dist = DistributedFedAvgAPI(
+            ds, model, task="nwp", mesh=mesh8,
+            config=DistributedFedAvgConfig(train=tc, **cfg))
+        sim.train()
+        dist.train()
+        num = float(pt.tree_norm(pt.tree_sub(sim.variables,
+                                             dist.variables)))
+        den = max(1e-30, float(pt.tree_norm(sim.variables)))
+        assert num / den < 1e-5, num / den
