@@ -1150,7 +1150,14 @@ def main():
                              "captured_at_utc; <18h old, "
                              "runs/bench_partial.json)"}
                             if headline_carried else {}),
-                         **({"chip_capture": carried} if carried else {})}})
+                         **({"chip_capture": carried} if carried else
+                            {"latest_chip_evidence":
+                             "no fresh carriable chip rows at emit time "
+                             "(window history: runs/tpu_probe_r*.log; "
+                             "any non-carriable rows: "
+                             "runs/bench_partial.json); the most recent "
+                             "chip measurements live in the last "
+                             "BENCH_r0N.json with host-tagged rows"})}})
         return 0
     _log(f"backend={info['backend']} device={info['device']!r}")
     # every row carries where it ran, so chip numbers can never be

@@ -190,6 +190,9 @@ def main(argv=None):
         "reference_scale": ref_scale[args.dataset],
         "at_reference_scale": clients == ref_scale[args.dataset],
         "rounds": args.rounds,
+        # history rows land at this cadence (rounds 0, k, 2k, ..., last),
+        # so a 4-round eval_every=2 run correctly has rows 0/2/3
+        "eval_every": args.eval_every,
         "client_num_per_round": args.client_num_per_round,
         "batch_size": args.batch_size,
         "train_samples": ds.train_data_num,

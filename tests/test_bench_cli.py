@@ -195,3 +195,32 @@ def test_roofline_math():
     # unavailable inputs -> None
     assert bench._roofline(float("nan"), 1.0, 1.0, 1.0) is None
     assert bench._roofline(1.0, 0.0, 1.0, 1.0) is None
+
+
+def test_probe_failure_empty_carry_emits_zero_with_evidence_pointer(
+        tmp_path, monkeypatch):
+    """ADVICE r4 regression guard for the EMPTY-carry branch: no fresh
+    chip rows => value 0.0, NO carried/value_source claims, and an
+    explicit pointer to where chip evidence actually lives."""
+    import sys
+    import time
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "runs").mkdir()
+    stale = {"fedavg_femnist_cnn": {
+        "rounds_per_sec": 7.0, "host": "tpu:x",
+        "captured_at_utc": _utc(time.time() - 30 * 3600)}}
+    (tmp_path / "runs" / "bench_partial.json").write_text(
+        json.dumps(stale))
+    monkeypatch.setenv("FEDML_BENCH_PROBE_TIMEOUT_S", "1")
+    monkeypatch.delenv("FEDML_BENCH_CARRY_MAX_AGE_S", raising=False)
+    monkeypatch.setattr(bench, "_probe_device",
+                        lambda timeout_s=0: {"error": "probe hung"})
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    line = json.loads(
+        (tmp_path / "runs" / "bench_details.json").read_text())
+    assert line["value"] == 0.0
+    assert "carried" not in line
+    assert "value_source" not in line["extra"]
+    assert "chip_capture" not in line["extra"]
+    assert "BENCH_r0N" in line["extra"]["latest_chip_evidence"]
