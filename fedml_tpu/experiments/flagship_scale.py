@@ -167,6 +167,7 @@ def main(argv=None):
 
     from fedml_tpu.utils import force_platform_from_env
     force_platform_from_env()
+    import jax
     from fedml_tpu.core import pytree as pt
     from fedml_tpu.data.registry import DEFAULT_MODEL_AND_TASK, load_data
     from fedml_tpu.models import create_model
@@ -198,6 +199,12 @@ def main(argv=None):
         "train_samples": ds.train_data_num,
         "eval_test_subsample": args.eval_test_subsample,
         "fused_rounds_per_dispatch": args.fused,
+        # provenance: which backend actually executed this run (the judge
+        # distinguishes chip anchor curves from CPU scale checks by this)
+        "host": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "captured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
     }
     results = {}
     for kind in drivers:
