@@ -1,0 +1,66 @@
+"""Regenerate the synthetic CIFAR-10-format corpus the cross-silo anchor
+protocol trains on (``~/.cache/fedml_tpu_gen/cifar10_synth``).
+
+The corpus is LEARNABLE (class prototypes + pixel noise, the same recipe
+as fedml_tpu/data/flagship_gen.py) and written in the standard CIFAR-10
+python-pickle layout that ``fedml_tpu.data.cifar._read_cifar10_dir``
+reads (``data_batch_*`` with ``b"data"`` rows of 3072 uint8 + ``b"labels"``,
+plus ``test_batch``) — the reference loader's format
+(fedml_api/data_preprocessing/cifar10/data_loader.py). Deterministic
+(seed 0), so a wiped cache regenerates bit-identically.
+"""
+import os
+import pickle
+
+import numpy as np
+
+OUT = os.path.join(os.path.expanduser("~"), ".cache", "fedml_tpu_gen",
+                   "cifar10_synth")
+N_TRAIN, N_TEST, CLASSES = 50000, 10000, 10
+NOISE = 64.0  # uint8-scale pixel noise around each class prototype
+
+
+def _prototypes(rng):
+    # smooth per-class patterns: low-frequency sinusoid mixtures so a
+    # conv net has real spatial structure to learn, not lookup noise
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32.0
+    protos = []
+    for c in range(CLASSES):
+        chans = []
+        for _ in range(3):
+            f1, f2, p1, p2 = rng.uniform(0.5, 3.0, 4)
+            img = (np.sin(2 * np.pi * (f1 * xx + p1))
+                   + np.cos(2 * np.pi * (f2 * yy + p2)))
+            chans.append(img)
+        img = np.stack(chans, -1)
+        img = (img - img.min()) / (np.ptp(img) + 1e-9)
+        protos.append(img * 200.0 + 27.0)
+    return np.stack(protos)  # [C, 32, 32, 3]
+
+
+def _split(rng, protos, n):
+    y = rng.randint(0, CLASSES, n)
+    x = protos[y] + rng.normal(0.0, NOISE, (n, 32, 32, 3))
+    x = np.clip(x, 0, 255).astype(np.uint8)
+    # CIFAR pickle layout: rows are R-plane, G-plane, B-plane flattened
+    rows = x.transpose(0, 3, 1, 2).reshape(n, 3072)
+    return rows, y.astype(int).tolist()
+
+
+def main():
+    rng = np.random.RandomState(0)
+    protos = _prototypes(rng)
+    os.makedirs(OUT, exist_ok=True)
+    per = N_TRAIN // 5
+    for b in range(1, 6):
+        rows, labels = _split(rng, protos, per)
+        with open(os.path.join(OUT, f"data_batch_{b}"), "wb") as f:
+            pickle.dump({b"data": rows, b"labels": labels}, f)
+    rows, labels = _split(rng, protos, N_TEST)
+    with open(os.path.join(OUT, "test_batch"), "wb") as f:
+        pickle.dump({b"data": rows, b"labels": labels}, f)
+    print(f"wrote {N_TRAIN} train + {N_TEST} test to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
