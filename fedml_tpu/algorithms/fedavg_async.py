@@ -35,7 +35,8 @@ from fedml_tpu.algorithms.fedavg_cross_silo import (
     MSG_ARG_KEY_CLIENT_INDEX, MSG_ARG_KEY_MODEL_PARAMS,
     MSG_ARG_KEY_NUM_SAMPLES, MSG_ARG_KEY_ROUND, MSG_TYPE_C2S_SEND_MODEL,
     MSG_TYPE_S2C_FINISH, MSG_TYPE_S2C_INIT_CONFIG, MSG_TYPE_S2C_SYNC_MODEL,
-    FedAvgAggregator, FedAvgClientManager, FedAvgServerManager, _to_numpy)
+    FedAvgAggregator, FedAvgClientManager, FedAvgServerManager,
+    _DEVICE_LOCK, _to_numpy)
 from fedml_tpu.comm.message import Message
 from fedml_tpu.core import pytree as pt
 
@@ -92,10 +93,11 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
                                 self.round_idx) != self.round_idx:
             return  # stale straggler reply from a closed round: discard
         worker = msg.get_sender_id() - 1
+        with _DEVICE_LOCK:  # delta decompression is device compute
+            payload = self._decode_model_payload(
+                msg.get(MSG_ARG_KEY_MODEL_PARAMS))
         self.aggregator.add_local_trained_result(
-            worker, self._decode_model_payload(
-                msg.get(MSG_ARG_KEY_MODEL_PARAMS)),
-            msg.get(MSG_ARG_KEY_NUM_SAMPLES))
+            worker, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES))
         if self.aggregator.check_whether_all_receive():
             self._close_round()
 
@@ -110,10 +112,17 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             self._arm_deadline()  # below quorum: keep waiting
 
     def _close_round(self) -> None:
+        # NOTE: in single-process actor mode the lock below also waits for
+        # any straggler local_train already ON the shared device — the
+        # deadline can fire at t but the close lands when the device frees
+        # up. That is shared-chip physics (one dispatch queue), not a
+        # protocol property; multi-process deployments (one device per
+        # silo) close at the deadline proper.
         self._cancel_deadline()
-        self.global_model = self.aggregator.aggregate_available()
-        if self.on_round_done is not None:
-            self.on_round_done(self.round_idx, self.global_model)
+        with _DEVICE_LOCK:  # aggregate + eval: device compute
+            self.global_model = self.aggregator.aggregate_available()
+            if self.on_round_done is not None:
+                self.on_round_done(self.round_idx, self.global_model)
         self.round_idx += 1
         if self.round_idx == self.comm_round:
             for worker in range(1, self.size):
@@ -123,7 +132,8 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             return
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
-        payload = _to_numpy(self.global_model)
+        with _DEVICE_LOCK:  # D2H transfer is a device dispatch too
+            payload = _to_numpy(self.global_model)
         for worker in range(1, self.size):
             msg = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, worker)
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
@@ -180,14 +190,15 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
                     Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
             self.finish()
             return
-        self.global_model = pt.tree_axpy(
-            a, w_client, pt.tree_scale(self.global_model, 1.0 - a))
-        self.version += 1
-        self.update_log.append({"version": self.version,
-                                "staleness": staleness, "mix": a,
-                                "worker": msg.get_sender_id() - 1})
-        if self.on_round_done is not None:
-            self.on_round_done(self.version, self.global_model)
+        with _DEVICE_LOCK:  # staleness merge + eval: device compute
+            self.global_model = pt.tree_axpy(
+                a, w_client, pt.tree_scale(self.global_model, 1.0 - a))
+            self.version += 1
+            self.update_log.append({"version": self.version,
+                                    "staleness": staleness, "mix": a,
+                                    "worker": msg.get_sender_id() - 1})
+            if self.on_round_done is not None:
+                self.on_round_done(self.version, self.global_model)
         if self.version >= self.max_updates:
             for worker in range(1, self.size):
                 self.send_message(
@@ -198,7 +209,8 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         rng = np.random.RandomState(self.version)
         client_idx = int(rng.randint(0, self.client_num_in_total))
         out = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, msg.get_sender_id())
-        out.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(self.global_model))
+        with _DEVICE_LOCK:  # D2H transfer while other silos may train
+            out.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(self.global_model))
         out.add(MSG_ARG_KEY_CLIENT_INDEX, client_idx)
         out.add(MSG_ARG_KEY_ROUND, self.version)
         self.send_message(out)

@@ -1,0 +1,25 @@
+"""Instrumented cross-silo chip probe: the full 10-silo ResNet-56 anchor
+protocol at comm_round=2 with faulthandler stack dumps if any phase
+stalls — diagnoses where the axon-tunnel cross-silo run wedges."""
+import faulthandler
+import logging
+import os
+
+faulthandler.dump_traceback_later(420, exit=True)
+
+import jax  # noqa: E402
+from fedml_tpu.algorithms.fedavg_cross_silo import run_fedavg_cross_silo  # noqa: E402
+from fedml_tpu.data.cifar import load_partition_data_cifar  # noqa: E402
+from fedml_tpu.models import create_model  # noqa: E402
+from fedml_tpu.trainer.functional import TrainConfig  # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+ds = load_partition_data_cifar(
+    "cifar10", os.path.expanduser("~/.cache/fedml_tpu_gen/cifar10_synth"),
+    partition_method="hetero", partition_alpha=0.5, client_number=10)
+model = create_model("resnet56", output_dim=10)
+print("data+model ready; backend:", jax.default_backend(), flush=True)
+final, hist, _ = run_fedavg_cross_silo(
+    ds, model, worker_num=10, comm_round=2,
+    train_cfg=TrainConfig(batch_size=64, lr=0.01, epochs=20))
+print("DONE", hist, flush=True)
