@@ -380,9 +380,18 @@ def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
     configs = [(bq, bk) for bq, bk in configs
                if seq_len % bq == 0 and seq_len % bk == 0]
     if not configs:
-        # odd seq_len: flash_attention's own min(block, s) clamp handles
-        # it — measure the default rather than silently reporting zero
-        configs = [(128, 128)]
+        # indivisible seq_len: the kernel's grid requires s % block == 0
+        # (its min(block, s) clamp only helps when s < block), so measure
+        # the XLA reference only and say so, instead of crashing or
+        # silently reporting zeros
+        ref_tps = tokens_per_sec(None)
+        return {
+            "tokens_per_sec": round(ref_tps, 1),
+            "seq_len": seq_len,
+            "flash_skipped_indivisible_seq_len": seq_len,
+            "note": "no autotune block divides seq_len; reference "
+                    "attention only",
+        }
     flash_tps, best_cfg = 0.0, configs[0]
     per_cfg = {}
     for bq, bk in configs:

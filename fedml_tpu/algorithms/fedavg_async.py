@@ -119,10 +119,11 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
         # protocol property; multi-process deployments (one device per
         # silo) close at the deadline proper.
         self._cancel_deadline()
-        with _DEVICE_LOCK:  # aggregate + eval: device compute
+        with _DEVICE_LOCK:  # aggregate: device compute
             self.global_model = self.aggregator.aggregate_available()
-            if self.on_round_done is not None:
-                self.on_round_done(self.round_idx, self.global_model)
+        if self.on_round_done is not None:
+            # outside the lock: eval re-locks internally, sink I/O doesn't
+            self.on_round_done(self.round_idx, self.global_model)
         self.round_idx += 1
         if self.round_idx == self.comm_round:
             for worker in range(1, self.size):
@@ -190,15 +191,16 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
                     Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
             self.finish()
             return
-        with _DEVICE_LOCK:  # staleness merge + eval: device compute
+        with _DEVICE_LOCK:  # staleness merge: device compute
             self.global_model = pt.tree_axpy(
                 a, w_client, pt.tree_scale(self.global_model, 1.0 - a))
-            self.version += 1
-            self.update_log.append({"version": self.version,
-                                    "staleness": staleness, "mix": a,
-                                    "worker": msg.get_sender_id() - 1})
-            if self.on_round_done is not None:
-                self.on_round_done(self.version, self.global_model)
+        self.version += 1
+        self.update_log.append({"version": self.version,
+                                "staleness": staleness, "mix": a,
+                                "worker": msg.get_sender_id() - 1})
+        if self.on_round_done is not None:
+            # outside the lock: eval re-locks internally, sink I/O doesn't
+            self.on_round_done(self.version, self.global_model)
         if self.version >= self.max_updates:
             for worker in range(1, self.size):
                 self.send_message(

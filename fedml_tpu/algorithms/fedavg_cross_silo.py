@@ -225,8 +225,9 @@ class FedAvgServerManager(ServerManager):
             return
         with _DEVICE_LOCK:
             self.global_model = self._aggregate_round()
-            if self.on_round_done is not None:
-                self.on_round_done(self.round_idx, self.global_model)
+        if self.on_round_done is not None:
+            # outside the lock: eval re-locks internally, sink I/O doesn't
+            self.on_round_done(self.round_idx, self.global_model)
         self.round_idx += 1
         if self.checkpoint_mgr is not None:
             self.checkpoint_mgr.save(self.round_idx,
@@ -368,7 +369,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           server_lr: float = 1e-3,
                           server_momentum: float = 0.0,
                           seed: int = 0,
-                          join_timeout_s: float = 600.0):
+                          join_timeout_s: float = 600.0,
+                          round_record_hook=None):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -400,7 +402,7 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         dataset, module, task, worker_num, train_cfg, server_factory,
         backend=backend, addresses=addresses, wire_codec=wire_codec,
         compress=compress, token=token, seed=seed,
-        join_timeout_s=join_timeout_s)
+        join_timeout_s=join_timeout_s, round_record_hook=round_record_hook)
     return model, history
 
 
@@ -410,7 +412,8 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       addresses=None, wire_codec: bool = True,
                       compress: bool = False, token=None, seed: int = 0,
                       join_timeout_s: float = 600.0,
-                      raise_on_timeout: bool = False):
+                      raise_on_timeout: bool = False,
+                      round_record_hook=None):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
@@ -429,16 +432,30 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
 
     def on_round_done(round_idx, model):
         xt, yt = dataset.test_data_global
-        if len(xt):
+        if not len(xt):
+            return
+        with _DEVICE_LOCK:  # only the eval is device compute
             stats = eval_fn(model, jnp.asarray(xt), jnp.asarray(yt),
                             jnp.ones(len(xt), jnp.float32))
-            history.append({
-                "round": round_idx,
-                "test_acc": float(stats["correct_sum"]) /
-                max(1.0, float(stats["count"])),
-                "test_loss": float(stats["loss_sum"]) /
-                max(1.0, float(stats["count"])),
-            })
+            acc = float(stats["correct_sum"]) / max(1.0,
+                                                    float(stats["count"]))
+            loss = float(stats["loss_sum"]) / max(1.0,
+                                                  float(stats["count"]))
+        # history/log/sink I/O happen OUTSIDE the lock: a slow sink (file
+        # I/O, wandb HTTP) must not stall every silo's local_train
+        rec = {"round": round_idx, "test_acc": acc, "test_loss": loss}
+        history.append(rec)
+        logging.info("cross-silo round %d: %s", round_idx, rec)
+        if round_record_hook is not None:
+            # stream to the caller's sink AS ROUNDS LAND — a 100-round
+            # chip protocol is otherwise indistinguishable from a hang
+            # until the final join (observed, round 5). Never let a sink
+            # error kill the server receive loop.
+            try:
+                round_record_hook(rec)
+            except Exception:
+                logging.warning("round_record_hook failed for round %d",
+                                round_idx, exc_info=True)
 
     aggregator = FedAvgAggregator(worker_num)
     server_com = create_comm_manager(backend, 0, size, router=router,
@@ -462,20 +479,29 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     # eval_fn closure), so round 0 costs worker_num executions instead of
     # worker_num serialized ~40 s compiles on receive threads.
     try:
+        import time as _time
         n_pad = dataset.padded_len(train_cfg.batch_size)
         wx, wy, wmask = dataset.pack_clients([0], train_cfg.batch_size,
                                              n_pad=n_pad)
+        t0 = _time.time()
+        logging.info("cross-silo warmup: local_train compile (n_pad=%d)...",
+                     n_pad)
         warm_vars, _ = _shared_local_train(module, task, train_cfg)(
             global_model, jnp.asarray(wx[0]), jnp.asarray(wy[0]),
             jnp.asarray(wmask[0]), jax.random.key(seed))
+        jax.block_until_ready(warm_vars)
+        del warm_vars
+        logging.info("cross-silo warmup: local_train ready in %.1fs; "
+                     "eval compile...", _time.time() - t0)
+        t0 = _time.time()
         xt, yt = dataset.test_data_global
         if len(xt):
             warm_stats = eval_fn(global_model, jnp.asarray(xt),
                                  jnp.asarray(yt),
                                  jnp.ones(len(xt), jnp.float32))
             jax.block_until_ready(warm_stats)
-        jax.block_until_ready(warm_vars)
-        del warm_vars
+        logging.info("cross-silo warmup: eval ready in %.1fs (test n=%d)",
+                     _time.time() - t0, len(xt))
     except Exception:  # warmup is an optimization, never a launch blocker
         logging.warning("cross-silo warmup compile failed; silos will "
                         "compile lazily on their receive threads",

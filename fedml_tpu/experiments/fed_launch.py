@@ -195,6 +195,7 @@ def run_algo(args):
         # alpha=0.5, E=20, B=64, ResNet-56).
         from fedml_tpu.algorithms.fedavg_cross_silo import (
             run_fedavg_cross_silo)
+        sink_live = [True]
         if args.frequency_of_the_test != 1:
             logging.warning("--frequency_of_the_test is not wired for "
                             "--algo fedavg_cross_silo (the actor protocol "
@@ -213,9 +214,17 @@ def run_algo(args):
             # wait: fast hosts finish and join immediately.
             join_timeout_s=max(1200.0, 30.0 * args.epochs
                                * args.comm_round
-                               * max(1, args.client_num_per_round)))
-        for rec in history:
-            sink.log(rec, step=rec.get("round"))
+                               * max(1, args.client_num_per_round)),
+            # stream each round into metrics.jsonl as it lands: a long
+            # chip protocol must be observable mid-run (a buffered-to-end
+            # history is indistinguishable from a hang). The liveness
+            # gate closes the hook before sink.finish(): on the
+            # non-raising join-timeout path the daemon server thread can
+            # complete further rounds AFTER this function returns, and
+            # those must not write to a finished sink.
+            round_record_hook=lambda rec: (
+                sink_live[0] and sink.log(rec, step=rec.get("round"))))
+        sink_live[0] = False
         sink.finish()
         return history[-1] if history else {}
     if args.checkpoint_dir:
