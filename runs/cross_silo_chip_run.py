@@ -1,0 +1,22 @@
+"""Launch the cross-silo ResNet-56 chip anchor with on-demand stack dumps:
+``kill -USR1 <pid>`` appends every thread's Python stack to stderr, so a
+tunnel wedge can be located without killing the run."""
+import faulthandler
+import signal
+import sys
+
+faulthandler.enable()  # native crashes (SIGSEGV in the tunnel client) too
+faulthandler.register(signal.SIGUSR1, all_threads=True)
+faulthandler.dump_traceback_later(1200, repeat=True)  # heartbeat stacks
+
+from fedml_tpu.experiments import fed_launch  # noqa: E402
+
+sys.exit(fed_launch.main([
+    "--algo", "fedavg_cross_silo", "--dataset", "cifar10",
+    "--data_dir", sys.argv[1],
+    "--model", "resnet56", "--partition_method", "hetero",
+    "--partition_alpha", "0.5",
+    "--client_num_in_total", "10", "--client_num_per_round", "10",
+    "--comm_round", "100", "--epochs", "20", "--batch_size", "64",
+    "--lr", "0.01", "--run_dir", "runs/cross_silo_resnet56_chip",
+]) and 0)
