@@ -2,8 +2,11 @@
 ``kill -USR1 <pid>`` appends every thread's Python stack to stderr, so a
 tunnel wedge can be located without killing the run."""
 import faulthandler
+import os
 import signal
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 faulthandler.enable()  # native crashes (SIGSEGV in the tunnel client) too
 faulthandler.register(signal.SIGUSR1, all_threads=True)

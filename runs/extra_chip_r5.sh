@@ -15,6 +15,9 @@ if [ ! -f runs/flagship_shakespeare_tta_chip/summary.json ]; then
 fi
 
 if [ ! -f runs/cross_silo_resnet56_chip/metrics.jsonl ]; then
+  # the corpus is synthetic and cache-resident; regenerate if wiped
+  [ -d "$HOME/.cache/fedml_tpu_gen/cifar10_synth" ] || \
+    python3 runs/gen_cifar10_synth.py >> runs/cross_silo_resnet56_chip.log 2>&1
   # the cross-silo CIFAR10 anchor protocol at the FULL reference config
   # (benchmark/README.md:105): 10 silos, LDA alpha=0.5, E=20, B=64,
   # ResNet-56, 100 rounds. ~35 s/step on this host's CPU (8h) but ~2 ms
