@@ -37,7 +37,7 @@ from fedml_tpu.core.sampling import (DEVICE_SAMPLE_SENTINEL, eval_subsample,
                                      round_keys, sample_clients)
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
-                                          make_local_train)
+                                          make_local_train, round_lr_scale)
 
 #: per-round heartbeat for long host loops (the eval records land only every
 #: frequency_of_the_test rounds, which leaves multi-minute CPU rounds
@@ -46,12 +46,18 @@ _progress_log = logging.getLogger("fedml_tpu.progress")
 def make_vmapped_body(local_train):
     """vmap local training over the client axis and sum stats — the shared
     round body every FedAvg-family algorithm composes with its own
-    aggregation rule."""
+    aggregation rule. ``lr_scale`` (optional scalar, broadcast to every
+    client) applies TrainConfig.lr_decay_round's per-round schedule; None
+    traces the identical constant-LR program as before."""
 
-    def body(variables, x, y, mask, keys):
+    def body(variables, x, y, mask, keys, lr_scale=None):
+        # lr_scale=None traces the identical constant-LR program
+        # (local_train skips the multiply at trace time), so one vmap
+        # covers both the scheduled and unscheduled paths
         stacked, stats = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0))(variables, x, y, mask,
-                                                     keys)
+            lambda v, xc, yc, mc, kc: local_train(
+                v, xc, yc, mc, kc, lr_scale=lr_scale),
+            in_axes=(None, 0, 0, 0, 0))(variables, x, y, mask, keys)
         totals = jax.tree.map(lambda s: jnp.sum(s, axis=0), stats)
         return stacked, totals
 
@@ -137,8 +143,10 @@ class FedAvgAPI:
                     pt.tree_weighted_mean(stacked, weights))
         body = self._vmapped_body
 
-        def round_fn(variables, x, y, mask, keys, weights, agg_key):
-            stacked, totals = body(variables, x, y, mask, keys)
+        def round_fn(variables, x, y, mask, keys, weights, agg_key,
+                     round_idx):
+            stacked, totals = body(variables, x, y, mask, keys,
+                                   round_lr_scale(cfg, round_idx))
             new_vars = hook(variables, stacked, weights, agg_key)
             return new_vars, totals
 
@@ -229,7 +237,8 @@ class FedAvgAPI:
         with self.timer.phase("dispatch"):
             self.variables, stats = self._round_fn(self.variables, x, y,
                                                    mask, keys, weights,
-                                                   agg_key)
+                                                   agg_key,
+                                                   jnp.uint32(round_idx))
         return idxs, stats
 
     # -- the outer loop (reference fedavg_api.py:46-95) ---------------------
@@ -391,7 +400,7 @@ class FusedRounds:
             else:
                 ids = jnp.arange(N, dtype=jnp.uint32)
             _, keys, agg_key = round_keys(base_key, r, ids)
-            return round_step(carry, x, y, mask, keys, weights, agg_key)
+            return round_step(carry, x, y, mask, keys, weights, agg_key, r)
 
         def run(carry, x, y, mask, weights, r0, rounds):
             return jax.lax.scan(
@@ -403,7 +412,7 @@ class FusedRounds:
         def block_round(carry, inp):
             r, x, y, mask, ids, weights = inp
             _, keys, agg_key = round_keys(base_key, r, ids)
-            return round_step(carry, x, y, mask, keys, weights, agg_key)
+            return round_step(carry, x, y, mask, keys, weights, agg_key, r)
 
         def run_block(carry, xs, ys, masks, ids, ws, r0):
             rs = r0 + jnp.arange(xs.shape[0], dtype=jnp.uint32)
@@ -443,11 +452,13 @@ class FusedRounds:
     def _store_carry(self, carry) -> None:
         self.api.variables = carry
 
-    def _round(self, carry, x, y, mask, keys, weights, agg_key):
+    def _round(self, carry, x, y, mask, keys, weights, agg_key, r):
         """One round on the scan carry; the base carry is the variables
-        tree and the body is the exact host-loop round program."""
+        tree and the body is the exact host-loop round program (``r`` is
+        the traced round index — the lr_decay_round schedule inside
+        round_fn depends on it)."""
         return self.api._round_fn_py(carry, x, y, mask, keys, weights,
-                                     agg_key)
+                                     agg_key, r)
 
     def run_rounds(self, r0: int, rounds: int):
         """Advance the api's model by ``rounds`` fused rounds starting at

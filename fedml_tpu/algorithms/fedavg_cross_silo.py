@@ -37,7 +37,8 @@ from fedml_tpu.comm.inproc import InProcRouter
 from fedml_tpu.core import pytree as pt
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.base import FederatedDataset
-from fedml_tpu.trainer.functional import TrainConfig, make_eval, make_local_train
+from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
+                                          make_local_train, round_lr_scale)
 
 # -- message schema (reference message_define.py) ---------------------------
 MSG_TYPE_S2C_INIT_CONFIG = 1
@@ -314,6 +315,7 @@ class FedAvgClientManager(ClientManager):
         from fedml_tpu.trainer.functional import validate_accum_steps
         validate_accum_steps(train_cfg, dataset.train_data_local_num_dict)
         self._local_train = _shared_local_train(module, task, train_cfg)
+        self._train_cfg = train_cfg
         self._n_pad = dataset.padded_len(train_cfg.batch_size)
         self._bsz = train_cfg.batch_size
         self._base_key = jax.random.key(seed)
@@ -334,12 +336,22 @@ class FedAvgClientManager(ClientManager):
         x, y, mask = self.dataset.pack_clients([client_idx], self._bsz,
                                                n_pad=self._n_pad)
         reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        # the scale is a pure function of round_idx (identical for every
+        # silo this round), computed OUTSIDE the device lock with the
+        # SHARED f32 formula (round_lr_scale) so every driver path scales
+        # by the bit-identical factor
+        scale = round_lr_scale(self._train_cfg, round_idx)
         with _DEVICE_LOCK:
             key = jax.random.fold_in(
                 jax.random.fold_in(self._base_key, round_idx), client_idx)
-            new_vars, _ = self._local_train(
-                variables, jnp.asarray(x[0]), jnp.asarray(y[0]),
-                jnp.asarray(mask[0]), key)
+            if scale is None:
+                new_vars, _ = self._local_train(
+                    variables, jnp.asarray(x[0]), jnp.asarray(y[0]),
+                    jnp.asarray(mask[0]), key)
+            else:
+                new_vars, _ = self._local_train(
+                    variables, jnp.asarray(x[0]), jnp.asarray(y[0]),
+                    jnp.asarray(mask[0]), key, lr_scale=scale)
             if self.compress:
                 from fedml_tpu.comm.compression import compress_delta
                 ckey = jax.random.fold_in(jax.random.fold_in(
@@ -486,9 +498,14 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
         t0 = _time.time()
         logging.info("cross-silo warmup: local_train compile (n_pad=%d)...",
                      n_pad)
+        warm_kw = {}
+        if train_cfg.lr_decay_round != 1.0:
+            # silos will call with lr_scale (a different traced signature)
+            # — warm THAT program, not the constant-lr one
+            warm_kw["lr_scale"] = round_lr_scale(train_cfg, 0)
         warm_vars, _ = _shared_local_train(module, task, train_cfg)(
             global_model, jnp.asarray(wx[0]), jnp.asarray(wy[0]),
-            jnp.asarray(wmask[0]), jax.random.key(seed))
+            jnp.asarray(wmask[0]), jax.random.key(seed), **warm_kw)
         jax.block_until_ready(warm_vars)
         del warm_vars
         logging.info("cross-silo warmup: local_train ready in %.1fs; "

@@ -157,6 +157,11 @@ class FedNovaAPI:
         self.module = module
         self.config = config or FedNovaConfig()
         cfg = self.config
+        if cfg.train.lr_decay_round != 1.0:
+            raise NotImplementedError(
+                "lr_decay_round is not threaded through FedNova's "
+                "normalized-gradient local program; use fedavg/fedopt for "
+                "the round schedule")
         local = make_fednova_local_train(module, task, cfg)
 
         def round_fn(variables, momentum_buf, x, y, mask, keys, ratios):

@@ -16,12 +16,14 @@ import dataclasses
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from fedml_tpu.core import pytree as pt
 from fedml_tpu.algorithms.fedavg import (FedAvgAPI, FedAvgConfig,
                                          FusedRounds)
 from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.trainer.functional import round_lr_scale
 
 #: name -> constructor(lr, **kw); parity with OptRepo's name2cls lookup
 OPTIMIZER_REPO = {
@@ -74,8 +76,11 @@ class FedOptAPI(FedAvgAPI):
         body = self._vmapped_body
         server_tx = self._server_tx
 
-        def round_fn(variables, opt_state, x, y, mask, keys, weights):
-            stacked, totals = body(variables, x, y, mask, keys)
+        def round_fn(variables, opt_state, x, y, mask, keys, weights,
+                     round_idx):
+            stacked, totals = body(variables, x, y, mask, keys,
+                                   round_lr_scale(self.config.train,
+                                                  round_idx))
             avg = pt.tree_weighted_mean(stacked, weights)
             # pseudo-gradient: w_old - w_avg (the server walks opposite the
             # aggregate displacement; FedOptAggregator.py:109-123)
@@ -95,7 +100,8 @@ class FedOptAPI(FedAvgAPI):
     def run_round(self, round_idx: int):
         idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
         self.variables, self.server_opt_state, stats = self._fedopt_round_fn(
-            self.variables, self.server_opt_state, x, y, mask, keys, weights)
+            self.variables, self.server_opt_state, x, y, mask, keys, weights,
+            jnp.uint32(round_idx))
         return idxs, stats
 
 
@@ -112,10 +118,10 @@ class FedOptFusedRounds(FusedRounds):
     def _store_carry(self, carry) -> None:
         self.api.variables, self.api.server_opt_state = carry
 
-    def _round(self, carry, x, y, mask, keys, weights, agg_key):
+    def _round(self, carry, x, y, mask, keys, weights, agg_key, r):
         variables, opt_state = carry
         new_vars, new_opt, totals = self.api._fedopt_round_fn_py(
-            variables, opt_state, x, y, mask, keys, weights)
+            variables, opt_state, x, y, mask, keys, weights, r)
         return (new_vars, new_opt), totals
 
 

@@ -61,6 +61,11 @@ class HierarchicalFedAvgAPI:
         cfg = self.config
         if cfg.group_method != "random":
             raise ValueError(f"unknown group_method {cfg.group_method!r}")
+        if cfg.train.lr_decay_round != 1.0:
+            raise NotImplementedError(
+                "lr_decay_round is not defined for the 2-tier loop (which "
+                "round index decays — group or global?); use the flat "
+                "FedAvg drivers for the schedule")
         np.random.seed(cfg.seed)
         self.group_indexes = np.random.randint(0, cfg.group_num,
                                                dataset.client_num)

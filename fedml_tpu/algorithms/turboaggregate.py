@@ -120,7 +120,12 @@ class SecureFedAvgAPI(FedAvgAPI):
 
     def run_round(self, round_idx: int):
         idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
-        stacked, stats = self._body_fn(self.variables, x, y, mask, keys)
+        from fedml_tpu.trainer.functional import round_lr_scale
+        scale = round_lr_scale(self.config.train, round_idx)
+        stacked, stats = (self._body_fn(self.variables, x, y, mask, keys)
+                          if scale is None else
+                          self._body_fn(self.variables, x, y, mask, keys,
+                                        lr_scale=scale))
         self.variables = self._secure.aggregate(stacked, np.asarray(weights),
                                                 round_idx=round_idx)
         return idxs, stats

@@ -45,6 +45,10 @@ def add_federated_args(parser: argparse.ArgumentParser):
                         help="average grads over k micro-batches per "
                              "optimizer step (effective batch = "
                              "k * batch_size, one micro-batch of HBM)")
+    parser.add_argument("--lr_decay_round", type=float, default=1.0,
+                        help="per-round exponential client-LR decay: "
+                             "effective lr at round r is lr * decay**r "
+                             "(1.0 = the reference's constant lr)")
     parser.add_argument("--model_parallel", type=str, default=None,
                         choices=[None, "tp", "fsdp"],
                         help="spmd backend: shard the model over a second "

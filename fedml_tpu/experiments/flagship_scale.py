@@ -82,7 +82,7 @@ def _incremental_history(api, path: str, period_s: float = 20.0):
 def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                eval_every: int, batch_size: int, lr: float, seed: int,
                eval_test_sub: int = None, history_path: str = None,
-               fused: int = 0):
+               fused: int = 0, lr_decay_round: float = 1.0):
     """One driver end to end; returns (history, variables, stats).
 
     ``fused > 0`` routes the sim driver through ``FusedRounds.train``
@@ -94,7 +94,8 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
     from fedml_tpu.core.sampling import sample_clients
     from fedml_tpu.trainer.functional import TrainConfig
 
-    tcfg = TrainConfig(epochs=1, batch_size=batch_size, lr=lr)
+    tcfg = TrainConfig(epochs=1, batch_size=batch_size, lr=lr,
+                       lr_decay_round=lr_decay_round)
     shapes = {ds.cohort_padded_len(
         sample_clients(r, ds.client_num, per_round), batch_size)
         for r in range(rounds)}
@@ -159,6 +160,10 @@ def main(argv=None):
                    help="sim driver: fuse up to R rounds per device "
                         "dispatch (FusedRounds.train; 0 = per-round host "
                         "loop). Trajectory-identical to the host loop.")
+    p.add_argument("--lr_decay_round", type=float, default=1.0,
+                   help="per-round exponential client-LR decay "
+                        "(TrainConfig.lr_decay_round; 1.0 = reference "
+                        "constant lr)")
     p.add_argument("--out", type=str, required=True)
     args = p.parse_args(argv)
 
@@ -199,6 +204,7 @@ def main(argv=None):
         "train_samples": ds.train_data_num,
         "eval_test_subsample": args.eval_test_subsample,
         "fused_rounds_per_dispatch": args.fused,
+        "lr_decay_round": args.lr_decay_round,
         # provenance: which backend actually executed this run (the judge
         # distinguishes chip anchor curves from CPU scale checks by this)
         "host": jax.default_backend(),
@@ -222,7 +228,7 @@ def main(argv=None):
             kind, ds, model, task, args.rounds, args.client_num_per_round,
             args.eval_every, args.batch_size, args.lr, args.seed,
             eval_test_sub=args.eval_test_subsample, history_path=hist_path,
-            fused=args.fused)
+            fused=args.fused, lr_decay_round=args.lr_decay_round)
         results[kind] = (hist, variables)
         summary[kind] = {**stats,
                          "final": hist[-1] if hist else {}}

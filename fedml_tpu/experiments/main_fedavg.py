@@ -28,7 +28,8 @@ def make_train_config(args) -> TrainConfig:
                        lr=args.lr, client_optimizer=args.client_optimizer,
                        wd=args.wd,
                        compute_dtype=getattr(args, "compute_dtype", None),
-                       accum_steps=getattr(args, "accum_steps", 1))
+                       accum_steps=getattr(args, "accum_steps", 1),
+                       lr_decay_round=getattr(args, "lr_decay_round", 1.0))
 
 
 def run_simulation(args, ds, model, task, sink):

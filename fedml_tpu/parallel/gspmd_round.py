@@ -58,6 +58,10 @@ def make_sharded_federated_round(model, task: str, cfg, mesh: Mesh,
     from fedml_tpu.core import pytree as pt
     from fedml_tpu.trainer.functional import make_local_train
 
+    if getattr(cfg, "lr_decay_round", 1.0) != 1.0:
+        raise NotImplementedError(
+            "lr_decay_round is not threaded through the model-parallel "
+            "(gspmd) round — run the schedule on the sim/spmd drivers")
     body = make_vmapped_body(make_local_train(model, task, cfg))
 
     def round_fn(variables, x, y, mask, keys, weights):

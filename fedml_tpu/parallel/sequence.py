@@ -219,6 +219,10 @@ def make_seq_federated_round(lm, cfg, mesh: Mesh,
     from fedml_tpu.parallel.spmd import (_pvary, _weighted_psum_mean)
     from fedml_tpu.trainer.functional import make_local_train
 
+    if getattr(cfg, "lr_decay_round", 1.0) != 1.0:
+        raise NotImplementedError(
+            "lr_decay_round is not threaded through the sequence-parallel "
+            "round; use the flat clients-axis drivers for the schedule")
     module = _SeqShardedLM(lm, seq_axis)
     local_train = make_local_train(module, task, cfg,
                                    grad_sync_axes=(seq_axis,))

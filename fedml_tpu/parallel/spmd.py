@@ -34,7 +34,7 @@ from fedml_tpu.core.sampling import (eval_subsample, round_keys,
                                      sample_clients)
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
-                                          make_local_train)
+                                          make_local_train, round_lr_scale)
 
 
 def build_mesh(axis_sizes: Dict[str, int],
@@ -89,20 +89,31 @@ def make_spmd_round(module, task: str, cfg: TrainConfig, mesh: Mesh,
     the caller reuses the same variables across calls (parity tests).
     """
     local_train = make_local_train(module, task, cfg)
+    decayed = cfg.lr_decay_round != 1.0
 
-    def body(variables, x, y, mask, keys, weights):
+    def body(variables, x, y, mask, keys, weights, *maybe_r):
         variables = _pvary(variables, (axis,))
-        stacked, stats = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
-            variables, x, y, mask, keys)
+        # replicated round index -> decay**r scale, broadcast to the
+        # vmapped clients (same f32 power as the sim driver's round_fn,
+        # so sim==mesh parity holds under the schedule too); None traces
+        # the identical constant-LR program
+        scale = round_lr_scale(cfg, maybe_r[0]) if decayed else None
+        stacked, stats = jax.vmap(
+            lambda v, xc, yc, mc, kc: local_train(
+                v, xc, yc, mc, kc, lr_scale=scale),
+            in_axes=(None, 0, 0, 0, 0))(variables, x, y, mask, keys)
         new_vars = _weighted_psum_mean(stacked, weights, (axis,))
         totals = jax.tree.map(
             lambda s: jax.lax.psum(jnp.sum(s, axis=0), axis), stats)
         return new_vars, totals
 
     sharded = P(axis)
+    in_specs = (P(), sharded, sharded, sharded, sharded, sharded)
+    if decayed:  # extra replicated round-index operand
+        in_specs = in_specs + (P(),)
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), sharded, sharded, sharded, sharded, sharded),
+        in_specs=in_specs,
         out_specs=(P(), P()),
         check_vma=check_vma,
     ), donate_argnums=(0,) if donate else ())
@@ -134,9 +145,12 @@ def make_spmd_multiround(module, task: str, cfg: TrainConfig, mesh: Mesh,
 
         def one_round(vars_r, r):
             _, keys, _ = round_keys(base_key, r, client_ids)
+            scale = round_lr_scale(cfg, r)
             stacked, stats = jax.vmap(
-                local_train, in_axes=(None, 0, 0, 0, 0))(vars_r, x, y,
-                                                         mask, keys)
+                lambda v, xc, yc, mc, kc: local_train(
+                    v, xc, yc, mc, kc, lr_scale=scale),
+                in_axes=(None, 0, 0, 0, 0))(vars_r, x, y,
+                                            mask, keys)
             new_vars = _weighted_psum_mean(stacked, weights, (axis,))
             totals = jax.tree.map(
                 lambda s: jax.lax.psum(jnp.sum(s, axis=0), axis), stats)
@@ -194,9 +208,12 @@ def make_spmd_block_multiround(module, task: str, cfg: TrainConfig,
         def one_round(vars_r, inp):
             r, x, y, mask, ids, weights = inp
             _, keys, _ = round_keys(base_key, r, ids)
+            scale = round_lr_scale(cfg, r)
             stacked, stats = jax.vmap(
-                local_train, in_axes=(None, 0, 0, 0, 0))(vars_r, x, y,
-                                                         mask, keys)
+                lambda v, xc, yc, mc, kc: local_train(
+                    v, xc, yc, mc, kc, lr_scale=scale),
+                in_axes=(None, 0, 0, 0, 0))(vars_r, x, y,
+                                            mask, keys)
             new_vars = _weighted_psum_mean(stacked, weights, (axis,))
             totals = jax.tree.map(
                 lambda s: jax.lax.psum(jnp.sum(s, axis=0), axis), stats)
@@ -246,6 +263,10 @@ def make_hierarchical_spmd_round(module, task: str, cfg: TrainConfig,
     group), then one cloud aggregation (psum over 'group') — the reference's
     hierarchical_fl group/global loop (hierarchical_fl/{trainer,group}.py) as
     nested collectives."""
+    if cfg.lr_decay_round != 1.0:
+        raise NotImplementedError(
+            "lr_decay_round is not defined for the 2-tier round (ambiguous "
+            "round index); use the flat FedAvg drivers for the schedule")
     local_train = make_local_train(module, task, cfg)
 
     def body(variables, x, y, mask, keys, weights):
@@ -328,6 +349,10 @@ class DistributedFedAvgAPI:
         mp = self.config.model_parallel
         if mp and mp not in ("tp", "fsdp"):
             raise ValueError(f"unknown model_parallel: {mp!r}")
+        if mp and self.config.train.lr_decay_round != 1.0:
+            raise NotImplementedError(
+                "lr_decay_round is not threaded through the model-parallel "
+                "(gspmd) round; use the flat clients-axis mesh")
         if self.config.pack not in ("cohort", "global"):
             raise ValueError(f"unknown pack policy: {self.config.pack!r}")
         from fedml_tpu.trainer.functional import validate_accum_steps
@@ -459,8 +484,15 @@ class DistributedFedAvgAPI:
             _, keys, _ = round_keys(
                 self._base_key, round_idx,
                 jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
-            self.variables, stats = self._round_fn(
-                self.variables, xd, yd, maskd, put(keys), wd)
+            if self.config.train.lr_decay_round != 1.0:
+                # decayed builder takes the replicated round index as its
+                # final operand (make_spmd_round's conditional spec)
+                self.variables, stats = self._round_fn(
+                    self.variables, xd, yd, maskd, put(keys), wd,
+                    jnp.uint32(round_idx))
+            else:
+                self.variables, stats = self._round_fn(
+                    self.variables, xd, yd, maskd, put(keys), wd)
         return idxs, stats
 
     def run_rounds_fused(self, r0: int, rounds: int):

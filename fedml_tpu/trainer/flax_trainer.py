@@ -31,6 +31,10 @@ class FlaxModelTrainer(ModelTrainer):
         self.module = module
         self.task = task
         self.cfg = cfg or TrainConfig()
+        if self.cfg.lr_decay_round != 1.0:
+            raise NotImplementedError(
+                "lr_decay_round is a ROUND-level schedule; the ModelTrainer "
+                "operator has no round index — drivers apply it")
         self._rng = jax.random.key(seed)
         self._variables = None
         self._train_fn = jax.jit(make_local_train(module, task, self.cfg))
