@@ -151,12 +151,19 @@ def _round_costs(api) -> "tuple[float, float]":
     """(FLOPs, bytes accessed) of the compiled round program — the XLA
     cost model's post-fusion accounting, so the bytes figure is the
     compiler's own HBM-traffic estimate for the exact program that runs."""
-    from fedml_tpu.utils.flops import cost_analysis
+    import jax.numpy as jnp
 
     _, args = api._prepare_round(0)
     try:
-        costs = cost_analysis(
-            lambda v, *a: api._round_fn(v, *a), api.variables, *args)
+        # lower the EXACT jitted round program run_round dispatches —
+        # round_idx is its final traced operand (lr_decay_round schedule);
+        # re-jitting a wrapper would constant-fold it and pay a second
+        # trace+compile of the round
+        analysis = (api._round_fn.lower(api.variables, *args, jnp.uint32(0))
+                    .compile().cost_analysis())
+        if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+            analysis = analysis[0] if analysis else {}
+        costs = dict(analysis or {})
         return (float(costs.get("flops", float("nan"))),
                 float(costs.get("bytes accessed", float("nan"))))
     except Exception:  # cost model unavailable on some backends
