@@ -175,6 +175,12 @@ def _round_flops(api) -> float:
     return _round_costs(api)[0]
 
 
+def _nn(x):
+    """nan -> None so emitted JSON stays RFC-8259 valid (bare NaN
+    literals break every strict parser — jq, JSON.parse, Go/Rust)."""
+    return None if x != x else x
+
+
 def _bench_rounds(api, timed_rounds: int) -> float:
     import jax
 
@@ -202,9 +208,9 @@ def bench_fedavg_cnn() -> dict:
     peak = _device_peak_tflops() * 1e12
     return {
         "rounds_per_sec": round(rps, 3),
-        "round_flops": flops,
-        "achieved_tflops": round(achieved / 1e12, 3),
-        "mfu": round(achieved / peak, 4) if peak == peak else None,
+        "round_flops": _nn(flops),
+        "achieved_tflops": _nn(round(achieved / 1e12, 3)),
+        "mfu": _nn(round(achieved / peak, 4)) if peak == peak else None,
         "phase_ms": {k: round(v * 1e3, 3)
                      for k, v in api.timer.means().items()},
     }
@@ -327,9 +333,9 @@ def bench_resnet18_gn() -> dict:
     peak = _device_peak_tflops() * 1e12
     return {
         "rounds_per_sec": round(rps, 3),
-        "round_flops": flops,
-        "achieved_tflops": round(achieved / 1e12, 3),
-        "mfu": round(achieved / peak, 4) if peak == peak else None,
+        "round_flops": _nn(flops),
+        "achieved_tflops": _nn(round(achieved / 1e12, 3)),
+        "mfu": _nn(round(achieved / peak, 4)) if peak == peak else None,
     }
 
 
@@ -809,8 +815,8 @@ def bench_smoke_chip() -> dict:
     rps = _bench_rounds(api, 10)
     peak = _device_peak_tflops() * 1e12
     out["rounds_per_sec"] = round(rps, 3)
-    out["achieved_tflops"] = round(rps * flops / 1e12, 3)
-    out["mfu"] = round(rps * flops / peak, 4) if peak == peak else None
+    out["achieved_tflops"] = _nn(round(rps * flops / 1e12, 3))
+    out["mfu"] = _nn(round(rps * flops / peak, 4)) if peak == peak else None
     if tpu:
         api16 = _make_api("cnn", 28, 1, CLASSES, 11,
                           compute_dtype="bfloat16")
@@ -984,13 +990,27 @@ def _fresh_chip_rows(partial: dict, max_age_s: float = 18 * 3600) -> dict:
     return fresh
 
 
+def _no_nan(obj):
+    """Recursively nan/inf -> None: persisted artifacts must stay strict
+    RFC-8259 JSON (json.dump would happily write bare NaN literals that
+    break jq/JSON.parse/Go consumers of the evidence files)."""
+    if isinstance(obj, dict):
+        return {k: _no_nan(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_no_nan(v) for v in obj]
+    if isinstance(obj, float) and (obj != obj or obj in (float("inf"),
+                                                         float("-inf"))):
+        return None
+    return obj
+
+
 def _persist_partial(partial: dict) -> None:
     """Write per-stage results as they land (runs/bench_partial.json): a
     mid-suite tunnel wedge can kill the process, but every stage that
     completed stays on disk as evidence."""
     os.makedirs("runs", exist_ok=True)
     with open(os.path.join("runs", "bench_partial.json"), "w") as f:
-        json.dump(partial, f, indent=2)
+        json.dump(_no_nan(partial), f, indent=2)
 
 
 def _emit(line: dict) -> None:
@@ -998,6 +1018,7 @@ def _emit(line: dict) -> None:
     runs/bench_details.json (also on failure paths, so a stale success
     file can never shadow the latest outcome)."""
     os.makedirs("runs", exist_ok=True)
+    line = _no_nan(line)
     with open(os.path.join("runs", "bench_details.json"), "w") as f:
         json.dump(line, f, indent=2)
     print(json.dumps(line), flush=True)

@@ -503,9 +503,18 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
             # silos will call with lr_scale (a different traced signature)
             # — warm THAT program, not the constant-lr one
             warm_kw["lr_scale"] = round_lr_scale(train_cfg, 0)
+        # mirror the ACTOR call exactly: silos receive the model as
+        # wire-decoded NUMPY arrays (uncommitted), not the init's
+        # device-committed tree — jit caches on input shardings, so a
+        # committed-tree warmup can leave the actors' uncommitted-input
+        # program cold (observed as a second multi-minute round-0 compile
+        # on the tunnel chip) and the key as fold_in output, as in
+        # handle_message_init
+        warm_key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(seed), 0), 0)
         warm_vars, _ = _shared_local_train(module, task, train_cfg)(
-            global_model, jnp.asarray(wx[0]), jnp.asarray(wy[0]),
-            jnp.asarray(wmask[0]), jax.random.key(seed), **warm_kw)
+            _to_numpy(global_model), jnp.asarray(wx[0]), jnp.asarray(wy[0]),
+            jnp.asarray(wmask[0]), warm_key, **warm_kw)
         jax.block_until_ready(warm_vars)
         del warm_vars
         logging.info("cross-silo warmup: local_train ready in %.1fs; "
