@@ -181,6 +181,41 @@ class TestCrossSiloDecayParity:
         assert history and history[-1]["round"] == 2
 
 
+class TestCrossSiloWarmupSharing:
+    @pytest.mark.parametrize("decay", [1.0, 0.9])
+    def test_silos_hit_the_warmed_jit_entry(self, small_dataset, decay,
+                                            caplog):
+        """The main-thread warmup must compile the ONE signature the silo
+        actors later call — device-tree vs wire-decoded-numpy inputs (or a
+        missing lr_scale operand under the schedule) would add a second
+        trace, which on the tunnel chip costs a multi-minute round-0
+        compile on a receive thread (observed live, round 5)."""
+        import logging
+
+        from fedml_tpu.algorithms import fedavg_cross_silo as cs
+
+        ds = small_dataset
+        tcfg = TrainConfig(epochs=1, batch_size=4, lr=0.1,
+                           lr_decay_round=decay)
+        module = LogisticRegression(num_classes=ds.class_num)
+        shared = cs._shared_local_train(module, "classification", tcfg)
+        if getattr(shared, "_cache_size", None) is None:
+            pytest.skip("jit._cache_size unavailable on this jax version")
+        base = shared._cache_size()
+        with caplog.at_level(logging.WARNING):
+            cs.run_fedavg_cross_silo(ds, module, worker_num=ds.client_num,
+                                     comm_round=2, train_cfg=tcfg)
+        # the warmup block swallows its own exceptions by design (never a
+        # launch blocker) — a silent warmup crash would shift the compile
+        # onto a receive thread while the cache count below stays 1
+        assert "warmup compile failed" not in caplog.text
+        added = shared._cache_size() - base
+        assert added == 1, (
+            f"cross-silo run added {added} trace entries to the shared "
+            f"local_train jit (decay={decay}); warmup and actors must "
+            f"share exactly one")
+
+
 class TestDecayGuards:
     def test_fednova_rejects(self):
         from fedml_tpu.algorithms.fednova import FedNovaAPI, FedNovaConfig
