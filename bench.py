@@ -1193,6 +1193,20 @@ def main():
         headline_carried = "fedavg_femnist_cnn" in carried
         headline = carried.get("fedavg_femnist_cnn", {}).get(
             "rounds_per_sec", 0.0)
+        # the torch baseline needs no chip — measure it FRESH so the
+        # carried headline still ships an honest vs_baseline ratio
+        # (carried numerator is labeled below; denominator is this run).
+        # _run's alarm covers a hung baseline on a sick host — a stall
+        # here must not block the carry emit forever
+        vs_baseline = base_rps = None
+        if headline_carried and headline > 0:
+            base_out = _run("torch_baseline_for_carry",
+                            lambda: {"rps": bench_torch_baseline()},
+                            timeout_s=180)
+            base = base_out.get("rps", float("nan"))
+            if base == base and base > 0:
+                base_rps = round(base, 3)
+                vs_baseline = round(headline / base, 2)
         # ADVICE r4 (medium): `carried: true` travels at top level whenever
         # the value is a prior invocation's capture, and value_source is
         # attached ONLY when the headline row itself is in the carried set —
@@ -1200,7 +1214,12 @@ def main():
         # fresh-capture claim.
         _emit({"metric": "fedavg_rounds_per_sec_femnist_cnn",
                "value": headline,
-               "unit": "rounds/s", "vs_baseline": None,
+               "unit": "rounds/s", "vs_baseline": vs_baseline,
+               **({"vs_baseline_kind":
+                   "torch_cpu_this_host (baseline measured fresh this "
+                   "invocation; numerator is the carried chip capture)",
+                   "baseline_rounds_per_sec": base_rps}
+                  if vs_baseline is not None else {}),
                **({"carried": True} if headline_carried else {}),
                "extra": {"error": info["error"],
                          **({"value_source":
