@@ -210,10 +210,15 @@ class TestCrossSiloWarmupSharing:
         # onto a receive thread while the cache count below stays 1
         assert "warmup compile failed" not in caplog.text
         added = shared._cache_size() - base
-        assert added == 1, (
+        # flax modules hash by field values, so an identically-configured
+        # run elsewhere in the session may have pre-traced this entry
+        # (added == 0, a legitimate shared-cache hit); the regression
+        # guarded here is a SECOND signature (warmup vs actors diverging)
+        assert added <= 1, (
             f"cross-silo run added {added} trace entries to the shared "
             f"local_train jit (decay={decay}); warmup and actors must "
-            f"share exactly one")
+            f"share one signature")
+        assert shared._cache_size() >= 1
 
 
 class TestDecayGuards:
