@@ -147,10 +147,13 @@ def _make_api(model_name: str, hw: int, chans: int, classes: int,
     return api
 
 
-def _round_costs(api) -> "tuple[float, float]":
-    """(FLOPs, bytes accessed) of the compiled round program — the XLA
-    cost model's post-fusion accounting, so the bytes figure is the
-    compiler's own HBM-traffic estimate for the exact program that runs."""
+def _round_costs(api) -> "tuple[float, float, str | None]":
+    """(FLOPs, bytes accessed, error) of the compiled round program — the
+    XLA cost model's post-fusion accounting, so the bytes figure is the
+    compiler's own HBM-traffic estimate for the exact program that runs.
+    ``error`` carries the probe failure instead of swallowing it: the r5
+    ResNet18-GN stage silently nulled its flops/MFU for a whole round
+    (VERDICT #2) because this except hid the cause."""
     import jax.numpy as jnp
 
     _, args = api._prepare_round(0)
@@ -164,21 +167,37 @@ def _round_costs(api) -> "tuple[float, float]":
         if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
             analysis = analysis[0] if analysis else {}
         costs = dict(analysis or {})
-        return (float(costs.get("flops", float("nan"))),
-                float(costs.get("bytes accessed", float("nan"))))
-    except Exception:  # cost model unavailable on some backends
-        return float("nan"), float("nan")
+        flops = float(costs.get("flops", float("nan")))
+        bytes_acc = float(costs.get("bytes accessed", float("nan")))
+        err = ("cost model returned no flops for the lowered round "
+               "program" if flops != flops else None)
+        return flops, bytes_acc, err
+    except Exception as exc:  # noqa: BLE001 — reported, not swallowed
+        return float("nan"), float("nan"), repr(exc)
 
 
 def _round_flops(api) -> float:
-    """FLOPs of the compiled round program (XLA cost model)."""
-    return _round_costs(api)[0]
+    """FLOPs of the compiled round program (XLA cost model), failing the
+    stage LOUDLY on chip when the probe cannot produce a number — a null
+    where a number is expected must not serialize as honest-looking
+    evidence (VERDICT r5 #3a)."""
+    flops, _, err = _round_costs(api)
+    if err and _is_tpu():
+        raise RuntimeError(f"round cost probe failed on chip: {err}")
+    return flops
+
+
+def _nonfinite(x) -> bool:
+    """Shared nan/inf predicate for JSON sanitizing — emitted artifacts
+    must stay RFC-8259 valid (bare NaN/Infinity literals break every
+    strict parser — jq, JSON.parse, Go/Rust)."""
+    return isinstance(x, float) and (x != x or x in (float("inf"),
+                                                     float("-inf")))
 
 
 def _nn(x):
-    """nan -> None so emitted JSON stays RFC-8259 valid (bare NaN
-    literals break every strict parser — jq, JSON.parse, Go/Rust)."""
-    return None if x != x else x
+    """nan/inf -> None (same predicate as the recursive _no_nan)."""
+    return None if _nonfinite(x) else x
 
 
 def _bench_rounds(api, timed_rounds: int) -> float:
@@ -271,8 +290,14 @@ def bench_fedavg_cnn_fused_headline() -> dict:
         round_costs = fused.cost_analysis(rounds=1)
         flops = float(round_costs.get("flops", float("nan")))
         bytes_acc = float(round_costs.get("bytes accessed", float("nan")))
-    except Exception:
+    except Exception as exc:  # noqa: BLE001
+        if tpu:  # a null where a number is expected must fail loudly
+            raise RuntimeError(
+                f"fused-round cost probe failed on chip: {exc!r}") from exc
         flops = bytes_acc = float("nan")
+    if tpu and flops != flops:
+        raise RuntimeError("fused-round cost probe returned no flops on "
+                           "chip (VERDICT r5 #3a: nulls must not pass)")
     peak = _device_peak_tflops() * 1e12
     bw = _device_hbm_gbps() * 1e9
     ok = flops == flops
@@ -346,19 +371,20 @@ def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
     import optax
 
     from fedml_tpu.models.transformer import TransformerLM
-    from fedml_tpu.ops.flash_attention import flash_attention
 
     interpret = not _is_tpu()
     if interpret:
         seq_len, batch, steps = 512, 2, 2  # CPU smoke shapes
 
-    vocab = 1024
+    vocab, width, num_heads = 1024, 256, 4
+    head_dim = width // num_heads  # the autotune key derives from THESE
     tokens = np.random.RandomState(0).randint(
         0, vocab, (batch, seq_len)).astype(np.int32)
 
     def tokens_per_sec(attn_fn) -> float:
-        model = TransformerLM(vocab_size=vocab, width=256, depth=4,
-                              num_heads=4, max_len=seq_len, attn_fn=attn_fn)
+        model = TransformerLM(vocab_size=vocab, width=width, depth=4,
+                              num_heads=num_heads, max_len=seq_len,
+                              attn_fn=attn_fn)
         variables = model.init(jax.random.key(0), jnp.asarray(tokens[:1]),
                                train=False)
 
@@ -382,17 +408,29 @@ def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
         jax.block_until_ready(variables)
         return steps * batch * seq_len / (time.perf_counter() - t0)
 
-    # block-size autotune: tunnel windows differ enough (r4 measured the
-    # 128x128 kernel 1.376x OVER reference attention, the r5 window 0.70x
-    # UNDER with ~3.3x faster absolute numbers all around) that one fixed
-    # block shape can't be presumed optimal; sweep a small grid and report
-    # the winner alongside its config so the claim travels with evidence
-    configs = ([(128, 128)] if interpret
-               else [(128, 128), (256, 128), (128, 256),
-                     (256, 256), (512, 256)])
-    configs = [(bq, bk) for bq, bk in configs
-               if seq_len % bq == 0 and seq_len % bk == 0]
-    if not configs:
+    # shape-aware auto-selection (VERDICT r5 #1): tunnel windows differ
+    # enough (r4 measured the 128x128 kernel 1.376x OVER reference
+    # attention, the r5 windows 0.70x/0.895x UNDER) that one fixed block
+    # shape can't be presumed optimal — or Pallas presumed the winner at
+    # all. The ops.autotune subsystem races the block grid against the
+    # XLA reference with THIS stage's full LM-train-step timer, records
+    # the decision in the persistent cache (so launchers dispatch the
+    # same winner), and the row reports winner + block per shape: either
+    # speedup >= 1.0 or the row shows the auto-selected XLA winner — the
+    # slower path is never silently dispatched.
+    from fedml_tpu.ops import autotune as at
+
+    grid = ((128, 128),) if interpret else at.DEFAULT_BLOCK_GRID
+    tps_by_label = {}
+
+    def measure(label, attn_fn):
+        # autotune minimizes seconds; invert tokens/s so the recorded
+        # decision IS the decision this row's tokens/s claim is made from
+        tps = tokens_per_sec(None if label == "xla" else attn_fn)
+        tps_by_label[label] = round(tps, 1)
+        return 1.0 / max(tps, 1e-9)
+
+    if not at.block_candidates(seq_len, grid):
         # indivisible seq_len: the kernel's grid requires s % block == 0
         # (its min(block, s) clamp only helps when s < block), so measure
         # the XLA reference only and say so, instead of crashing or
@@ -401,27 +439,51 @@ def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
         return {
             "tokens_per_sec": round(ref_tps, 1),
             "seq_len": seq_len,
+            "selected_impl": "xla",
             "flash_skipped_indivisible_seq_len": seq_len,
             "note": "no autotune block divides seq_len; reference "
                     "attention only",
         }
-    flash_tps, best_cfg = 0.0, configs[0]
-    per_cfg = {}
-    for bq, bk in configs:
-        def flash_cfg(q, k, v, causal=True, _bq=bq, _bk=bk):
-            return flash_attention(q, k, v, causal=causal, block_q=_bq,
-                                   block_k=_bk, interpret=interpret)
-        tps = tokens_per_sec(flash_cfg)
-        per_cfg[f"{bq}x{bk}"] = round(tps, 1)
-        if tps > flash_tps:
-            flash_tps, best_cfg = tps, (bq, bk)
-    ref_tps = tokens_per_sec(None)  # default = XLA reference attention
+    # refresh=True: the bench is the evidence generator — re-time every
+    # window so a stale cached decision can never hide a regression; the
+    # fresh decision lands in the shared cache for every other consumer.
+    # CPU smoke runs race INTERPRET-mode kernels, whose timings say
+    # nothing about any deployment — keep those decisions out of the
+    # shared cache (README: the CPU contract is untimed XLA fallback)
+    if interpret:
+        import tempfile
+        cache = at.AutotuneCache(
+            tempfile.mkdtemp(prefix="fedml_autotune_cpu_smoke_"))
+    else:
+        cache = at.default_cache()
+    decision = at.autotune_attention(
+        seq_len, head_dim, num_heads=num_heads, batch=batch,
+        causal=True, grid=grid, measure=measure, interpret=interpret,
+        cache=cache, refresh=True)
+    if decision.label not in tps_by_label:
+        # FEDML_TPU_AUTOTUNE=0: the kill switch won over refresh=True and
+        # nothing was raced — time only the dispatched winner (cached or
+        # the XLA default) so the row still carries throughput evidence
+        from fedml_tpu.ops.flash_attention import make_flash_attention
+        attn = (None if decision.impl == "xla" else
+                make_flash_attention(decision.block_q, decision.block_k,
+                                     interpret))
+        tps_by_label[decision.label] = round(tokens_per_sec(attn), 1)
+    ref_tps = tps_by_label.get("xla")
+    flash_tps = max((v for k, v in tps_by_label.items() if k != "xla"),
+                    default=None)
     return {
-        "tokens_per_sec": round(flash_tps, 1),
+        "tokens_per_sec": tps_by_label[decision.label],
         "seq_len": seq_len,
-        "flash_block_qk": f"{best_cfg[0]}x{best_cfg[1]}",
-        "flash_tokens_per_sec_by_block": per_cfg,
-        "speedup_vs_reference_attention": round(flash_tps / ref_tps, 3),
+        "selected_impl": decision.impl,
+        "selected_block_qk": (f"{decision.block_q}x{decision.block_k}"
+                              if decision.impl == "pallas" else None),
+        "decision_source": decision.source,
+        "tokens_per_sec_by_candidate": tps_by_label,
+        "speedup_vs_reference_attention": (
+            round(flash_tps / ref_tps, 3) if flash_tps and ref_tps
+            else None),
+        "autotune_cache": cache.path,
     }
 
 
@@ -676,7 +738,16 @@ def bench_parallel_axes() -> dict:
             variables = shard_params(variables)
         args = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask), keys,
                 jnp.asarray(weights))
-        v, _ = round_fn(variables, *args)  # compile
+        v, _ = round_fn(variables, *args)  # compile (uncommitted params)
+        # second warmup on the COMMITTED output: the jit caches on input
+        # sharding, and the seq round's params go in uncommitted but come
+        # out mesh-committed — so the next call recompiles. The r5 577.8
+        # tokens/s row (VERDICT #5) was exactly this second compile landing
+        # inside the timed region (tens of seconds through the chip
+        # tunnel); the tp twin pre-places params via shard_params, which is
+        # why only the seq row was 4 orders of magnitude off. Steady state
+        # is the committed->committed signature — warm it before timing.
+        v, _ = round_fn(v, *args)
         jax.block_until_ready(v)
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -693,6 +764,13 @@ def bench_parallel_axes() -> dict:
         "mesh_model_axis": n_model,
         "seq_round_tokens_per_sec": run("seq", n_model),
         "tp_round_tokens_per_sec": run("tp", n_model),
+        "note": "seq warms BOTH jit signatures (uncommitted-params "
+                "compile, then the committed steady state) before "
+                "timing; the r5 577.8 tok/s seq row timed the second "
+                "compile (VERDICT #5 root cause, see "
+                "make_seq_federated_round docstring). Guarded by the "
+                "CPU-shape seq-vs-tp ratio test in "
+                "tests/test_seq_federated.py.",
     }
 
 
@@ -811,12 +889,16 @@ def bench_smoke_chip() -> dict:
     api = _make_api("cnn", 28, 1, CLASSES, 11,
                     samples=SAMPLES_PER_CLIENT if tpu else 2 * BATCH,
                     clients=CLIENTS_PER_ROUND if tpu else 2)
-    flops = _round_flops(api)
+    # smoke is the wedge-proof evidence stage: a cost-probe failure is
+    # reported loudly IN the row, but must not cost the rps capture
+    flops, _, cost_err = _round_costs(api)
     rps = _bench_rounds(api, 10)
     peak = _device_peak_tflops() * 1e12
     out["rounds_per_sec"] = round(rps, 3)
     out["achieved_tflops"] = _nn(round(rps * flops / 1e12, 3))
     out["mfu"] = _nn(round(rps * flops / peak, 4)) if peak == peak else None
+    if cost_err and tpu:
+        out["cost_probe_error"] = cost_err
     if tpu:
         api16 = _make_api("cnn", 28, 1, CLASSES, 11,
                           compute_dtype="bfloat16")
@@ -998,8 +1080,7 @@ def _no_nan(obj):
         return {k: _no_nan(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_no_nan(v) for v in obj]
-    if isinstance(obj, float) and (obj != obj or obj in (float("inf"),
-                                                         float("-inf"))):
+    if _nonfinite(obj):
         return None
     return obj
 
@@ -1013,6 +1094,13 @@ def _persist_partial(partial: dict) -> None:
         json.dump(_no_nan(partial), f, indent=2)
 
 
+#: the REAL stdout, captured before main() re-points sys.stdout at stderr
+#: so stray library prints can't corrupt the driver's parse (BENCH_r04 and
+#: r05 both landed `parsed: null`, VERDICT r5 #5): the contract line is
+#: the ONLY thing this process writes to its real stdout.
+_CONTRACT_STREAM = None
+
+
 def _emit(line: dict) -> None:
     """Print the driver contract line AND persist it to
     runs/bench_details.json (also on failure paths, so a stale success
@@ -1021,7 +1109,8 @@ def _emit(line: dict) -> None:
     line = _no_nan(line)
     with open(os.path.join("runs", "bench_details.json"), "w") as f:
         json.dump(line, f, indent=2)
-    print(json.dumps(line), flush=True)
+    print(json.dumps(line), file=_CONTRACT_STREAM or sys.stdout,
+          flush=True)
 
 
 def _label_resumed(partial: dict, ran_now: set) -> dict:
@@ -1174,8 +1263,28 @@ def _parse_stage_selection(argv) -> "set | None":
 def main():
     # make JAX_PLATFORMS=cpu actually bind (sitecustomize overrides the
     # env var programmatically; same guard as every CLI entrypoint)
-    from fedml_tpu.utils import force_platform_from_env
+    from fedml_tpu.utils import (enable_persistent_compilation_cache,
+                                 force_platform_from_env)
     force_platform_from_env()
+    # persistent XLA compile cache ($FEDML_TPU_COMPILE_CACHE): on a
+    # tunnel-windowed chip budget, recompiling programs a previous run
+    # already compiled is the largest avoidable waste (VERDICT r5 #6)
+    enable_persistent_compilation_cache()
+    # frame stdout: the driver json-parses it, and two rounds of headline
+    # artifacts (BENCH_r04/r05 `parsed: null`) were lost to stray prints.
+    # Everything a stage (or an imported library) prints goes to stderr;
+    # the single contract JSON line is written to the real stdout by
+    # _emit via _CONTRACT_STREAM.
+    global _CONTRACT_STREAM
+    _CONTRACT_STREAM = sys.stdout
+    sys.stdout = sys.stderr
+    try:
+        return _main_framed()
+    finally:
+        sys.stdout, _CONTRACT_STREAM = _CONTRACT_STREAM, None
+
+
+def _main_framed():
     smoke_only = "--smoke-chip" in sys.argv
     selected = _parse_stage_selection(sys.argv)
     resume = "--resume-partial" in sys.argv

@@ -70,6 +70,11 @@ def add_federated_args(parser: argparse.ArgumentParser):
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="write a TensorBoard-loadable jax.profiler "
                              "trace of the training loop here")
+    parser.add_argument("--compile_cache_dir", type=str, default=None,
+                        help="persistent XLA compilation cache dir "
+                             "(default: $FEDML_TPU_COMPILE_CACHE; unset = "
+                             "off) — saves cold-launch recompiles of "
+                             "already-compiled round programs")
     parser.add_argument("--use_wandb", action="store_true")
     parser.add_argument("--checkpoint_dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")

@@ -164,14 +164,19 @@ def main(argv=None):
                    help="per-round exponential client-LR decay "
                         "(TrainConfig.lr_decay_round; 1.0 = reference "
                         "constant lr)")
+    p.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent XLA compilation cache dir (default: "
+                        "$FEDML_TPU_COMPILE_CACHE; unset = off)")
     p.add_argument("--out", type=str, required=True)
     args = p.parse_args(argv)
 
     import logging
     logging.basicConfig(level=logging.INFO)  # per-round eval records
 
-    from fedml_tpu.utils import force_platform_from_env
+    from fedml_tpu.utils import (enable_persistent_compilation_cache,
+                                 force_platform_from_env)
     force_platform_from_env()
+    enable_persistent_compilation_cache(args.compile_cache_dir)
     import jax
     from fedml_tpu.core import pytree as pt
     from fedml_tpu.data.registry import DEFAULT_MODEL_AND_TASK, load_data

@@ -45,11 +45,16 @@ def main(argv=None):
     p.add_argument("--drivers", type=str, default="sim")
     p.add_argument("--eval_subsample", type=int, default=1000,
                    help="one final eval over a seeded subsample (0 = skip)")
+    p.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent XLA compilation cache dir (default: "
+                        "$FEDML_TPU_COMPILE_CACHE; unset = off)")
     p.add_argument("--out", type=str, required=True)
     args = p.parse_args(argv)
 
-    from fedml_tpu.utils import force_platform_from_env
+    from fedml_tpu.utils import (enable_persistent_compilation_cache,
+                                 force_platform_from_env)
     force_platform_from_env()
+    enable_persistent_compilation_cache(args.compile_cache_dir)
     import jax
 
     from fedml_tpu.data.registry import DEFAULT_MODEL_AND_TASK, load_data

@@ -31,6 +31,27 @@ def _default_attention(q, k, v, causal: bool = True):
     return reference_attention(q, k, v, causal=causal)
 
 
+_AUTO_ATTENTION = None
+
+
+def _auto_attention(q, k, v, causal: bool = True):
+    """``attn_fn="auto"``: per-shape winner between the Pallas kernel's
+    tuned blocks and the XLA reference, resolved (and memoized on disk)
+    by :mod:`fedml_tpu.ops.autotune`. Process-wide singleton so every
+    block and every model instance shares one decision memo."""
+    global _AUTO_ATTENTION
+    if _AUTO_ATTENTION is None:
+        from fedml_tpu.ops.autotune import make_autotuned_attention
+        _AUTO_ATTENTION = make_autotuned_attention()
+    return _AUTO_ATTENTION(q, k, v, causal=causal)
+
+
+def _resolve_attn(attn_fn) -> AttnFn:
+    if attn_fn == "auto":
+        return _auto_attention
+    return attn_fn or _default_attention
+
+
 class MoeFFN(nn.Module):
     """Switch-style MoE replacement for the block MLP (top-1 routing,
     fixed capacity; parallel/expert.py holds the routing math and the
@@ -81,6 +102,7 @@ class TransformerBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dropout: float = 0.0
+    # None = plain softmax oracle; "auto" = ops.autotune per-shape winner
     attn_fn: Optional[AttnFn] = None
     moe_experts: int = 0  # >0: Switch MoE FFN instead of the dense MLP
     moe_ep_axis: Optional[str] = None  # expert-parallel mesh axis
@@ -91,7 +113,7 @@ class TransformerBlock(nn.Module):
     def __call__(self, x, train: bool = False):
         b, s, width = x.shape
         head_dim = width // self.num_heads
-        attn = self.attn_fn or _default_attention
+        attn = _resolve_attn(self.attn_fn)
 
         h = nn.LayerNorm()(x)
         qkv = nn.Dense(3 * width, use_bias=False)(h)
@@ -127,6 +149,9 @@ class TransformerLM(nn.Module):
     num_heads: int = 4
     max_len: int = 2048
     dropout: float = 0.0
+    # None = plain softmax oracle; "auto" = ops.autotune per-shape winner
+    # (tuned Pallas blocks vs XLA reference, decision cached on disk);
+    # or any (q, k, v, causal=...) callable, e.g. ring/ulysses attention
     attn_fn: Optional[AttnFn] = None
     moe_experts: int = 0   # >0: every `moe_every`-th block is a Switch MoE
     moe_every: int = 2

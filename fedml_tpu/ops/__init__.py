@@ -14,6 +14,10 @@ corresponding device-side primitives are hand-tiled Pallas kernels:
 - :mod:`fedml_tpu.ops.flash_attention` — streaming-softmax attention for
   the transformer path (VMEM-blocked K/V, causal block skipping), with a
   blockwise custom VJP.
+- :mod:`fedml_tpu.ops.autotune` — shape-aware selection between the
+  Pallas kernel's (block_q, block_k) grid and the XLA reference
+  attention, memoized in an on-disk per-device-kind cache so neither
+  tuning nor a losing kernel is ever paid twice.
 
 Every kernel has an ``interpret=True`` path so the math is testable on the
 CPU mesh, and a pure-jnp reference used both as the CPU fallback and as the
@@ -23,6 +27,9 @@ test oracle.
 from fedml_tpu.ops.aggregate import (tree_weighted_mean_pallas,
                                      weighted_mean_flat,
                                      weighted_mean_flat_reference)
+from fedml_tpu.ops.autotune import (AttentionDecision, AutotuneCache,
+                                    autotune_attention,
+                                    make_autotuned_attention)
 from fedml_tpu.ops.flash_attention import (flash_attention,
                                            make_flash_attention)
 from fedml_tpu.ops.quantize import (dequantize_int8, dequantize_tree,
@@ -38,4 +45,8 @@ __all__ = [
     "dequantize_tree",
     "flash_attention",
     "make_flash_attention",
+    "AttentionDecision",
+    "AutotuneCache",
+    "autotune_attention",
+    "make_autotuned_attention",
 ]

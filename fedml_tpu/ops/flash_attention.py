@@ -294,9 +294,23 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-def make_flash_attention(block_q: int = 128, block_k: int = 128,
-                         interpret: bool = False):
-    """attn_fn factory for TransformerLM: (q, k, v, causal=...) -> out."""
+def make_flash_attention(block_q=128, block_k=128, interpret: bool = False,
+                         autotune_cache=None):
+    """attn_fn factory for TransformerLM: (q, k, v, causal=...) -> out.
+
+    ``block_q="auto"`` (or ``block_k="auto"``) returns the shape-aware
+    auto-selected attention instead of a fixed-block kernel: per dispatched
+    shape, :mod:`fedml_tpu.ops.autotune` times the Pallas block grid
+    against the XLA reference, memoizes the winner on disk
+    (``autotune_cache`` or the env-configured default), and dispatches it —
+    so no shape ever runs the slower path on the strength of a hand-picked
+    constant.
+    """
+    if block_q == "auto" or block_k == "auto":
+        from fedml_tpu.ops.autotune import make_autotuned_attention
+        return make_autotuned_attention(cache=autotune_cache,
+                                        interpret=interpret or None)
+
     def attn(q, k, v, causal: bool = True):
         return flash_attention(q, k, v, causal, block_q, block_k, interpret)
     return attn

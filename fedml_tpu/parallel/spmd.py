@@ -35,6 +35,9 @@ from fedml_tpu.core.sampling import (eval_subsample, round_keys,
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train, round_lr_scale)
+from fedml_tpu.utils.jax_compat import install_jax_compat
+
+install_jax_compat()
 
 
 def build_mesh(axis_sizes: Dict[str, int],
@@ -42,13 +45,19 @@ def build_mesh(axis_sizes: Dict[str, int],
     """Build a named mesh, e.g. {'clients': 8} or {'group': 2, 'clients': 4}."""
     shape = tuple(axis_sizes.values())
     names = tuple(axis_sizes.keys())
-    # Auto axis types: arrays don't get mesh-committed shardings-in-types
-    # (Explicit mode pins inputs to one mesh and breaks multi-mesh programs)
-    types = tuple(jax.sharding.AxisType.Auto for _ in names)
+    # Auto axis types where the API has them: arrays don't get
+    # mesh-committed shardings-in-types (Explicit mode pins inputs to one
+    # mesh and breaks multi-mesh programs). Pre-AxisType jax is all-Auto
+    # already, so omitting the kwarg is the same semantics.
+    if hasattr(jax.sharding, "AxisType"):
+        types = tuple(jax.sharding.AxisType.Auto for _ in names)
+        if devices is None:
+            return jax.make_mesh(shape, names, axis_types=types)
+        return Mesh(np.asarray(devices).reshape(shape), names,
+                    axis_types=types)
     if devices is None:
-        return jax.make_mesh(shape, names, axis_types=types)
-    arr = np.asarray(devices).reshape(shape)
-    return Mesh(arr, names, axis_types=types)
+        return jax.make_mesh(shape, names)
+    return Mesh(np.asarray(devices).reshape(shape), names)
 
 
 def _pvary(tree, axes: Tuple[str, ...]):

@@ -258,20 +258,32 @@ def make_local_train(module, task: str, cfg: TrainConfig,
                                             True, key)
                 stats = head(out, yb, mb)
                 if grad_sync_axes:
-                    # the client's loss is over ALL shards' tokens; summing
-                    # the stat sums here makes the step (and its gradient,
-                    # via the psum transpose) globally correct
-                    stats = jax.tree.map(
-                        lambda s: jax.lax.psum(s, grad_sync_axes), stats)
-                loss = stats["loss_sum"] / jnp.maximum(stats["count"], 1.0)
+                    # differentiate the UNNORMALIZED local loss sum and
+                    # keep every psum outside the grad: the client's loss
+                    # is psum(loss_sum)/psum(count), whose gradient is
+                    # psum(d loss_sum/dθ)/psum(count) because count does
+                    # not depend on θ — so syncing and normalizing after
+                    # jax.grad is exact, and it sidesteps the psum
+                    # transpose entirely (pre-VMA jax transposes psum to
+                    # psum, which would scale in-grad-synced gradients by
+                    # the axis size)
+                    loss = stats["loss_sum"]
+                else:
+                    loss = stats["loss_sum"] / jnp.maximum(stats["count"],
+                                                           1.0)
                 return loss, (new_vars, stats)
 
             grads, (new_vars, stats) = jax.grad(loss_fn, has_aux=True)(params)
             if grad_sync_axes:
                 # each shard's backward holds only its tokens' terms of
-                # d[psum(loss_sum)/psum(count)]/dθ; the psum completes the
-                # exact full-sequence gradient on every shard
-                grads = jax.lax.psum(grads, grad_sync_axes)
+                # d[loss_sum]/dθ; the psum + global-count normalization
+                # completes the exact full-sequence gradient on every shard
+                stats = jax.tree.map(
+                    lambda s: jax.lax.psum(s, grad_sync_axes), stats)
+                denom = jnp.maximum(stats["count"], 1.0)
+                grads = jax.tree.map(
+                    lambda g: g / denom,
+                    jax.lax.psum(grads, grad_sync_axes))
             updates, new_opt_state = tx.update(grads, opt_state, params)
             if lr_scale is not None:
                 # round-level lr schedule (TrainConfig.lr_decay_round):

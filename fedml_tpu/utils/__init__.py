@@ -20,3 +20,39 @@ def force_platform_from_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", platforms)
+
+
+def enable_persistent_compilation_cache(cache_dir=None):
+    """Wire JAX's persistent compilation cache into this process.
+
+    Cross-silo round-0 compiles cost ~15 min of tunnel-windowed chip
+    budget in round 5 (runs/cross_silo_resnet56_chip/NOTE.md) because no
+    launcher persisted compiled programs across processes — the single
+    largest avoidable waste of window time (VERDICT r5 #6). Every CLI
+    entrypoint (fed_launch, main_fedavg, flagship_scale,
+    virtualization_stress, bench) calls this right after
+    :func:`force_platform_from_env`.
+
+    ``cache_dir`` = the explicit argument (a launcher's
+    ``--compile_cache_dir``) or ``$FEDML_TPU_COMPILE_CACHE``; when neither
+    is set this is a no-op (cache off — there is no safe universal default
+    location on shared hosts). The aggressive thresholds (persist every
+    entry, not just slow ones) are right for this workload: on a windowed
+    chip budget a 2 s compile saved is still a 2 s saved, and the cache
+    dir is operator-chosen. Returns the dir when enabled, else None.
+    """
+    cache_dir = cache_dir or os.environ.get("FEDML_TPU_COMPILE_CACHE")
+    if not cache_dir:
+        return None
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for flag, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(flag, value)
+        except (AttributeError, ValueError):
+            pass  # flag absent on this jax version; defaults still cache
+    return cache_dir

@@ -1,0 +1,78 @@
+"""Version-bridging shims for the JAX APIs this package leans on.
+
+The parallel layer is written against the modern spellings —
+``jax.shard_map`` (with its ``check_vma`` replication checker),
+``jax.lax.pvary`` for marking replicated operands device-varying, and
+``jax.sharding.AxisType`` on mesh construction. Older jaxlibs (the 0.4.x
+line this container ships) expose the same machinery under the previous
+names: ``jax.experimental.shard_map.shard_map`` with ``check_rep``, no
+``pvary`` (the pre-VMA replication tracker makes it unnecessary — grads of
+replicated operands taken *inside* the body are purely local, so the
+identity is semantically exact there), and untyped mesh axes.
+
+:func:`install_jax_compat` patches the missing modern names onto ``jax``
+once, idempotently, so every call site keeps the forward-looking spelling
+and the package runs unmodified on both API generations. Modules that use
+``jax.shard_map``/``jax.lax.pvary`` call it at import time; on a modern
+jax it is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+_INSTALLED = False
+
+
+def install_jax_compat() -> None:
+    """Idempotently alias modern jax API names on legacy versions."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    import jax
+
+    legacy = not hasattr(jax, "shard_map")
+    if legacy:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                      check_vma: bool = True, **kwargs):
+            # check_rep is ALWAYS off here, whatever check_vma says: the
+            # legacy rep-checker's psum rewrite has no pvary marker, so a
+            # jax.grad w.r.t. replicated operands inside the body comes
+            # back psum-contaminated (every client receives the SUM of all
+            # clients' gradients — caught by the sim==distributed parity
+            # test), and its scan rule rejects carries whose replication
+            # set changes (the rewrite jax upstream tells you to disable).
+            # With it off, psum is plain psum and body autodiff is local —
+            # exactly the semantics _pvary marking restores on modern jax.
+            kwargs.pop("check_rep", None)
+            del check_vma
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False,
+                              **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "pvary") and not hasattr(jax.lax, "pcast"):
+        # pre-VMA jax: replication is tracked by shard_map's own rep rule,
+        # not by varying-manual-axes types, so the marker is the identity
+        jax.lax.pvary = lambda x, axes: x
+
+    if legacy:
+        # Legacy-only: route jit(shard_map) through the Shardy partitioner.
+        # 0.4.x GSPMD MISCOMPILES sorts inside manual regions: the sort in
+        # jax.random.permutation/argsort loses its {manual} sharding, gets
+        # re-partitioned as a global op, and the partitioner's
+        # all-reduce(select(partition_id==0, vals, 0)) hands EVERY device
+        # partition 0's random values — every client trains on client 0's
+        # shuffle schedule (caught by the sim==distributed parity tests:
+        # client 0 exact, every other client wrong). Shardy keeps manual
+        # regions manual; diff goes to 0.0.
+        try:
+            jax.config.update("jax_use_shardy_partitioner", True)
+        except (AttributeError, ValueError):
+            pass  # no shardy on this version; parity tests will say so
+
+    _INSTALLED = True

