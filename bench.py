@@ -14,8 +14,11 @@ Workloads (BASELINE.md rows):
    S=2048) with the Pallas flash-attention kernel; tokens/s plus the
    speedup over the XLA reference attention.
 4. ``fedavg_powerlaw_1000``: the reference flagship shape (1000 power-law
-   clients, 10/round, B=10, LR) — cohort-bucket packing wall-clock vs
-   global-max packing, plus the padded-row reduction.
+   clients, 10/round, B=10, LR) — serial vs pipelined rounds/sec (the
+   async round pipeline overlapping next-round pack+upload with the
+   current dispatch, ``prefetch_hidden_ms`` = host time taken off the
+   critical path), cohort-bucket packing wall-clock vs global-max
+   packing, plus the padded-row reduction.
 5. ``fedavg_fused_rounds``: R sampled rounds as one fused BLOCK (host-
    presampled cohorts at the block's cohort bucket under one lax.scan —
    both throughput levers composed) vs the cohort-packed host loop;
@@ -176,15 +179,40 @@ def _round_costs(api) -> "tuple[float, float, str | None]":
         return float("nan"), float("nan"), repr(exc)
 
 
-def _round_flops(api) -> float:
-    """FLOPs of the compiled round program (XLA cost model), failing the
-    stage LOUDLY on chip when the probe cannot produce a number — a null
-    where a number is expected must not serialize as honest-looking
-    evidence (VERDICT r5 #3a)."""
+def _analytic_round_flops(api) -> float:
+    """The conv/GroupNorm analytic cost model (utils/flops.analytic_flops)
+    applied to the exact round program: jaxpr-traced matmul/conv terms,
+    scan trip counts multiplied in (XLA's cost model bills a scan body
+    ONCE regardless of trip count, so on multi-batch local loops the
+    analytic figure is the honest per-round count)."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.utils.flops import analytic_flops
+
+    _, args = api._prepare_round(0)
+    return analytic_flops(api._round_fn_py, api.variables, *args,
+                          jnp.uint32(0))
+
+
+def _round_flops(api) -> "tuple[float, str]":
+    """(FLOPs, source) of the round program: the XLA cost model when it
+    answers, else the analytic conv/GroupNorm jaxpr count — the chip
+    plugin returns no cost analysis for some conv programs (BENCH_r05's
+    resnet18_gn row serialized round_flops: null for a whole round), and
+    a null where a number is expected must not serialize as
+    honest-looking evidence (VERDICT r5 #3a). Raises only when BOTH
+    models fail on chip."""
     flops, _, err = _round_costs(api)
-    if err and _is_tpu():
-        raise RuntimeError(f"round cost probe failed on chip: {err}")
-    return flops
+    if not err:
+        return flops, "xla_cost_model"
+    try:
+        return _analytic_round_flops(api), "analytic_conv_gn_jaxpr"
+    except Exception as exc:  # noqa: BLE001
+        if _is_tpu():
+            raise RuntimeError(
+                f"round cost probes failed on chip: xla={err}; "
+                f"analytic={exc!r}") from exc
+        return float("nan"), f"unavailable ({err})"
 
 
 def _nonfinite(x) -> bool:
@@ -221,13 +249,14 @@ def bench_fedavg_cnn() -> dict:
     api = _make_api("cnn", 28, 1, CLASSES, timed + 1,
                     samples=SAMPLES_PER_CLIENT if tpu else 2 * BATCH,
                     clients=CLIENTS_PER_ROUND if tpu else 2)
-    flops = _round_flops(api)
+    flops, flops_src = _round_flops(api)
     rps = _bench_rounds(api, timed)
     achieved = rps * flops  # FLOP/s through the round program
     peak = _device_peak_tflops() * 1e12
     return {
         "rounds_per_sec": round(rps, 3),
         "round_flops": _nn(flops),
+        "round_flops_source": flops_src,
         "achieved_tflops": _nn(round(achieved / 1e12, 3)),
         "mfu": _nn(round(achieved / peak, 4)) if peak == peak else None,
         "phase_ms": {k: round(v * 1e3, 3)
@@ -347,18 +376,35 @@ def _roofline(flops: float, bytes_acc: float, peak: float,
 
 
 def bench_resnet18_gn() -> dict:
+    """Heavier conv workload; the FLOPs column now carries an analytic
+    conv/GroupNorm fallback (utils/flops.analytic_flops) so the row
+    reports MFU like the headline even when the chip plugin's cost model
+    returns nothing for the conv round program (BENCH_r05 serialized
+    round_flops/achieved_tflops/mfu: null). The analytic jaxpr count is
+    always emitted alongside for cross-checking — unlike XLA's cost
+    model it multiplies scan trip counts, so on multi-batch local loops
+    it is the honest per-round figure."""
     tpu = _is_tpu()
     timed = 20 if tpu else 2
     api = _make_api("resnet18_gn", 24, 3, 100, timed + 1,
                     samples=5 * BATCH if tpu else BATCH,
                     clients=CLIENTS_PER_ROUND if tpu else 2)
-    flops = _round_flops(api)
+    flops, flops_src = _round_flops(api)
+    if flops_src == "analytic_conv_gn_jaxpr":
+        analytic = flops  # already computed as the fallback — don't retrace
+    else:
+        try:
+            analytic = _analytic_round_flops(api)
+        except Exception:  # noqa: BLE001 — cross-check only, never fatal
+            analytic = float("nan")
     rps = _bench_rounds(api, timed)
     achieved = rps * flops
     peak = _device_peak_tflops() * 1e12
     return {
         "rounds_per_sec": round(rps, 3),
         "round_flops": _nn(flops),
+        "round_flops_source": flops_src,
+        "round_flops_analytic": _nn(analytic),
         "achieved_tflops": _nn(round(achieved / 1e12, 3)),
         "mfu": _nn(round(achieved / peak, 4)) if peak == peak else None,
     }
@@ -490,9 +536,16 @@ def bench_transformer_flash(seq_len: int = 2048, batch: int = 4,
 def bench_powerlaw_1000() -> dict:
     """The reference flagship shape: 1000 power-law clients (LEAF MNIST
     size distribution), 10 sampled/round, B=10 — the workload where
-    cohort-bucket packing matters. Reports rounds/s (cohort packing, the
-    default) and the padded-row reduction vs global-max packing (a direct
-    per-round FLOP proxy; VERDICT r2 contract: >=3x)."""
+    cohort-bucket packing matters. Reports serial vs PIPELINED rounds/s
+    (the async round pipeline, parallel/prefetch.py: next round's pack +
+    upload overlapped with the current dispatch — BENCH_r05 paid pack
+    30.2ms on the critical path every round), the hidden pack+upload time
+    per round (``prefetch_hidden_ms``; ``prefetch_wait`` ≈ 0 once warm is
+    the pipelined win condition), and the padded-row reduction vs
+    global-max packing (a direct per-round FLOP proxy; VERDICT r2
+    contract: >=3x). The serial numbers come from ``prefetch_depth=0`` —
+    provably today's path (same flag the ``FEDML_TPU_PREFETCH=0`` kill
+    switch forces)."""
     import jax
 
     from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
@@ -506,25 +559,39 @@ def bench_powerlaw_1000() -> dict:
     timed = 50 if tpu else 8
     ds = make_powerlaw_blob_federated(client_num=N, dim=64, class_num=10,
                                       seed=2)
-    api = FedAvgAPI(ds, LogisticRegression(num_classes=10),
-                    config=FedAvgConfig(
-                        comm_round=timed + 1, client_num_per_round=10,
-                        frequency_of_the_test=10**9,
-                        train=TrainConfig(epochs=1, batch_size=10,
-                                          lr=0.03)))
-    # warm every bucket shape before timing (bounded: <= log2 shapes)
-    warmed = set()
-    for r in range(timed + 1):
-        n_pad = ds.cohort_padded_len(sample_clients(r, N, 10), 10)
-        if n_pad not in warmed:
-            warmed.add(n_pad)
+
+    def make_api(pack="cohort", prefetch_depth=0):
+        return FedAvgAPI(ds, LogisticRegression(num_classes=10),
+                         config=FedAvgConfig(
+                             comm_round=timed + 1, client_num_per_round=10,
+                             frequency_of_the_test=10**9, pack=pack,
+                             prefetch_depth=prefetch_depth,
+                             train=TrainConfig(epochs=1, batch_size=10,
+                                               lr=0.03)))
+
+    def timed_rounds(api):
+        # warm every bucket shape before timing (bounded: <= log2 shapes)
+        warmed = set()
+        for r in range(timed + 1):
+            n_pad = ds.cohort_padded_len(sample_clients(r, N, 10), 10)
+            if n_pad not in warmed:
+                warmed.add(n_pad)
+                api.run_round(r)
+        jax.block_until_ready(api.variables)
+        before = api.prefetch_stats() or {}
+        t0 = time.perf_counter()
+        for r in range(1, timed + 1):
             api.run_round(r)
-    jax.block_until_ready(api.variables)
-    t0 = time.perf_counter()
-    for r in range(1, timed + 1):
-        api.run_round(r)
-    jax.block_until_ready(api.variables)
-    rps = timed / (time.perf_counter() - t0)
+        jax.block_until_ready(api.variables)
+        rps = timed / (time.perf_counter() - t0)
+        after = api.prefetch_stats() or {}
+        window = {k: after[k] - before.get(k, 0) for k in after}
+        return rps, window
+
+    api_serial = make_api()
+    rps_serial, _ = timed_rounds(api_serial)
+    api_pipe = make_api(prefetch_depth=2)
+    rps_pipe, pf = timed_rounds(api_pipe)
     glob = ds.padded_len(10)
     rows_g = rows_c = 0
     for r in range(1, timed + 1):
@@ -533,22 +600,39 @@ def bench_powerlaw_1000() -> dict:
         rows_c += ds.cohort_padded_len(idxs, 10) * len(idxs)
     # wall-clock under global-max packing on the SAME workload, so the
     # padding win is evidenced in measured time, not only the FLOP proxy
-    api_g = FedAvgAPI(ds, LogisticRegression(num_classes=10),
-                      config=FedAvgConfig(
-                          comm_round=timed + 1, client_num_per_round=10,
-                          frequency_of_the_test=10**9, pack="global",
-                          train=TrainConfig(epochs=1, batch_size=10,
-                                            lr=0.03)))
+    # (serial on both sides: the packing comparison must not conflate the
+    # pipeline lever)
+    api_g = make_api(pack="global")
     # one warm round suffices: global pack has a single compiled shape
     rps_global = _bench_rounds(api_g, timed)
     return {
-        "rounds_per_sec": round(rps, 3),
+        # the default config is pipelined — that is the dispatched path
+        "rounds_per_sec": round(rps_pipe, 3),
+        "rounds_per_sec_serial": round(rps_serial, 3),
+        "rounds_per_sec_pipelined": round(rps_pipe, 3),
+        "pipeline_speedup_x": round(rps_pipe / rps_serial, 3),
+        # pack+upload ms per round removed from the critical path (worker
+        # produce time for consumed slots minus any wait the caller paid)
+        "prefetch_hidden_ms": round(
+            max(0.0, pf.get("hidden_s", 0.0)) / timed * 1e3, 3),
+        "prefetch_wait_ms": round(
+            pf.get("wait_s", 0.0) / timed * 1e3, 3),
+        "prefetch_hits": pf.get("hits"),
+        "prefetch_misses": pf.get("misses"),
         "rounds_per_sec_global_pack": round(rps_global, 3),
-        "cohort_pack_speedup_x": round(rps / rps_global, 2),
+        "cohort_pack_speedup_x": round(rps_serial / rps_global, 2),
         "clients_total": N,
         "padded_row_reduction_vs_global": round(rows_g / rows_c, 2),
         "phase_ms": {k: round(v * 1e3, 3)
-                     for k, v in api.timer.means().items()},
+                     for k, v in api_pipe.timer.means().items()},
+        "phase_ms_serial": {k: round(v * 1e3, 3)
+                            for k, v in api_serial.timer.means().items()},
+        "note": "serial = prefetch_depth 0, the pre-pipeline path. On a "
+                "1-core CPU smoke host the prefetch worker timeshares "
+                "with XLA compute and pipelined can read SLOWER; the "
+                "overlap win is a chip-host claim (host cores idle during "
+                "device dispatch) — judge tpu-tagged rows by "
+                "prefetch_wait ≈ 0 with prefetch_hidden_ms > 0.",
     }
 
 
@@ -1492,7 +1576,10 @@ def _main_framed():
             "rounds_per_sec_fused_bf16"),
         "femnist_cnn_fused_mfu": flagship_fused.get("mfu"),
         "resnet18_gn_rps": resnet.get("rounds_per_sec"),
+        "resnet18_gn_mfu": resnet.get("mfu"),
         "powerlaw_1000_rps": powerlaw.get("rounds_per_sec"),
+        "powerlaw_pipeline_speedup_x": powerlaw.get("pipeline_speedup_x"),
+        "powerlaw_prefetch_hidden_ms": powerlaw.get("prefetch_hidden_ms"),
         "fused_block_rps": fused.get("rounds_per_sec_fused_block"),
         "fused_block_vs_host_cohort_x": fused.get(
             "fused_block_vs_host_cohort_x"),

@@ -103,6 +103,15 @@ class FedAvgConfig:
     # pads every round to the dataset-wide max (one compile ever). Full
     # participation produces identical shapes either way.
     pack: str = "cohort"
+    # async round pipeline (parallel/prefetch.py): pack + upload round r+1
+    # on a background thread while round r's dispatch executes, holding at
+    # most this many cohorts in flight (2 = double buffering; 0 = today's
+    # serial path; $FEDML_TPU_PREFETCH overrides). Sampling is a pure
+    # function of the round index, so the pipelined trajectory is
+    # bit-identical to the serial one. Only engages for partial
+    # participation — full participation already reuses the resident
+    # _pack_cache cohort.
+    prefetch_depth: int = 2
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 
 
@@ -176,10 +185,46 @@ class FedAvgAPI:
         # eval arrays live on device across test rounds (re-uploading the
         # global unions every evaluation dominated host time on image sets)
         self._eval_cache = None
+        # cohort prefetcher (parallel/prefetch.py), built lazily on the
+        # first partial-participation round; (prefetcher, dataset-at-build)
+        self._prefetch = None
         from fedml_tpu.utils.tracing import RoundTimer
         self.timer = RoundTimer()
 
     # -- one round ---------------------------------------------------------
+    def _pack_cohort(self, idxs, dataset=None):
+        """Cache-free pack + upload of one sampled cohort (thread-safe: no
+        shared mutable state — the prefetcher worker calls this
+        concurrently with the main thread's dispatch)."""
+        cfg = self.config
+        ds = dataset if dataset is not None else self.dataset
+        with self.timer.phase("pack"):
+            n_pad = (ds.cohort_padded_len(idxs, cfg.train.batch_size)
+                     if cfg.pack == "cohort" else self._n_pad)
+            x, y, mask = ds.pack_clients(idxs, cfg.train.batch_size,
+                                         n_pad=n_pad)
+            weights = ds.client_weights(idxs)
+        with self.timer.phase("upload"):
+            return (jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+                    jnp.asarray(weights))
+
+    def _pack_round(self, round_idx: int):
+        """The full host side of one round — seeded sampling, pack,
+        upload, per-client keys — as a pure function of the round index
+        (the prefetcher's ``produce``). The dataset reference is snapshot
+        once so a concurrent mid-run swap can never mix two datasets'
+        arrays inside one payload (the stale payload is then discarded by
+        the caller's identity check)."""
+        ds = self.dataset
+        idxs = sample_clients(round_idx, ds.client_num,
+                              self.config.client_num_per_round,
+                              delete_client=self.delete_client)
+        xd, yd, maskd, wd = self._pack_cohort(idxs, dataset=ds)
+        _, keys, agg_key = round_keys(
+            self._base_key, round_idx,
+            jnp.asarray(np.asarray(idxs), dtype=jnp.uint32))
+        return ds, idxs, (xd, yd, maskd, keys, wd, agg_key)
+
     def _prepare_round(self, round_idx: int):
         """Host side of a round: seeded sampling, pad-and-mask packing,
         per-client keys. Shared by all FedAvg-family algorithms."""
@@ -199,15 +244,7 @@ class FedAvgAPI:
             xd, yd, maskd, wd = self._pack_cache[2]
         else:
             self._pack_cache = None  # free the old buffers before packing
-            n_pad = (self.dataset.cohort_padded_len(idxs,
-                                                    cfg.train.batch_size)
-                     if cfg.pack == "cohort" else self._n_pad)
-            x, y, mask = self.dataset.pack_clients(idxs,
-                                                   cfg.train.batch_size,
-                                                   n_pad=n_pad)
-            weights = self.dataset.client_weights(idxs)
-            xd, yd, maskd, wd = (jnp.asarray(x), jnp.asarray(y),
-                                 jnp.asarray(mask), jnp.asarray(weights))
+            xd, yd, maskd, wd = self._pack_cohort(idxs)
             if len(idxs) == self.dataset.client_num:
                 self._pack_cache = (self.dataset, cohort,
                                     (xd, yd, maskd, wd))
@@ -215,6 +252,47 @@ class FedAvgAPI:
             self._base_key, round_idx,
             jnp.asarray(np.asarray(idxs), dtype=jnp.uint32))
         return idxs, (xd, yd, maskd, keys, wd, agg_key)
+
+    def _round_prefetcher(self):
+        """The cohort prefetcher for the current config/dataset, or None
+        when the serial path should run: depth 0 (flag or
+        $FEDML_TPU_PREFETCH kill switch) or full participation (the
+        resident ``_pack_cache`` already skips pack+upload there). A
+        dataset swap invalidates every in-flight slot, exactly like
+        ``_pack_cache``."""
+        from fedml_tpu.parallel.prefetch import (RoundPrefetcher,
+                                                 bind_prefetcher,
+                                                 resolve_prefetch_depth)
+        depth = resolve_prefetch_depth(
+            getattr(self.config, "prefetch_depth", 0))
+        # full participation keeps the resident _pack_cache — EXCEPT
+        # under delete_client (leave-one-out), whose per-round-seeded
+        # permuted cohorts never cache and so do want the pipeline
+        if (depth <= 0 or (self.config.client_num_per_round
+                           >= self.dataset.client_num
+                           and self.delete_client is None)):
+            if self._prefetch is not None:
+                # kill switch flipped mid-run: free the resident slots
+                # instead of pinning them until the API dies
+                self._prefetch[0].invalidate()
+            return None
+        self._prefetch = bind_prefetcher(
+            self._prefetch, self.dataset,
+            lambda: RoundPrefetcher(self._pack_round, depth,
+                                    name="fedavg-cohort-prefetch"))
+        return self._prefetch[0]
+
+    def prefetch_stats(self):
+        """Prefetcher counters (hits/misses/wait_s/hidden_s) or None when
+        the serial path ran — evidence hook for bench/tests."""
+        return self._prefetch[0].stats() if self._prefetch else None
+
+    def release_prefetch(self):
+        """Drop every speculative slot (their device buffers) without
+        stopping the worker — for callers driving ``run_round`` in
+        patterns the ``comm_round`` speculation clamp can't see."""
+        if self._prefetch is not None:
+            self._prefetch[0].invalidate()
 
     def fused_rounds(self, device_sampling: bool = False) -> "FusedRounds":
         """The fused multi-round driver PAIRED with this API class
@@ -230,10 +308,26 @@ class FedAvgAPI:
                 "cannot run inside a scan")
         return self._fused_driver_cls(self, device_sampling)
 
+    def _host_round_inputs(self, round_idx: int):
+        """Pipelined-or-serial host inputs for one round — ``run_round``'s
+        input half, shared with subclasses that override only the
+        dispatch half (FedOpt's server-optimizer step, TurboAggregate's
+        secure exchange), so every FedAvg-family driver gets the async
+        pipeline. Speculation is clamped to ``comm_round``: past it
+        nothing follows, so the last get() must not leave never-consumed
+        packed slots pinning HBM."""
+        pf = self._round_prefetcher()
+        if pf is None:
+            return self._prepare_round(round_idx)
+        from fedml_tpu.parallel.prefetch import consume
+        _, idxs, args = consume(pf, round_idx, self.timer, self.dataset,
+                                self._pack_round,
+                                round_bound=self.config.comm_round)
+        return idxs, args
+
     def run_round(self, round_idx: int):
-        with self.timer.phase("pack"):
-            idxs, (x, y, mask, keys, weights, agg_key) = self._prepare_round(
-                round_idx)
+        idxs, (x, y, mask, keys, weights, agg_key) = \
+            self._host_round_inputs(round_idx)
         with self.timer.phase("dispatch"):
             self.variables, stats = self._round_fn(self.variables, x, y,
                                                    mask, keys, weights,

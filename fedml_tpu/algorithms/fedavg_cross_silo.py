@@ -309,7 +309,7 @@ class FedAvgClientManager(ClientManager):
     def __init__(self, rank: int, size: int, com_manager,
                  dataset: FederatedDataset, module, task: str,
                  train_cfg: TrainConfig, seed: int = 0,
-                 compress: bool = False):
+                 compress: bool = False, prefetch_depth: int = 2):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
         from fedml_tpu.trainer.functional import validate_accum_steps
@@ -320,6 +320,45 @@ class FedAvgClientManager(ClientManager):
         self._bsz = train_cfg.batch_size
         self._base_key = jax.random.key(seed)
         self.compress = compress
+        # async round pipeline (parallel/prefetch.py): the server's
+        # client_sampling is the deterministic shared stream
+        # (core/sampling.sample_clients), so this silo can predict which
+        # client it will be handed NEXT round and pack that shard while
+        # the current local_train holds the device. Keys are
+        # ``(round_idx, client_idx)``: a mispredicting server
+        # (async/quorum reassignments) misses on the key and the inline
+        # produce then packs the ACTUAL client — one pack per round
+        # either way, exactly the serial cost. Host-numpy only — the
+        # device lock is never touched off the receive thread; closed on
+        # the server's FINISH so no speculated shard outlives the run.
+        from fedml_tpu.parallel.prefetch import (RoundPrefetcher,
+                                                 resolve_prefetch_depth)
+        depth = resolve_prefetch_depth(prefetch_depth)
+        self._prefetch = (RoundPrefetcher(self._pack_client, depth,
+                                          next_key=self._predict_next,
+                                          name=f"silo{rank}-prefetch")
+                          if depth > 0 else None)
+
+    def _pack_client(self, key):
+        """Pack one client's padded shard for ``key = (round_idx,
+        client_idx)`` (numpy; no device). ``client_idx`` None is the
+        degenerate silo-outnumbers-pool prediction — nothing to pack."""
+        _, client_idx = key
+        ds = self.dataset
+        if client_idx is None:
+            return ds, None
+        x, y, mask = ds.pack_clients([client_idx], self._bsz,
+                                     n_pad=self._n_pad)
+        return ds, (x[0], y[0], mask[0])
+
+    def _predict_next(self, key):
+        """Successor key: next round's sampled client for this silo under
+        the server's deterministic stream (FedAVGAggregator.py:89-97)."""
+        r = key[0] + 1
+        idxs = sample_clients(r, self.dataset.client_num, self.size - 1)
+        if self.rank - 1 >= len(idxs):
+            return (r, None)
+        return (r, int(idxs[self.rank - 1]))
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -327,14 +366,33 @@ class FedAvgClientManager(ClientManager):
         self.register_message_receive_handler(
             MSG_TYPE_S2C_SYNC_MODEL, self.handle_message_init)
         self.register_message_receive_handler(
-            MSG_TYPE_S2C_FINISH, lambda msg: self.finish())
+            MSG_TYPE_S2C_FINISH, self._handle_finish)
+
+    def _handle_finish(self, msg: Message) -> None:
+        # nothing follows FINISH: release speculated shards + the worker
+        # thread, then shut the protocol down
+        if self._prefetch is not None:
+            self._prefetch.close()
+        self.finish()
 
     def handle_message_init(self, msg: Message) -> None:
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = msg.get(MSG_ARG_KEY_ROUND)
         variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
-        x, y, mask = self.dataset.pack_clients([client_idx], self._bsz,
-                                               n_pad=self._n_pad)
+        packed = None
+        if self._prefetch is not None:
+            # keyed on the ACTUAL (round, client): a mispredicted slot
+            # simply misses and this same get() packs the right shard
+            # inline — never two packs for one round
+            (ds, payload), _, _ = self._prefetch.get(
+                (round_idx, int(client_idx)))
+            if ds is self.dataset:
+                packed = payload
+        if packed is None:  # swapped dataset (or degenerate None slot)
+            x, y, mask = self.dataset.pack_clients([client_idx], self._bsz,
+                                                   n_pad=self._n_pad)
+            packed = (x[0], y[0], mask[0])
+        xb, yb, maskb = packed
         reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
         # the scale is a pure function of round_idx (identical for every
         # silo this round), computed OUTSIDE the device lock with the
@@ -346,12 +404,12 @@ class FedAvgClientManager(ClientManager):
                 jax.random.fold_in(self._base_key, round_idx), client_idx)
             if scale is None:
                 new_vars, _ = self._local_train(
-                    variables, jnp.asarray(x[0]), jnp.asarray(y[0]),
-                    jnp.asarray(mask[0]), key)
+                    variables, jnp.asarray(xb), jnp.asarray(yb),
+                    jnp.asarray(maskb), key)
             else:
                 new_vars, _ = self._local_train(
-                    variables, jnp.asarray(x[0]), jnp.asarray(y[0]),
-                    jnp.asarray(mask[0]), key, lr_scale=scale)
+                    variables, jnp.asarray(xb), jnp.asarray(yb),
+                    jnp.asarray(maskb), key, lr_scale=scale)
             if self.compress:
                 from fedml_tpu.comm.compression import compress_delta
                 ckey = jax.random.fold_in(jax.random.fold_in(
@@ -382,7 +440,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           server_momentum: float = 0.0,
                           seed: int = 0,
                           join_timeout_s: float = 600.0,
-                          round_record_hook=None):
+                          round_record_hook=None,
+                          prefetch_depth: int = 2):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -414,7 +473,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         dataset, module, task, worker_num, train_cfg, server_factory,
         backend=backend, addresses=addresses, wire_codec=wire_codec,
         compress=compress, token=token, seed=seed,
-        join_timeout_s=join_timeout_s, round_record_hook=round_record_hook)
+        join_timeout_s=join_timeout_s, round_record_hook=round_record_hook,
+        prefetch_depth=prefetch_depth)
     return model, history
 
 
@@ -425,7 +485,8 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       compress: bool = False, token=None, seed: int = 0,
                       join_timeout_s: float = 600.0,
                       raise_on_timeout: bool = False,
-                      round_record_hook=None):
+                      round_record_hook=None,
+                      prefetch_depth: int = 2):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
@@ -482,7 +543,8 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                                   token=token)
         clients.append(FedAvgClientManager(rank, size, com, dataset, module,
                                            task, train_cfg, seed=seed,
-                                           compress=compress))
+                                           compress=compress,
+                                           prefetch_depth=prefetch_depth))
 
     # Warm the two heavyweight programs ON THE MAIN THREAD before any
     # actor thread starts: one local_train at the padded shape and one

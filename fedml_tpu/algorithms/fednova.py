@@ -45,6 +45,12 @@ class FedNovaConfig:
     # a_i counts only real batches, so padding never affects the
     # normalization — this is purely a FLOP/wall-clock knob
     pack: str = "cohort"
+    # accepted for launcher symmetry with FedAvgConfig (fed_launch passes
+    # one shared kwargs dict); FedNova's custom normalized-gradient loop
+    # packs serially — the async round pipeline is wired for the drivers
+    # built on FedAvgAPI._host_round_inputs (fedavg/fedopt/robust/seg/
+    # turboaggregate, the spmd mesh driver, and the cross-silo silos)
+    prefetch_depth: int = 2
 
 
 def make_fednova_local_train(module, task: str, cfg: FedNovaConfig):

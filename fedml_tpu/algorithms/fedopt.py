@@ -98,7 +98,8 @@ class FedOptAPI(FedAvgAPI):
         self._fedopt_round_fn_py = round_fn
 
     def run_round(self, round_idx: int):
-        idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
+        idxs, (x, y, mask, keys, weights, _) = self._host_round_inputs(
+            round_idx)
         self.variables, self.server_opt_state, stats = self._fedopt_round_fn(
             self.variables, self.server_opt_state, x, y, mask, keys, weights,
             jnp.uint32(round_idx))

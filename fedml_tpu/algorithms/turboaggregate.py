@@ -119,7 +119,8 @@ class SecureFedAvgAPI(FedAvgAPI):
         self._body_fn = jax.jit(self._vmapped_body)
 
     def run_round(self, round_idx: int):
-        idxs, (x, y, mask, keys, weights, _) = self._prepare_round(round_idx)
+        idxs, (x, y, mask, keys, weights, _) = self._host_round_inputs(
+            round_idx)
         from fedml_tpu.trainer.functional import round_lr_scale
         scale = round_lr_scale(self.config.train, round_idx)
         stacked, stats = (self._body_fn(self.variables, x, y, mask, keys)

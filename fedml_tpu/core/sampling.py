@@ -14,10 +14,20 @@ reference FedAVGTrainer.update_dataset semantics).
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
+
+#: the reference contract pins the draw to the GLOBAL numpy RNG
+#: (np.random.seed(round_idx) then choice). That global state is shared
+#: process-wide, so the async round pipeline's prefetch worker (and the
+#: cross-silo silo threads) drawing round r+1 concurrently with the main
+#: thread's round r would interleave seed/draw pairs and corrupt both
+#: cohorts. Each call re-seeds, so mutual exclusion alone restores the
+#: exact per-round stream regardless of thread arrival order.
+_GLOBAL_RNG_LOCK = threading.Lock()
 
 #: sentinel fold indices OUTSIDE the client-id range: client c's training
 #: key is fold_in(round_key, c), so server-side draws use ids no client can
@@ -61,12 +71,13 @@ def sample_clients(
     if client_num_in_total == client_num_per_round and delete_client is None:
         return np.arange(client_num_in_total)
     num_clients = min(client_num_per_round, client_num_in_total)
-    np.random.seed(round_idx)
     candidates: Sequence[int] = range(client_num_in_total)
     if delete_client is not None:
         candidates = [c for c in range(client_num_in_total) if c != delete_client]
         num_clients = min(num_clients, len(candidates))
-    return np.random.choice(candidates, num_clients, replace=False)
+    with _GLOBAL_RNG_LOCK:  # seed+draw must be atomic across threads
+        np.random.seed(round_idx)
+        return np.random.choice(candidates, num_clients, replace=False)
 
 
 def eval_subsample(x, y, limit: Optional[int], seed: int):

@@ -57,6 +57,15 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "(ZeRO-3, any model)")
     parser.add_argument("--mp_size", type=int, default=1,
                         help="devices per client slot for --model_parallel")
+    parser.add_argument("--prefetch_depth", type=int, default=2,
+                        help="async round pipeline: pack + upload the "
+                             "next round's cohort (or fused block window) "
+                             "on a background thread while the current "
+                             "round runs on device, holding at most this "
+                             "many cohorts in flight (2 = double "
+                             "buffering). 0 = serial host loop; "
+                             "$FEDML_TPU_PREFETCH overrides. Trajectories "
+                             "are bit-identical either way.")
     parser.add_argument("--fused_rounds", type=int, default=0,
                         help="throughput mode (simulation backend): run N "
                              "rounds per device dispatch under one "

@@ -168,6 +168,14 @@ def _validate_before_sink(args, ds):
     if args.accum_steps > 1 and args.algo in _CUSTOM_LOOP_ALGOS:
         logging.warning("--accum_steps is only wired for TrainConfig-based "
                         "algorithms; ignoring for %r", args.algo)
+    if (getattr(args, "prefetch_depth", 2) != 2
+            and args.algo in _CUSTOM_LOOP_ALGOS):
+        # the async round pipeline rides FedAvgAPI._host_round_inputs;
+        # custom-loop algorithms pack serially (default depth stays
+        # quiet — only an explicit request warrants the warning)
+        logging.warning("--prefetch_depth is not wired for %r's custom "
+                        "loop; ignoring %d", args.algo,
+                        args.prefetch_depth)
 
 
 def run_algo(args):
@@ -179,7 +187,8 @@ def run_algo(args):
     common = dict(comm_round=args.comm_round,
                   client_num_per_round=args.client_num_per_round,
                   frequency_of_the_test=args.frequency_of_the_test,
-                  seed=args.seed, train=tcfg)
+                  seed=args.seed, train=tcfg,
+                  prefetch_depth=getattr(args, "prefetch_depth", 2))
 
     if args.algo == "fedavg":
         from fedml_tpu.experiments.main_fedavg import BACKEND_RUNNERS
@@ -207,6 +216,7 @@ def run_algo(args):
             comm_round=args.comm_round, train_cfg=tcfg, seed=args.seed,
             checkpoint_dir=args.checkpoint_dir or None,
             resume=args.resume,
+            prefetch_depth=getattr(args, "prefetch_depth", 2),
             # scale the join budget with the local work — on a 1-core
             # host the silo threads SERIALIZE, so the budget grows with
             # epochs x rounds x silos; the 1200 floor absorbs a

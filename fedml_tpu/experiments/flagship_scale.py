@@ -82,7 +82,8 @@ def _incremental_history(api, path: str, period_s: float = 20.0):
 def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                eval_every: int, batch_size: int, lr: float, seed: int,
                eval_test_sub: int = None, history_path: str = None,
-               fused: int = 0, lr_decay_round: float = 1.0):
+               fused: int = 0, lr_decay_round: float = 1.0,
+               prefetch_depth: int = 2):
     """One driver end to end; returns (history, variables, stats).
 
     ``fused > 0`` routes the sim driver through ``FusedRounds.train``
@@ -106,7 +107,7 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
             comm_round=rounds, client_num_per_round=per_round,
             frequency_of_the_test=eval_every, seed=seed,
             eval_train_subsample=2000, eval_test_subsample=eval_test_sub,
-            train=tcfg))
+            prefetch_depth=prefetch_depth, train=tcfg))
     else:
         from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
                                              DistributedFedAvgConfig)
@@ -117,6 +118,7 @@ def run_driver(kind: str, ds, model, task, rounds: int, per_round: int,
                                        frequency_of_the_test=eval_every,
                                        seed=seed,
                                        eval_test_subsample=eval_test_sub,
+                                       prefetch_depth=prefetch_depth,
                                        train=tcfg))
     stop_flush = (_incremental_history(api, history_path)
                   if history_path else lambda: None)
@@ -164,6 +166,9 @@ def main(argv=None):
                    help="per-round exponential client-LR decay "
                         "(TrainConfig.lr_decay_round; 1.0 = reference "
                         "constant lr)")
+    p.add_argument("--prefetch_depth", type=int, default=2,
+                   help="async round pipeline depth (0 = serial host "
+                        "loop; $FEDML_TPU_PREFETCH overrides)")
     p.add_argument("--compile_cache_dir", type=str, default=None,
                    help="persistent XLA compilation cache dir (default: "
                         "$FEDML_TPU_COMPILE_CACHE; unset = off)")
@@ -210,6 +215,7 @@ def main(argv=None):
         "eval_test_subsample": args.eval_test_subsample,
         "fused_rounds_per_dispatch": args.fused,
         "lr_decay_round": args.lr_decay_round,
+        "prefetch_depth": args.prefetch_depth,
         # provenance: which backend actually executed this run (the judge
         # distinguishes chip anchor curves from CPU scale checks by this)
         "host": jax.default_backend(),
@@ -233,7 +239,8 @@ def main(argv=None):
             kind, ds, model, task, args.rounds, args.client_num_per_round,
             args.eval_every, args.batch_size, args.lr, args.seed,
             eval_test_sub=args.eval_test_subsample, history_path=hist_path,
-            fused=args.fused, lr_decay_round=args.lr_decay_round)
+            fused=args.fused, lr_decay_round=args.lr_decay_round,
+            prefetch_depth=args.prefetch_depth)
         results[kind] = (hist, variables)
         summary[kind] = {**stats,
                          "final": hist[-1] if hist else {}}

@@ -41,6 +41,7 @@ def run_simulation(args, ds, model, task, sink):
                        seed=args.seed,
                        eval_train_subsample=getattr(
                            args, "eval_train_subsample", None),
+                       prefetch_depth=getattr(args, "prefetch_depth", 2),
                        train=make_train_config(args))
     api = FedAvgAPI(ds, model, task=task, config=cfg)
     if getattr(args, "fused_rounds", 0):
@@ -87,6 +88,7 @@ def run_spmd(args, ds, model, task, sink):
         frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
         model_parallel=getattr(args, "model_parallel", None),
         mp_size=getattr(args, "mp_size", 1),
+        prefetch_depth=getattr(args, "prefetch_depth", 2),
         train=make_train_config(args))
     api = DistributedFedAvgAPI(ds, model, task=task, config=cfg)
     if getattr(args, "fused_rounds", 0) and cfg.model_parallel:
@@ -124,6 +126,7 @@ def run_cross_silo(args, ds, model, task, sink):
         backend=args.backend, addresses=addresses,
         compress=getattr(args, "compress", False),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
+        prefetch_depth=getattr(args, "prefetch_depth", 2),
         # fedopt-style server step when the launcher passes the fedopt flags
         server_optimizer=getattr(args, "cross_silo_server_optimizer", None),
         server_lr=getattr(args, "server_lr", 1e-3))

@@ -331,6 +331,15 @@ class DistributedFedAvgConfig:
     # seeded test-union eval subsample, same stream as
     # FedAvgConfig.eval_test_subsample so histories stay comparable
     eval_test_subsample: Optional[int] = None
+    # async round pipeline (parallel/prefetch.py): host pack + sharded
+    # device_put of round r+1 (or the next fused block window) runs on a
+    # background thread while round r's dispatch executes; at most this
+    # many cohorts stay in flight (2 = double buffering, 0 = serial;
+    # $FEDML_TPU_PREFETCH overrides). Trajectories are bit-identical to
+    # the serial path — the prefetcher runs the exact same pack for the
+    # exact round index. Engages only for partial participation (full
+    # participation keeps the resident _pack_cache cohort).
+    prefetch_depth: int = 2
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     # model parallelism INSIDE each client slot: shard the model over a
     # second mesh axis — "tp" (Megatron, transformer models) or "fsdp"
@@ -427,6 +436,11 @@ class DistributedFedAvgAPI:
         self._pack_cache = None
         # eval union: padded to a mesh multiple, sharded, device-resident
         self._eval_cache = None
+        # cohort / fused-block prefetchers (parallel/prefetch.py), built
+        # lazily; each is (prefetcher, dataset-at-build) so a mid-run
+        # dataset swap invalidates in-flight slots like _pack_cache
+        self._prefetch = None
+        self._block_prefetch = None
 
     def _eval_global(self):
         xt, yt = self.dataset.test_data_global
@@ -461,50 +475,130 @@ class DistributedFedAvgAPI:
         alive = np.concatenate([np.ones(P_round), np.zeros(rem)])
         return padded, alive.astype(np.float32)
 
-    def run_round(self, round_idx: int):
+    def _pack_cohort(self, idxs, dataset=None):
+        """Cache-free pad + pack + sharded upload of one sampled cohort
+        (thread-safe: no shared mutable state — the prefetcher worker runs
+        this concurrently with the main thread's dispatch)."""
         cfg = self.config
-        idxs = sample_clients(round_idx, self.dataset.client_num,
-                              cfg.client_num_per_round)
-        put = lambda a: jax.device_put(a, self._data_sharding)
-        cohort = tuple(int(i) for i in idxs)
+        ds = dataset if dataset is not None else self.dataset
         with self.timer.phase("pack"):
+            padded, alive = self._pad_round(np.asarray(idxs))
+            n_pad = (ds.cohort_padded_len(padded, cfg.train.batch_size)
+                     if cfg.pack == "cohort" else self._n_pad)
+            x, y, mask = ds.pack_clients(padded, cfg.train.batch_size,
+                                         n_pad=n_pad)
+            mask = mask * alive[:, None]
+            weights = ds.client_weights(padded) * alive
+        with self.timer.phase("upload"):
+            put = lambda a: jax.device_put(jnp.asarray(a),
+                                           self._data_sharding)
+            return padded, (put(x), put(y), put(mask), put(weights))
+
+    def _pack_round(self, round_idx: int):
+        """Full host side of one round (sampling, pack, upload, sharded
+        per-client keys) as a function of the round index — the
+        prefetcher's ``produce``. The dataset reference is snapshot once
+        so a concurrent swap can't mix arrays; the payload carries it for
+        the caller's identity check."""
+        ds = self.dataset
+        idxs = sample_clients(round_idx, ds.client_num,
+                              self.config.client_num_per_round)
+        padded, (xd, yd, maskd, wd) = self._pack_cohort(idxs, dataset=ds)
+        _, keys, _ = round_keys(
+            self._base_key, round_idx,
+            jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
+        keysd = jax.device_put(keys, self._data_sharding)
+        return ds, idxs, (xd, yd, maskd, keysd, wd)
+
+    def _round_prefetcher(self):
+        """Cohort prefetcher, or None for the serial path (depth 0 via
+        config or the $FEDML_TPU_PREFETCH kill switch, or full
+        participation where _pack_cache already keeps the cohort
+        resident)."""
+        from fedml_tpu.parallel.prefetch import (RoundPrefetcher,
+                                                 bind_prefetcher,
+                                                 resolve_prefetch_depth)
+        depth = resolve_prefetch_depth(
+            getattr(self.config, "prefetch_depth", 0))
+        if (depth <= 0 or self.config.client_num_per_round
+                >= self.dataset.client_num):
+            if self._prefetch is not None:
+                # kill switch flipped mid-run: free the resident slots
+                self._prefetch[0].invalidate()
+            return None
+        self._prefetch = bind_prefetcher(
+            self._prefetch, self.dataset,
+            lambda: RoundPrefetcher(self._pack_round, depth,
+                                    name="mesh-cohort-prefetch"))
+        return self._prefetch[0]
+
+    def prefetch_stats(self):
+        """Merged cohort + block prefetcher counters, or None when every
+        round ran the serial path — evidence hook for bench/tests."""
+        out = None
+        for pf in (self._prefetch, self._block_prefetch):
+            if pf is None:
+                continue
+            stats = pf[0].stats()
+            if out is None:
+                out = stats
+            else:
+                for k, v in stats.items():
+                    out[k] = out[k] + v
+        return out
+
+    def release_prefetch(self):
+        """Drop every speculative slot (their device buffers — a block
+        slot is a whole ``[R, P, n_pad, ...]`` sharded window) without
+        stopping the workers. ``train``/``train_fused`` end clean on
+        their own (the speculation clamp / final ``()`` window), but a
+        DIRECT ``run_rounds_fused`` loop leaves its last speculative
+        window resident — call this when it finishes if you need the HBM
+        back before the API dies."""
+        for pf in (self._prefetch, self._block_prefetch):
+            if pf is not None:
+                pf[0].invalidate()
+
+    def run_round(self, round_idx: int):
+        pf = self._round_prefetcher()
+        if pf is not None:
+            from fedml_tpu.parallel.prefetch import consume
+            _, idxs, args = consume(pf, round_idx, self.timer,
+                                    self.dataset, self._pack_round,
+                                    round_bound=self.config.comm_round)
+            xd, yd, maskd, keysd, wd = args
+        else:
+            cfg = self.config
+            idxs = sample_clients(round_idx, self.dataset.client_num,
+                                  cfg.client_num_per_round)
+            cohort = tuple(int(i) for i in idxs)
             if (self._pack_cache is not None
                     and self._pack_cache[0] is self.dataset
                     and self._pack_cache[1] == cohort):
                 padded, xd, yd, maskd, wd = self._pack_cache[2]
             else:
                 self._pack_cache = None
-                padded, alive = self._pad_round(np.asarray(idxs))
-                n_pad = (self.dataset.cohort_padded_len(
-                    padded, cfg.train.batch_size)
-                    if cfg.pack == "cohort" else self._n_pad)
-                x, y, mask = self.dataset.pack_clients(
-                    padded, cfg.train.batch_size, n_pad=n_pad)
-                mask = mask * alive[:, None]
-                weights = self.dataset.client_weights(padded) * alive
-                xd, yd, maskd, wd = (put(jnp.asarray(x)),
-                                     put(jnp.asarray(y)),
-                                     put(jnp.asarray(mask)),
-                                     put(jnp.asarray(weights)))
+                padded, (xd, yd, maskd, wd) = self._pack_cohort(idxs)
                 if len(idxs) == self.dataset.client_num:
                     self._pack_cache = (self.dataset, cohort,
                                         (padded, xd, yd, maskd, wd))
-        with self.timer.phase("dispatch"):
             _, keys, _ = round_keys(
                 self._base_key, round_idx,
                 jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
+            keysd = jax.device_put(keys, self._data_sharding)
+        with self.timer.phase("dispatch"):
             if self.config.train.lr_decay_round != 1.0:
                 # decayed builder takes the replicated round index as its
                 # final operand (make_spmd_round's conditional spec)
                 self.variables, stats = self._round_fn(
-                    self.variables, xd, yd, maskd, put(keys), wd,
+                    self.variables, xd, yd, maskd, keysd, wd,
                     jnp.uint32(round_idx))
             else:
                 self.variables, stats = self._round_fn(
-                    self.variables, xd, yd, maskd, put(keys), wd)
+                    self.variables, xd, yd, maskd, keysd, wd)
         return idxs, stats
 
-    def run_rounds_fused(self, r0: int, rounds: int):
+    def run_rounds_fused(self, r0: int, rounds: int, next_window=None):
         """Advance the model by ``rounds`` rounds in ONE device dispatch.
 
         Full participation (``client_num_per_round == client_num``): the
@@ -515,14 +609,23 @@ class DistributedFedAvgAPI:
         at the block's cohort bucket, and scanned in one dispatch
         (make_spmd_block_multiround) — both throughput levers at once,
         trajectory-identical to R ``run_round`` calls. Returns stacked
-        per-round stats."""
+        per-round stats.
+
+        ``next_window``: the caller's ACTUAL next ``(r0, rounds)`` window
+        (``train_fused`` knows its whole chunk schedule up front), so the
+        block prefetcher packs exactly that window behind this dispatch;
+        the bare ``(r0 + rounds, rounds)`` guess would miss at every
+        eval-boundary chunk-size change and waste whole-window speculative
+        uploads. ``()`` means "nothing follows" (last window: speculate
+        nothing); None keeps the uniform-window guess for direct callers."""
         cfg = self.config
         N = self.dataset.client_num
         if cfg.model_parallel:
             raise ValueError(
                 "fused mesh rounds support the flat 'clients' mesh only")
         if cfg.client_num_per_round != N:
-            return self._run_block_fused(r0, rounds)
+            return self._run_block_fused(r0, rounds,
+                                         next_window=next_window)
         if (getattr(self, "_fused_data", None) is None
                 or self._fused_data[0] is not self.dataset):
             padded, alive = self._pad_round(np.arange(N))
@@ -549,40 +652,89 @@ class DistributedFedAvgAPI:
             jnp.uint32(r0))
         return stats
 
-    def _run_block_fused(self, r0: int, rounds: int):
-        """Sampled-cohort fused block on the mesh: host-drawn cohorts,
-        one [R, P, n_pad, ...] sharded upload, one scan dispatch."""
+    def _pack_block(self, key):
+        """Host side of one fused block window ``key = (r0, rounds)``:
+        draw the R cohorts with the host sampling stream, pack them as one
+        ``[R, P, n_pad, ...]`` batch, shard-upload. Thread-safe (the block
+        prefetcher's ``produce``); the payload carries the dataset for the
+        caller's identity check."""
+        r0, rounds = key
         cfg = self.config
         bsz = cfg.train.batch_size
         ds = self.dataset
-        cohorts = [sample_clients(r, ds.client_num,
-                                  cfg.client_num_per_round)
-                   for r in range(r0, r0 + rounds)]
-        padded_alive = [self._pad_round(np.asarray(c)) for c in cohorts]
-        flat = np.concatenate([p for p, _ in padded_alive])
-        alive = np.concatenate([a for _, a in padded_alive])
-        n_pad = (max(ds.cohort_padded_len(c, bsz) for c in cohorts)
-                 if cfg.pack == "cohort" else self._n_pad)
-        x, y, mask = ds.pack_clients(flat, bsz, n_pad=n_pad)
-        mask = mask * alive[:, None]
-        weights = ds.client_weights(flat) * alive
-        P_pad = len(padded_alive[0][0])  # cohort size padded to the mesh
-        lead = (rounds, P_pad)
-        put = lambda a: jax.device_put(
-            jnp.asarray(a), NamedSharding(self.mesh, P(None, "clients")))
-        args = (put(x.reshape(lead + x.shape[1:])),
-                put(y.reshape(lead + y.shape[1:])),
-                put(mask.reshape(lead + mask.shape[1:])),
-                put(flat.astype(np.uint32).reshape(lead)),
-                put(weights.reshape(lead)))
+        with self.timer.phase("pack"):
+            cohorts = [sample_clients(r, ds.client_num,
+                                      cfg.client_num_per_round)
+                       for r in range(r0, r0 + rounds)]
+            padded_alive = [self._pad_round(np.asarray(c)) for c in cohorts]
+            flat = np.concatenate([p for p, _ in padded_alive])
+            alive = np.concatenate([a for _, a in padded_alive])
+            n_pad = (max(ds.cohort_padded_len(c, bsz) for c in cohorts)
+                     if cfg.pack == "cohort" else self._n_pad)
+            x, y, mask = ds.pack_clients(flat, bsz, n_pad=n_pad)
+            mask = mask * alive[:, None]
+            weights = ds.client_weights(flat) * alive
+            P_pad = len(padded_alive[0][0])  # cohort padded to the mesh
+            lead = (rounds, P_pad)
+        with self.timer.phase("upload"):
+            put = lambda a: jax.device_put(
+                jnp.asarray(a), NamedSharding(self.mesh,
+                                              P(None, "clients")))
+            args = (put(x.reshape(lead + x.shape[1:])),
+                    put(y.reshape(lead + y.shape[1:])),
+                    put(mask.reshape(lead + mask.shape[1:])),
+                    put(flat.astype(np.uint32).reshape(lead)),
+                    put(weights.reshape(lead)))
+        return ds, args
+
+    def _block_prefetcher(self):
+        """Fused-block-window prefetcher. Clamped to ONE window ahead
+        regardless of prefetch_depth: each slot holds a whole R-round
+        block, so depth 1 is already double buffering and deeper
+        speculation would multiply HBM by block size."""
+        from fedml_tpu.parallel.prefetch import (RoundPrefetcher,
+                                                 bind_prefetcher,
+                                                 resolve_prefetch_depth)
+        depth = resolve_prefetch_depth(
+            getattr(self.config, "prefetch_depth", 0))
+        if depth <= 0:
+            if self._block_prefetch is not None:
+                # kill switch flipped mid-run: a block slot is a whole
+                # [R, P, n_pad, ...] sharded window — free it
+                self._block_prefetch[0].invalidate()
+            return None
+        self._block_prefetch = bind_prefetcher(
+            self._block_prefetch, self.dataset,
+            lambda: RoundPrefetcher(self._pack_block, depth=1,
+                                    next_key=lambda k: (k[0] + k[1], k[1]),
+                                    name="mesh-block-prefetch"))
+        return self._block_prefetch[0]
+
+    def _run_block_fused(self, r0: int, rounds: int, next_window=None):
+        """Sampled-cohort fused block on the mesh: host-drawn cohorts,
+        one [R, P, n_pad, ...] sharded upload, one scan dispatch. With
+        prefetching on, the NEXT window's pack + upload runs behind this
+        window's scan (the caller's real schedule when supplied, see
+        run_rounds_fused)."""
+        pf = self._block_prefetcher()
+        if pf is not None:
+            from fedml_tpu.parallel.prefetch import consume
+            upcoming = (None if next_window is None
+                        else ([tuple(next_window)] if next_window else []))
+            _, args = consume(pf, (r0, rounds), self.timer,
+                              self.dataset, self._pack_block,
+                              upcoming=upcoming)
+        else:
+            _, args = self._pack_block((r0, rounds))
         if getattr(self, "_block_fn", None) is None:
             # one jitted program; jit's own shape-keyed trace cache
             # specializes per (R, P_pad, n_pad) block shape
             self._block_fn = make_spmd_block_multiround(
-                self.module, self.task, cfg.train, self.mesh,
+                self.module, self.task, self.config.train, self.mesh,
                 check_vma=getattr(self, "_check_vma", True))
-        self.variables, stats = self._block_fn(
-            self.variables, *args, self._base_key, jnp.uint32(r0))
+        with self.timer.phase("dispatch"):
+            self.variables, stats = self._block_fn(
+                self.variables, *args, self._base_key, jnp.uint32(r0))
         return stats
 
     def train_fused(self, max_rounds_per_dispatch: Optional[int] = None
@@ -599,16 +751,28 @@ class DistributedFedAvgAPI:
         freq = cfg.frequency_of_the_test
         evals = sorted({r for r in range(0, cfg.comm_round, freq)}
                        | {cfg.comm_round - 1})
-        r = 0
+        # the whole chunk schedule is known up front — computed here so
+        # each dispatch can hand the block prefetcher its REAL successor
+        # window (chunk sizes change at eval boundaries, which a uniform
+        # stride guess would miss every time)
+        windows, r = [], 0
         for e in evals:
-            stats = None
             while r <= e:
                 chunk = e + 1 - r
                 if max_rounds_per_dispatch:
                     chunk = min(chunk, max_rounds_per_dispatch)
-                stats = self.run_rounds_fused(r, chunk)
+                windows.append((r, chunk, e))
                 r += chunk
-            rec = {"round": r - 1,
+        wi = 0
+        for e in evals:
+            stats = None
+            while wi < len(windows) and windows[wi][2] == e:
+                w0, chunk, _ = windows[wi]
+                nxt = (windows[wi + 1][:2] if wi + 1 < len(windows)
+                       else ())
+                stats = self.run_rounds_fused(w0, chunk, next_window=nxt)
+                wi += 1
+            rec = {"round": e,
                    "train_loss_local": (
                        float(stats["loss_sum"][-1])
                        / max(1.0, float(stats["count"][-1])))}

@@ -25,6 +25,101 @@ def param_bytes(variables: Any) -> int:
                if hasattr(x, "shape"))
 
 
+#: elementwise primitives billed at one FLOP per output element — enough to
+#: make GroupNorm's normalize/scale/shift arithmetic (and activations)
+#: visible next to the conv/matmul terms without pretending to cycle-level
+#: accuracy. Pure data movement (reshape/transpose/gather/...) stays 0.
+_ELEMWISE = {
+    "add", "sub", "mul", "div", "rem", "neg", "abs", "sign", "max", "min",
+    "exp", "log", "expm1", "log1p", "tanh", "logistic", "erf", "erf_inv",
+    "sqrt", "rsqrt", "pow", "integer_pow", "cos", "sin", "floor", "ceil",
+    "round", "clamp", "select_n", "nextafter", "atan2", "square", "cbrt",
+}
+
+
+def _aval_elems(var) -> float:
+    shape = getattr(var.aval, "shape", ())
+    return float(np.prod(shape)) if shape else 1.0
+
+
+def _eqn_flops(eqn) -> float:
+    prim = eqn.primitive.name
+    if prim == "dot_general":
+        (lhs_c, _), _ = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        contract = 1.0
+        for d in lhs_c:
+            contract *= lhs.shape[d]
+        return 2.0 * _aval_elems(eqn.outvars[0]) * contract
+    if prim == "conv_general_dilated":
+        rhs = eqn.invars[1].aval
+        dn = eqn.params["dimension_numbers"]
+        # rhs_spec = (out_feature_dim, in_feature_dim, *spatial_dims): each
+        # output element contracts C_in/groups * prod(kernel spatial)
+        # values (the grouped-conv form also covers GN-era depthwise)
+        spatial = 1.0
+        for d in dn.rhs_spec[2:]:
+            spatial *= rhs.shape[d]
+        cin_per_group = rhs.shape[dn.rhs_spec[1]]
+        return (2.0 * _aval_elems(eqn.outvars[0]) * cin_per_group * spatial)
+    if prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+                "argmax", "argmin", "reduce_window_sum",
+                "reduce_window_max", "cumsum", "cumlogsumexp"):
+        return sum(_aval_elems(v) for v in eqn.invars)
+    if prim in _ELEMWISE:
+        return _aval_elems(eqn.outvars[0])
+    return 0.0
+
+
+def _jaxpr_flops(jaxpr) -> float:
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        try:
+            if prim == "scan":
+                total += float(eqn.params["length"]) * _jaxpr_flops(
+                    eqn.params["jaxpr"].jaxpr)
+            elif prim == "while":
+                # static trip count is unknowable; bill one body iteration
+                total += _jaxpr_flops(eqn.params["body_jaxpr"].jaxpr)
+            elif prim == "cond":
+                total += max((_jaxpr_flops(b.jaxpr)
+                              for b in eqn.params["branches"]), default=0.0)
+            elif "jaxpr" in eqn.params:
+                inner = eqn.params["jaxpr"]
+                total += _jaxpr_flops(getattr(inner, "jaxpr", inner))
+            elif "call_jaxpr" in eqn.params:
+                inner = eqn.params["call_jaxpr"]
+                total += _jaxpr_flops(getattr(inner, "jaxpr", inner))
+            elif "fun_jaxpr" in eqn.params:  # custom_vjp_call
+                inner = eqn.params["fun_jaxpr"]
+                total += _jaxpr_flops(getattr(inner, "jaxpr", inner))
+            else:
+                total += _eqn_flops(eqn)
+        except Exception:  # noqa: BLE001 — unknown primitive shapes: bill 0
+            pass
+    return total
+
+
+def analytic_flops(fn, *args, **kwargs) -> float:
+    """Backend-independent analytic FLOP count of ``fn(*args)``: trace to
+    a jaxpr (no compile, no device) and sum exact matmul/conv terms
+    (``2*M*N*K``; conv ``2 * out_elems * C_in/groups * prod(kernel)``,
+    grouped and depthwise included) plus one FLOP per element for
+    elementwise/reduction ops — the conv/GroupNorm cost model. ``scan``
+    bodies multiply by trip count, so a whole epochs×batches local-train
+    program is billed correctly. Differentiated programs are billed from
+    the traced jaxpr, i.e. the backward convs/matmuls count as the real
+    ops XLA will run, not a 3x-forward heuristic.
+
+    Use when the XLA cost model is unavailable — some TPU plugin paths
+    return no ``cost_analysis`` for conv round programs (BENCH_r05's
+    ``resnet18_gn_fedcifar100`` serialized ``round_flops: null``); the
+    jaxpr count stands in so MFU evidence never silently drops."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return _jaxpr_flops(closed.jaxpr)
+
+
 def cost_analysis(fn, *args) -> Dict[str, float]:
     """XLA cost model for ``jit(fn)(*args)``: flops, bytes accessed, etc."""
     lowered = jax.jit(fn).lower(*args)

@@ -4,7 +4,11 @@ The reference's only tracing is wall-clock log lines
 (``aggregate time cost``, FedAVGAggregator.py:85-86). Here:
 - ``RoundTimer`` — cheap named phase timing with running aggregates
   (host-side; call ``block_until_ready`` on outputs before stopping a phase
-  to charge async device work to the right bucket)
+  to charge async device work to the right bucket). Thread-safe: the round
+  prefetcher (parallel/prefetch.py) charges ``pack``/``upload`` phases from
+  its worker thread while the main thread times ``dispatch`` — overlapped
+  phases record where time went, not critical-path wall-clock. Event
+  counters (``count``) track prefetch hits/misses next to the phase means.
 - ``profile`` — context manager around ``jax.profiler.trace`` emitting a
   TensorBoard-loadable trace directory when enabled, a no-op otherwise.
 """
@@ -12,6 +16,7 @@ The reference's only tracing is wall-clock log lines
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Iterator, Optional
@@ -21,6 +26,8 @@ class RoundTimer:
     def __init__(self) -> None:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        self.counters: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -28,16 +35,34 @@ class RoundTimer:
         try:
             yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Charge ``seconds`` to a phase directly (pre-measured time, e.g.
+        the prefetcher's ``prefetch_wait``)."""
+        with self._lock:
+            self.totals[name] += seconds
             self.counts[name] += 1
 
+    def count(self, name: str, n: int = 1) -> None:
+        """Bump an event counter (e.g. ``prefetch_hit``/``prefetch_miss``)."""
+        with self._lock:
+            self.counters[name] += n
+
     def means(self) -> Dict[str, float]:
-        return {k: self.totals[k] / max(1, self.counts[k])
-                for k in self.totals}
+        with self._lock:
+            return {k: self.totals[k] / max(1, self.counts[k])
+                    for k in self.totals}
 
     def report(self) -> str:
-        return " | ".join(f"{k}: {v * 1e3:.1f}ms"
-                          for k, v in sorted(self.means().items()))
+        out = " | ".join(f"{k}: {v * 1e3:.1f}ms"
+                         for k, v in sorted(self.means().items()))
+        with self._lock:
+            counters = dict(self.counters)
+        if counters:
+            out += " | " + " | ".join(
+                f"{k}: {v}" for k, v in sorted(counters.items()))
+        return out
 
 
 @contextlib.contextmanager

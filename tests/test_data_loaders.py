@@ -254,6 +254,63 @@ class TestStreaming:
         assert x0[0, 0] == 0.0 and x0[1, 0] == 3.0  # samples 0, 3, 6, 9
         assert set(np.unique(y0)) <= {-1.0, 1.0}
 
+    def test_numpy_fast_path_matches_reference_reader(self, tmp_path):
+        # the vectorized np.loadtxt path must reproduce the row-loop
+        # reader exactly: label-first and label-last layouts, limit
+        # truncation, the >0.5 -> {-1,+1} label map
+        from fedml_tpu.data.streaming import (_read_csv_python,
+                                              read_streaming_csv)
+        rng = np.random.RandomState(7)
+        rows = np.round(rng.randn(23, 5).astype(np.float64), 6)
+        rows[:, 0] = rng.randint(0, 2, 23)  # SUSY-style 0/1 label
+        p = str(tmp_path / "fixture.csv")
+        with open(p, "w") as f:
+            for row in rows:
+                f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+        for label_first in (True, False):
+            for limit in (0, 7):
+                fast = read_streaming_csv(p, label_first=label_first,
+                                          limit=limit)
+                ref = _read_csv_python(p, label_first=label_first,
+                                       limit=limit)
+                np.testing.assert_array_equal(fast[0], ref[0])
+                np.testing.assert_array_equal(fast[1], ref[1])
+                assert fast[0].dtype == ref[0].dtype == np.float32
+
+    def test_ragged_rows_fall_back_to_reference_reader(self, tmp_path):
+        # trailing delimiters/blank fields reject the rectangular parser;
+        # the reader must transparently fall back to the row loop
+        p = str(tmp_path / "ragged.csv")
+        with open(p, "w") as f:
+            f.write("1,2.0,3.0,\n0,4.0,5.0\n")  # trailing comma row
+        from fedml_tpu.data.streaming import read_streaming_csv
+        x, y = read_streaming_csv(p, label_first=True)
+        np.testing.assert_array_equal(x, [[2.0, 3.0], [4.0, 5.0]])
+        np.testing.assert_array_equal(y, [1.0, -1.0])
+
+    def test_hash_suffixed_field_raises_like_reference(self, tmp_path):
+        # loadtxt's default '#' comment handling would silently truncate
+        # what the reference reader rejects; both must raise
+        import pytest
+        p = str(tmp_path / "hash.csv")
+        with open(p, "w") as f:
+            f.write("1,2.0,3.0#flag\n0,4.0,5.0#flag\n")
+        from fedml_tpu.data.streaming import read_streaming_csv
+        with pytest.raises(ValueError):
+            read_streaming_csv(p, label_first=True)
+
+    def test_blank_interior_line_raises_like_reference(self, tmp_path):
+        # loadtxt would silently skip a blank line; the reference loop
+        # raises (csv.reader yields [] -> vals[0] IndexError). The fast
+        # path must fall back so both raise identically.
+        import pytest
+        p = str(tmp_path / "blank.csv")
+        with open(p, "w") as f:
+            f.write("1,2.0,3.0\n\n0,4.0,5.0\n")
+        from fedml_tpu.data.streaming import read_streaming_csv
+        with pytest.raises(IndexError):
+            read_streaming_csv(p, label_first=True)
+
 
 class TestPoisoned:
     def test_trigger_and_flip(self):

@@ -69,3 +69,62 @@ class TestFlops:
         m = LogisticRegression(num_classes=10)
         v = m.init(jax.random.key(0), jnp.zeros((1, 784)), train=False)
         assert count_params(v) == 784 * 10 + 10
+
+
+class TestAnalyticFlops:
+    """The conv/GroupNorm jaxpr cost model (utils/flops.analytic_flops) —
+    the bench's fallback when the chip plugin's XLA cost analysis returns
+    nothing for conv round programs (BENCH_r05 resnet nulls)."""
+
+    def test_matmul_exact(self):
+        import jax.numpy as jnp
+
+        from fedml_tpu.utils.flops import analytic_flops
+        a, b = jnp.zeros((64, 128)), jnp.zeros((128, 32))
+        assert analytic_flops(lambda a, b: a @ b, a, b) == 2 * 64 * 128 * 32
+
+    def test_conv_matches_xla_cost_model(self):
+        import jax.numpy as jnp
+
+        from fedml_tpu.utils.flops import analytic_flops, cost_analysis
+
+        def conv(x, k):
+            return jax.lax.conv_general_dilated(
+                x, k, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        x, k = jnp.zeros((4, 24, 24, 16)), jnp.zeros((3, 3, 16, 32))
+        af = analytic_flops(conv, x, k)
+        xf = cost_analysis(conv, x, k)["flops"]
+        if xf == xf:  # cost model available on this backend
+            assert 0.9 < af / xf < 1.3  # elementwise billing adds a few %
+        # exact conv MAC count dominates: 2 * out * Cin * k*k
+        assert af >= 2 * 4 * 24 * 24 * 32 * 16 * 9
+
+    def test_scan_multiplies_trip_count(self):
+        # XLA's cost model bills a scan body once regardless of length
+        # (verified in bench_fedavg_cnn_fused_headline); the analytic
+        # model must multiply, or multi-batch local loops under-report
+        import jax.numpy as jnp
+
+        from fedml_tpu.utils.flops import analytic_flops
+        W = jnp.zeros((32, 32))
+
+        def scanned(x, n):
+            out, _ = jax.lax.scan(lambda c, _: (c @ W, None), x, None,
+                                  length=n)
+            return out
+
+        one = analytic_flops(lambda x: scanned(x, 1), W)
+        eight = analytic_flops(lambda x: scanned(x, 8), W)
+        assert eight == 8 * one
+
+    def test_grad_counts_backward_ops(self):
+        import jax.numpy as jnp
+
+        from fedml_tpu.utils.flops import analytic_flops
+        W = jnp.zeros((64, 64))
+        fwd = analytic_flops(lambda w: jnp.sum((w @ W) ** 2), W)
+        bwd = analytic_flops(
+            lambda w: jax.grad(lambda v: jnp.sum((v @ W) ** 2))(w), W)
+        assert bwd > 1.5 * fwd  # backward adds its real matmuls
