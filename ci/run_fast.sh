@@ -1,6 +1,8 @@
 #!/usr/bin/env bash
 # CI fast lane (the reference's per-PR Travis role, CI-script-fedavg.sh):
-# unit + integration tests on 8 virtual CPU devices, ~6 min.
+# static analysis (analysis CLI: AST lint + jaxpr audit, ~25 s), then
+# unit + integration tests on 8 virtual CPU devices, ~7 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+./ci/run_static.sh
 exec python -m pytest tests/ -q -m "not slow" "$@"

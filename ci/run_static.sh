@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# CI static lane: fedml_tpu.analysis (AST lint FT001-FT006 + jaxpr audit
+# of the registered hot entry points) over fedml_tpu/ and tests/.
+# Exit non-zero on any finding that is not fixed, pragma'd
+# (# ft: allow[FTxxx]) or baselined in ci/analysis_baseline.json.
+# The JSON report lands in runs/static_analysis.json as a CI artifact.
+# Extra args pass through (e.g. --no-audit for a sub-second lint-only
+# pre-commit hook).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p runs
+exec env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+    python -m fedml_tpu.analysis \
+    --baseline ci/analysis_baseline.json \
+    --output runs/static_analysis.json \
+    "$@"

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedml_tpu.core.sampling import locked_global_numpy_rng
 from fedml_tpu.core.topology import (AsymmetricTopologyManager,
                                      SymmetricTopologyManager)
 
@@ -47,15 +48,18 @@ class DecentralizedConfig:
 def _make_topologies(n: int, cfg: DecentralizedConfig) -> np.ndarray:
     """[T, n, n] mixing matrices (static => the same matrix tiled)."""
     def gen(seed):
-        np.random.seed(seed)
-        if cfg.b_symmetric:
-            mgr = SymmetricTopologyManager(
-                n, cfg.topology_neighbors_num_undirected)
-        else:
-            mgr = AsymmetricTopologyManager(
-                n, cfg.topology_neighbors_num_undirected,
-                cfg.topology_neighbors_num_directed)
-        return mgr.generate_topology()
+        # atomic seed + topology coin flips on the locked global stream
+        # (reference seeds np.random; the flips draw inside
+        # generate_topology — the reentrant lock spans both)
+        with locked_global_numpy_rng(seed):
+            if cfg.b_symmetric:
+                mgr = SymmetricTopologyManager(
+                    n, cfg.topology_neighbors_num_undirected)
+            else:
+                mgr = AsymmetricTopologyManager(
+                    n, cfg.topology_neighbors_num_undirected,
+                    cfg.topology_neighbors_num_directed)
+            return mgr.generate_topology()
 
     if cfg.time_varying and not cfg.b_symmetric:
         # per-iteration regeneration (reference client_pushsum.py:63-72);

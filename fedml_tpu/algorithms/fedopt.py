@@ -127,3 +127,36 @@ class FedOptFusedRounds(FusedRounds):
 
 
 FedOptAPI._fused_driver_cls = FedOptFusedRounds
+
+
+# -- static-analysis hook (fedml_tpu.analysis layer 2) ----------------------
+from fedml_tpu.analysis.registry import AuditSpec, hot_entry_point  # noqa: E402
+
+
+@hot_entry_point("fedopt.round_fn")
+def _audit_fedopt_round() -> AuditSpec:
+    """FedOpt's server-optimizer round (adam server tx) over three real
+    rounds' host inputs — the carry includes opt_state, so a signature
+    drift in EITHER the model or the optimizer tree forks the cache."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+
+    ds = make_blob_federated(client_num=4, n_samples=200, seed=0)
+    api = FedOptAPI(
+        ds, LogisticRegression(num_classes=ds.class_num),
+        config=FedOptConfig(
+            comm_round=3, client_num_per_round=2, pack="global",
+            prefetch_depth=0, server_optimizer="adam", server_lr=0.01,
+            train=TrainConfig(epochs=1, batch_size=8)))
+
+    def inputs(r):
+        _, (x, y, mask, keys, w, _) = api._prepare_round(r)
+        return (api.variables, api.server_opt_state, x, y, mask, keys, w,
+                jnp.uint32(r))
+
+    return AuditSpec(fn=api._fedopt_round_fn,
+                     sweep=[inputs(r) for r in range(3)],
+                     max_lowerings=1, grad_path=True)

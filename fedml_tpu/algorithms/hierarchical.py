@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.core import pytree as pt
-from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.sampling import locked_global_numpy_rng, sample_clients
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train)
@@ -66,9 +66,11 @@ class HierarchicalFedAvgAPI:
                 "lr_decay_round is not defined for the 2-tier loop (which "
                 "round index decays — group or global?); use the flat "
                 "FedAvg drivers for the schedule")
-        np.random.seed(cfg.seed)
-        self.group_indexes = np.random.randint(0, cfg.group_num,
-                                               dataset.client_num)
+        # reference parity (GroupHierarchicalFL seeds the global stream);
+        # atomic seed+draw on the locked global RNG
+        with locked_global_numpy_rng(cfg.seed) as grng:
+            self.group_indexes = grng.randint(0, cfg.group_num,
+                                              dataset.client_num)
 
         from fedml_tpu.algorithms.fedavg import make_vmapped_body
         from fedml_tpu.trainer.functional import validate_accum_steps

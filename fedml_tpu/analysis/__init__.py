@@ -1,0 +1,28 @@
+"""fedml_tpu.analysis — JAX-aware static analysis for this codebase.
+
+Two layers (ISSUE 3; in the spirit of XLA's HLO verifier, but aimed at
+the hazards a TPU federated-learning stack actually ships):
+
+- **AST lint** (:mod:`.lint`, :mod:`.rules`): project-specific rules
+  FT001–FT006 over the source tree — thread-unsafe global RNG,
+  donated-buffer reuse, hot-path host syncs, scalar jit signatures,
+  swallowed exceptions, stray float64.
+- **jaxpr audit** (:mod:`.jaxpr_audit`, :mod:`.registry`): traces the
+  registered hot entry points and inspects the program itself — f64
+  results, callbacks inside scan bodies, grad-path upcasts, lowering-
+  key stability across a declared shape sweep.
+
+CLI: ``python -m fedml_tpu.analysis --format text|json
+[--baseline ci/analysis_baseline.json]`` — exit 0 iff every finding is
+fixed, pragma'd (``# ft: allow[FTxxx]``), or baselined.
+"""
+
+from fedml_tpu.analysis.baseline import (apply_baseline, load_baseline,
+                                         save_baseline)
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, Rule, lint_paths
+from fedml_tpu.analysis.registry import AuditSpec, hot_entry_point
+
+__all__ = ["Finding", "FileContext", "Rule", "lint_paths", "AuditSpec",
+           "hot_entry_point", "apply_baseline", "load_baseline",
+           "save_baseline"]

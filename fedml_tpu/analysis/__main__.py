@@ -1,0 +1,152 @@
+"""CLI: ``python -m fedml_tpu.analysis [paths...]``.
+
+Default paths are ``fedml_tpu/`` and ``tests/`` under the repo root
+(auto-detected: the cwd if it contains ``fedml_tpu/``, else the
+package's parent). Exit codes: 0 clean (all findings fixed, pragma'd
+or baselined), 1 active findings, 2 internal error. Human output goes
+to stdout in ``--format text`` (the default), one JSON report object
+in ``--format json``; ``--output`` additionally writes the JSON report
+as a CI artifact in either mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List
+
+from fedml_tpu.analysis.baseline import (apply_baseline, load_baseline,
+                                         save_baseline)
+from fedml_tpu.analysis.lint import lint_paths
+
+
+def _repo_root() -> Path:
+    cwd = Path.cwd()
+    if (cwd / "fedml_tpu").is_dir():
+        return cwd
+    import fedml_tpu
+    return Path(fedml_tpu.__file__).resolve().parent.parent
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fedml_tpu.analysis",
+        description="JAX-aware static analysis: AST lint + jaxpr audit")
+    parser.add_argument("paths", nargs="*", type=Path,
+                        help="files/dirs to lint (default: fedml_tpu/ and "
+                             "tests/ under the repo root)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="baseline JSON; matching findings are "
+                             "suppressed, unmatched entries warn stale "
+                             "(default: ci/analysis_baseline.json under "
+                             "the repo root, when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the default repo baseline")
+    parser.add_argument("--write-baseline", type=Path, default=None,
+                        help="write the active findings to this baseline "
+                             "file and exit 0 (tool-adoption escape hatch)")
+    parser.add_argument("--no-audit", action="store_true",
+                        help="skip the jaxpr audit layer (AST lint only)")
+    parser.add_argument("--audit-only", action="store_true",
+                        help="skip the AST lint (jaxpr audit only)")
+    parser.add_argument("--output", type=Path, default=None,
+                        help="also write the JSON report here (CI artifact)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from fedml_tpu.analysis.rules import rule_table
+        for row in rule_table():
+            print(f"{row['id']}  {row['title']}\n       fix: {row['hint']}")
+        return 0
+
+    root = _repo_root()
+    paths = args.paths or [p for p in (root / "fedml_tpu", root / "tests")
+                           if p.exists()]
+    if args.baseline is None and not args.no_baseline:
+        default_bl = root / "ci" / "analysis_baseline.json"
+        if default_bl.exists():
+            args.baseline = default_bl
+    elif args.no_baseline:
+        args.baseline = None
+
+    findings = []
+    if not args.audit_only:
+        findings.extend(lint_paths(paths, root=root))
+
+    audit_reports: List[dict] = []
+    if not args.no_audit:
+        # honor $JAX_PLATFORMS against environments whose sitecustomize
+        # sets the platform programmatically (same belt-and-braces as
+        # tests/conftest.py) — audit builders execute model init, and an
+        # accidental tunnel-TPU dispatch turns 14 s of CI into minutes
+        import os
+        if os.environ.get("JAX_PLATFORMS"):
+            import jax
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        from fedml_tpu.analysis.jaxpr_audit import run_audit
+        audit_findings, audit_reports = run_audit()
+        findings.extend(audit_findings)
+
+    stale: List[dict] = []
+    suppressed = []
+    entries: List[dict] = []
+    if args.baseline is not None:
+        entries = load_baseline(args.baseline)
+        findings, suppressed, stale = apply_baseline(findings, entries)
+
+    if args.write_baseline is not None:
+        # active AND currently-suppressed findings: refreshing an
+        # existing baseline must not drop the entries that are still
+        # live in the tree (they were filtered out of `findings` above)
+        adopted = sorted(findings + suppressed,
+                         key=lambda f: (f.path, f.line, f.rule))
+        save_baseline(args.write_baseline, adopted, note="adopted",
+                      notes_by_fingerprint={e["fingerprint"]: e.get("note", "")
+                                            for e in entries})
+        print(f"wrote {len(adopted)} entries to {args.write_baseline}")
+        return 0
+
+    report = {
+        "findings": [f.to_json() for f in findings],
+        "suppressed": [f.to_json() for f in suppressed],
+        "stale_baseline": stale,
+        "audit": audit_reports,
+        "counts": {"active": len(findings), "suppressed": len(suppressed),
+                   "stale_baseline": len(stale)},
+    }
+    if args.output is not None:
+        args.output.parent.mkdir(parents=True, exist_ok=True)
+        args.output.write_text(json.dumps(report, indent=2) + "\n")
+
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for f in findings:
+            print(f.format_text())
+        for e in stale:
+            print(f"WARNING: stale baseline entry {e['rule']} "
+                  f"{e.get('path', '?')} ({e['fingerprint']}) matches "
+                  "nothing — the code was fixed; remove the entry")
+        for rep in audit_reports:
+            print(f"audit: {rep['entry']}: {rep['n_lowering_keys']} "
+                  f"lowering key(s) over {rep['sweep_len']}-point sweep, "
+                  f"{rep['n_eqns']} top-level eqns")
+        n = len(findings)
+        print(f"{n} active finding(s), {len(suppressed)} baselined, "
+              f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:  # pragma: no cover
+        sys.exit(130)
+    except Exception:  # the documented "internal error" exit: a crash
+        import traceback  # (malformed baseline, unreadable output dir)
+        traceback.print_exc()  # must be distinguishable from "findings"
+        sys.exit(2)

@@ -1,0 +1,61 @@
+"""Checked-in finding baseline (``ci/analysis_baseline.json``).
+
+The workflow ruff/detect-secrets users know: a finding the team decides
+to live with is recorded by fingerprint (rule + path + normalized
+snippet — NOT line number, see finding.py) with a human note. The CLI
+then exits zero as long as every current finding is either fixed or
+baselined, and WARNS when a baseline entry no longer matches anything
+(the flagged code was fixed or deleted — remove the stale entry so it
+cannot mask a future regression at the same spot).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+
+VERSION = 1
+
+
+def load_baseline(path: Path) -> List[Dict]:
+    data = json.loads(Path(path).read_text())
+    if data.get("version") != VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r} "
+            f"(this tool writes version {VERSION})")
+    entries = data.get("entries", [])
+    for e in entries:
+        if "fingerprint" not in e or "rule" not in e:
+            raise ValueError(f"baseline {path}: malformed entry {e!r}")
+    return entries
+
+
+def save_baseline(path: Path, findings: Sequence[Finding],
+                  note: str = "",
+                  notes_by_fingerprint: Dict[str, str] = None) -> None:
+    """Write every finding's fingerprint as a baseline entry (the
+    ``--write-baseline`` escape hatch for adopting the tool on a tree
+    with known, accepted findings). ``notes_by_fingerprint`` carries
+    prior entries' handwritten notes through a refresh."""
+    notes = notes_by_fingerprint or {}
+    entries = [{"rule": f.rule, "path": f.path,
+                "fingerprint": f.fingerprint,
+                "snippet": f.snippet,
+                "note": notes.get(f.fingerprint) or note}
+               for f in findings]
+    Path(path).write_text(json.dumps(
+        {"version": VERSION, "entries": entries}, indent=2) + "\n")
+
+
+def apply_baseline(findings: Sequence[Finding], entries: Sequence[Dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[Dict]]:
+    """-> (active, suppressed, stale_entries)."""
+    by_fp = {e["fingerprint"] for e in entries}
+    active = [f for f in findings if f.fingerprint not in by_fp]
+    suppressed = [f for f in findings if f.fingerprint in by_fp]
+    seen = {f.fingerprint for f in suppressed}
+    stale = [e for e in entries if e["fingerprint"] not in seen]
+    return active, suppressed, stale

@@ -1,0 +1,58 @@
+"""Finding: the one record type both analysis layers emit.
+
+A finding is identified across runs by its *fingerprint* — a hash of
+(rule, path, normalized source snippet), deliberately NOT the line
+number, so a baseline entry survives unrelated edits above it and goes
+stale only when the flagged code itself changes or disappears (the same
+scheme detect-secrets and ruff's --add-noqa baselines use).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          #: rule id, e.g. "FT001" (lint) / "FT104" (audit)
+    path: str          #: repo-relative posix path, or "<entry:NAME>" for audit
+    line: int          #: 1-based line, 0 for audit findings
+    message: str       #: what is wrong, concretely
+    hint: str = ""     #: how to fix it
+    snippet: str = ""  #: the offending source line, stripped
+
+    @property
+    def fingerprint(self) -> str:
+        payload = "|".join((self.rule, self.path, " ".join(self.snippet.split())))
+        return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint,
+        }
+
+    def format_text(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.snippet:
+            out += f"\n    | {self.snippet}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+
+def audit_finding(rule: str, entry: str, message: str,
+                  hint: str = "", detail: Optional[str] = None) -> Finding:
+    """Finding for a jaxpr-audit check: anchored to a registered entry
+    point instead of a source line (``detail`` lands in the snippet slot
+    so it participates in the fingerprint)."""
+    return Finding(rule=rule, path=f"<entry:{entry}>", line=0,
+                   message=message, hint=hint, snippet=detail or "")

@@ -1,0 +1,222 @@
+"""Layer 2 — jaxpr audit of registered hot entry points.
+
+Where the AST lint sees spelling, this layer sees the program XLA will
+actually receive: each registered entry point (analysis/registry.py) is
+traced with ``jax.make_jaxpr`` over its declared argument sweep and the
+closed jaxpr is walked recursively (through pjit / scan / while /
+custom-vjp sub-jaxprs) for the hazard classes the project has been
+bitten by:
+
+- **FT101** — a float64 aval anywhere under x64-off intent: under
+  x64-off jax truncates it silently (an intent bug wearing f32
+  clothes); under x64-on it is a 2x bandwidth tax.
+- **FT102** — ``pure_callback`` / ``io_callback`` / ``debug_callback``
+  inside a ``scan``/``while`` body: a host round-trip per iteration,
+  i.e. a fused R-round scan degenerates to R host syncs.
+- **FT103** — ``convert_element_type`` float upcasts inside a
+  grad-declared program (accidental mixed-precision promotion on the
+  backward path; checked more strictly than forward-only entries,
+  which only flag upcasts landing in f64).
+- **FT104** — distinct lowering keys across the declared sweep: the
+  r5 bench artifact class. The key is the tuple of input avals
+  (shape, dtype, weak_type) — exactly what jit caches on — so a weak
+  vs strong scalar, a flipped dtype, or a shape drift between rounds
+  shows up as key count > ``max_lowerings`` and fails CI instead of a
+  bench window.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from fedml_tpu.analysis.finding import Finding, audit_finding
+from fedml_tpu.analysis.registry import AuditSpec, load_entry_points
+
+try:  # jax >= 0.4.x exposes the stable aliases under jax.extend
+    from jax.extend import core as _jcore
+except ImportError:  # pragma: no cover - very old jax
+    from jax import core as _jcore  # type: ignore
+
+LOOP_PRIMITIVES = frozenset({"scan", "while"})
+CALLBACK_PRIMITIVES = frozenset(
+    {"pure_callback", "io_callback", "debug_callback"})
+
+
+def _sub_jaxprs(eqn) -> List[Any]:
+    """Every Jaxpr/ClosedJaxpr nested in an eqn's params (pjit's
+    ``jaxpr``, scan's ``jaxpr``, while's ``cond_jaxpr``/``body_jaxpr``,
+    custom-vjp's ``fun_jaxpr``, branches tuples, ...)."""
+    out: List[Any] = []
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            if isinstance(v, (_jcore.Jaxpr, _jcore.ClosedJaxpr)):
+                out.append(v)
+    return out
+
+
+def _as_jaxpr(j):
+    return j.jaxpr if isinstance(j, _jcore.ClosedJaxpr) else j
+
+
+def _walk(jaxpr, in_loop: bool, visit) -> None:
+    """DFS over eqns; ``visit(eqn, in_loop)``; loop flag set below
+    scan/while."""
+    for eqn in _as_jaxpr(jaxpr).eqns:
+        visit(eqn, in_loop)
+        child_in_loop = in_loop or eqn.primitive.name in LOOP_PRIMITIVES
+        for sub in _sub_jaxprs(eqn):
+            _walk(sub, child_in_loop, visit)
+
+
+def _aval_key(aval) -> Tuple:
+    return (str(getattr(aval, "shape", None)),
+            str(getattr(aval, "dtype", None)),
+            bool(getattr(aval, "weak_type", False)))
+
+
+def signature_key(closed) -> Tuple:
+    """The lowering key of a traced call: input avals incl. weak_type —
+    the same equivalence jit's compile cache uses."""
+    return tuple(_aval_key(v.aval) for v in _as_jaxpr(closed).invars)
+
+
+def _is_f64(aval) -> bool:
+    return str(getattr(aval, "dtype", "")) == "float64"
+
+
+def _float_width(dtype) -> Optional[int]:
+    s = str(dtype)
+    if s in ("float16", "bfloat16"):
+        return 16
+    if s == "float32":
+        return 32
+    if s == "float64":
+        return 64
+    return None
+
+
+def audit_spec(name: str, spec: AuditSpec) -> Tuple[List[Finding], Dict]:
+    """Trace + walk one entry point. Returns (findings, report) where
+    report carries the evidence CI artifacts and tests assert on:
+    ``n_lowering_keys``, ``n_eqns``, ``sweep_len``."""
+    findings: List[Finding] = []
+    keys = []
+    jaxprs = []
+    for args in spec.sweep:
+        closed = jax.make_jaxpr(spec.fn)(*args)
+        jaxprs.append(closed)
+        keys.append(signature_key(closed))
+    distinct = sorted(set(keys), key=keys.index)
+    if len(distinct) > spec.max_lowerings:
+        findings.append(audit_finding(
+            "FT104", name,
+            f"{len(distinct)} distinct lowering keys across the declared "
+            f"{len(spec.sweep)}-point sweep (contract: "
+            f"<= {spec.max_lowerings}) — each extra key is a recompile "
+            "landing at an uncontrolled moment",
+            hint="align the callers' arg dtypes/weak-types (jnp-typed "
+                 "scalars) or mark program-variant args static",
+            detail="; ".join(repr(k) for k in distinct[:4])))
+
+    f64_seen: List[str] = []
+    callback_in_loop: List[str] = []
+    upcasts: List[str] = []
+
+    def visit(eqn, in_loop: bool) -> None:
+        prim = eqn.primitive.name
+        if prim in CALLBACK_PRIMITIVES and in_loop:
+            callback_in_loop.append(prim)
+        if not spec.allow_f64:
+            for v in eqn.outvars:
+                if _is_f64(getattr(v, "aval", None)):
+                    f64_seen.append(prim)
+                    break
+        if prim == "convert_element_type":
+            old = _float_width(getattr(eqn.invars[0].aval, "dtype", None))
+            new = _float_width(eqn.params.get("new_dtype"))
+            if old and new and new > old and (spec.grad_path or new == 64):
+                upcasts.append(
+                    f"{eqn.invars[0].aval.dtype}->{eqn.params['new_dtype']}")
+
+    # hazard-walk ONE representative jaxpr per distinct lowering key —
+    # with max_lowerings > 1 a hazard may live only in the program a
+    # later sweep point traces (different branch/shape), and walking
+    # only jaxprs[0] would report the entry clean
+    walked_keys = set()
+    for key, closed in zip(keys, jaxprs):
+        if key in walked_keys:
+            continue
+        walked_keys.add(key)
+        _walk(closed, False, visit)
+        if not spec.allow_f64:
+            for v in _as_jaxpr(closed).invars + _as_jaxpr(closed).outvars:
+                if _is_f64(getattr(v, "aval", None)):
+                    f64_seen.append("(entry boundary)")
+                    break
+    closed = jaxprs[0]  # report shape metadata from the first trace
+
+    if f64_seen:
+        findings.append(audit_finding(
+            "FT101", name,
+            f"float64 result(s) in the traced program (first at: "
+            f"{f64_seen[0]}) under x64-off intent — silently truncated "
+            "today, a 2x bandwidth tax the day x64 is enabled",
+            hint="pin the literal/dtype to f32, or set allow_f64=True on "
+                 "the AuditSpec if this entry means it",
+            detail=",".join(f64_seen[:6])))
+    if callback_in_loop:
+        findings.append(audit_finding(
+            "FT102", name,
+            f"host callback ({callback_in_loop[0]}) inside a scan/while "
+            "body — one host round-trip per iteration defeats the fused "
+            "round scan",
+            hint="hoist the callback out of the loop body, or debug with "
+                 "jax.debug.print only in non-fused paths",
+            detail=",".join(sorted(set(callback_in_loop)))))
+    if upcasts:
+        findings.append(audit_finding(
+            "FT103", name,
+            f"float upcast(s) on the traced path of a grad-declared "
+            f"entry: {', '.join(sorted(set(upcasts))[:4])}",
+            hint="make the accumulation dtype explicit at the cast site "
+                 "(preferred) or declare the entry forward-only",
+            detail=",".join(sorted(set(upcasts)))))
+
+    report = {"entry": name, "sweep_len": len(spec.sweep),
+              "n_lowering_keys": len(distinct),
+              "max_lowerings": spec.max_lowerings,
+              "n_eqns": len(_as_jaxpr(closed).eqns),
+              "grad_path": spec.grad_path}
+    return findings, report
+
+
+def run_audit(only: Optional[Sequence[str]] = None
+              ) -> Tuple[List[Finding], List[Dict]]:
+    """Build + audit every registered entry point (or the ``only``
+    subset). A builder/trace crash is a loud FT100 finding, never a
+    silently shorter audit."""
+    entries = load_entry_points()
+    findings: List[Finding] = []
+    reports: List[Dict] = []
+    for name in sorted(entries):
+        if only and name not in only:
+            continue
+        try:
+            spec = entries[name]()
+            got, report = audit_spec(name, spec)
+        except Exception as exc:
+            logging.exception("jaxpr audit: entry %s failed", name)
+            findings.append(audit_finding(
+                "FT100", name,
+                f"entry point failed to build/trace: {type(exc).__name__}: "
+                f"{exc}",
+                hint="an auditable entry must stay traceable on the CPU CI "
+                     "backend; fix the builder or the program"))
+            continue
+        findings.extend(got)
+        reports.append(report)
+    return findings, reports

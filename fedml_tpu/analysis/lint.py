@@ -1,0 +1,313 @@
+"""Layer 1 — the AST lint engine.
+
+One parse per file, shared by every rule through a :class:`FileContext`
+that pre-computes what the project rules keep asking for: suppression
+pragmas, ``with``-block spans whose context expression names the global
+RNG lock, nested-``def`` spans (jit-traced closures in hot modules), and
+the module's jitted-callable bindings (FT002/FT004).
+
+Scoping: rules declare where they apply via :meth:`Rule.applies`.
+Driver code under ``tests/`` is exempt from the concurrency rules
+(single-threaded by construction) — EXCEPT ``analysis_corpus``
+directories, which hold seeded violations and are always linted as
+library code. The directory walker skips corpus dirs, so they are only
+linted when named explicitly (the analyzer's own tests do exactly that).
+
+Pragma syntax (suppresses on its own line or the line above)::
+
+    np.random.seed(s)  # ft: allow[FT001] — build-time, pre-thread
+    # ft: allow[FT005,FT003] rationale text
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+
+PRAGMA_RE = re.compile(r"#\s*ft:\s*allow\[([A-Z0-9,\s]+)\]")
+
+#: recognized spellings of the core.sampling global-RNG mutual exclusion
+#: (FT001 treats draws lexically inside these ``with`` blocks as safe)
+RNG_LOCK_NAMES = ("locked_global_numpy_rng", "_GLOBAL_RNG_LOCK",
+                  "global_rng_lock")
+
+#: directories never entered by the recursive walker
+SKIP_DIRS = {"__pycache__", ".git", "analysis_corpus", "node_modules",
+             ".pytest_cache", "build", "dist"}
+
+
+def _parts(relpath: str) -> Tuple[str, ...]:
+    return tuple(Path(relpath).parts)
+
+
+def is_corpus_path(relpath: str) -> bool:
+    return "analysis_corpus" in _parts(relpath)
+
+
+def is_test_path(relpath: str) -> bool:
+    if is_corpus_path(relpath):
+        return False  # seeded-violation corpora are linted as library code
+    parts = _parts(relpath)
+    return "tests" in parts or (parts and parts[-1].startswith("test_"))
+
+
+class JitBinding:
+    """A name (or self-attribute) bound to a ``jax.jit(...)`` result in
+    this module, with its donation/static metadata — the shared substrate
+    of FT002 (donated-buffer reuse) and FT004 (scalar-arg signatures)."""
+
+    def __init__(self, name: str, lineno: int,
+                 donate: Set[int], static_nums: Set[int],
+                 static_names: Set[str]):
+        self.name = name
+        self.lineno = lineno
+        self.donate = donate
+        self.static_nums = static_nums
+        self.static_names = static_names
+
+
+def _int_set(node: Optional[ast.expr]) -> Set[int]:
+    """Literal ints out of ``(0, 1)`` / ``0`` argnums values; non-literal
+    expressions yield an empty set (we cannot resolve them — rules then
+    stay quiet rather than guess)."""
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+        return out
+    return set()
+
+
+def _str_set(node: Optional[ast.expr]) -> Set[str]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+def dotted_name(node: ast.expr) -> Optional[str]:
+    """``self._round_fn`` / ``np.random.seed`` as a dotted string, or None
+    for anything not a pure attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _jit_call_meta(call: ast.Call) -> Optional[Tuple[Set[int], Set[int], Set[str]]]:
+    """(donate, static_nums, static_names) if ``call`` constructs a jitted
+    callable: ``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    name = dotted_name(call.func)
+    kw_src: Optional[ast.Call] = None
+    if name in ("jax.jit", "jit"):
+        kw_src = call
+    elif name in ("functools.partial", "partial") and call.args:
+        first = dotted_name(call.args[0])
+        if first in ("jax.jit", "jit"):
+            kw_src = call
+    if kw_src is None:
+        return None
+    donate: Set[int] = set()
+    static_nums: Set[int] = set()
+    static_names: Set[str] = set()
+    for kw in kw_src.keywords:
+        if kw.arg == "donate_argnums":
+            val = kw.value
+            # the tree's `(0,) if donate else ()` idiom: take the
+            # donating branch — flagging a maybe-donated reuse is the
+            # conservative direction for FT002
+            if isinstance(val, ast.IfExp):
+                val = val.body
+            donate |= _int_set(val)
+        elif kw.arg == "static_argnums":
+            static_nums |= _int_set(kw.value)
+        elif kw.arg == "static_argnames":
+            static_names |= _str_set(kw.value)
+    return donate, static_nums, static_names
+
+
+class FileContext:
+    """Everything the rules need about one file, computed once."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self.pragmas = self._collect_pragmas()
+        self.lock_spans = self._collect_lock_spans()
+        self.nested_def_spans = self._collect_nested_def_spans()
+        self.jit_bindings = self._collect_jit_bindings()
+
+    # -- pragmas ----------------------------------------------------------
+    def _collect_pragmas(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        return out
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """Pragma on the finding's line or the line directly above it."""
+        for ln in (line, line - 1):
+            if rule in self.pragmas.get(ln, ()):
+                return True
+        return False
+
+    # -- structure helpers ------------------------------------------------
+    def _collect_lock_spans(self) -> List[Tuple[int, int]]:
+        spans = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    src = ast.dump(item.context_expr)
+                    if any(name in src for name in RNG_LOCK_NAMES):
+                        spans.append((node.lineno, node.end_lineno or node.lineno))
+                        break
+        return spans
+
+    def under_rng_lock(self, line: int) -> bool:
+        return any(a <= line <= b for a, b in self.lock_spans)
+
+    def _collect_nested_def_spans(self) -> List[Tuple[int, int]]:
+        """Spans of defs nested inside another def (closures handed to
+        jit/vmap/scan in this codebase's idiom) — class methods are NOT
+        nested defs."""
+        spans: List[Tuple[int, int]] = []
+
+        def visit(node: ast.AST, in_func: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    if in_func:
+                        spans.append((child.lineno,
+                                      child.end_lineno or child.lineno))
+                    visit(child, True)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, in_func)
+                else:
+                    visit(child, in_func)
+
+        visit(self.tree, False)
+        return spans
+
+    def in_nested_def(self, line: int) -> bool:
+        return any(a <= line <= b for a, b in self.nested_def_spans)
+
+    def _collect_jit_bindings(self) -> Dict[str, JitBinding]:
+        """name/attr -> JitBinding for every ``x = jax.jit(...)`` /
+        ``self.y = jax.jit(...)`` assignment and every def decorated with
+        ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``."""
+        out: Dict[str, JitBinding] = {}
+
+        def record(name: Optional[str], lineno: int, meta) -> None:
+            if name and meta is not None:
+                out[name] = JitBinding(name, lineno, *meta)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                meta = _jit_call_meta(node.value)
+                for tgt in node.targets:
+                    record(dotted_name(tgt), node.lineno, meta)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        meta = _jit_call_meta(dec)
+                        record(node.name, node.lineno, meta)
+                    elif dotted_name(dec) in ("jax.jit", "jit"):
+                        record(node.name, node.lineno, (set(), set(), set()))
+        return out
+
+    # -- finding constructor ---------------------------------------------
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        return Finding(rule=rule.id, path=self.relpath, line=line,
+                       message=message, hint=rule.hint, snippet=snippet)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``title``/``hint``, implement
+    ``check``, and may narrow ``applies`` (path scoping)."""
+
+    id: str = "FT000"
+    title: str = ""
+    hint: str = ""
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def iter_python_files(paths: Sequence[Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if not any(part in SKIP_DIRS for part in sub.parts):
+                    yield sub
+
+
+def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
+               rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
+    """Run every rule over every python file under ``paths``.
+
+    ``root`` anchors the repo-relative paths findings carry (defaults to
+    the common parent, so fingerprints are stable no matter where the
+    CLI is invoked from). Unparseable files produce an FT000 finding
+    instead of crashing the run.
+    """
+    from fedml_tpu.analysis.rules import all_rules
+    rules = list(rules) if rules is not None else all_rules()
+    root = Path(root).resolve() if root else None
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        resolved = path.resolve()
+        if root is not None:
+            try:
+                rel = resolved.relative_to(root).as_posix()
+            except ValueError:
+                rel = resolved.as_posix()
+        else:
+            rel = path.as_posix()
+        try:
+            ctx = FileContext(path, rel, path.read_text())
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            findings.append(Finding(
+                rule="FT000", path=rel,
+                line=getattr(exc, "lineno", 0) or 0,
+                message=f"unparseable: {type(exc).__name__}: {exc}",
+                hint="fix the syntax error; the linter cannot see this file"))
+            continue
+        for rule in rules:
+            if not rule.applies(ctx.relpath):
+                continue
+            for f in rule.check(ctx):
+                if not ctx.allowed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,78 @@
+"""Hot-entry-point registry for the jaxpr audit (layer 2).
+
+Each algorithm/parallel driver registers its hot compiled programs here
+via the :func:`hot_entry_point` decorator. Registration is a dict
+insert; the decorated BUILDER runs only when the auditor asks, so
+drivers pay nothing at import time. A builder returns an
+:class:`AuditSpec`: the callable to trace, a *sweep* of argument tuples
+that must all lower to the same signature, and the audit intents
+(grad-path, f64 tolerance, expected lowering-key count).
+
+This module must stay import-light (stdlib only): driver modules import
+it at module scope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class AuditSpec:
+    """What the auditor traces for one entry point.
+
+    ``sweep``: argument tuples that are all legitimate calls of ``fn``
+    and — per the driver's own signature-stability contract — must
+    produce at most ``max_lowerings`` distinct lowering keys (FT104).
+    ``grad_path``: the program contains a grad; float upcasts inside it
+    are flagged (FT103). ``allow_f64``: set only by entries that mean
+    to compute in f64 (none today) — otherwise any f64 aval is FT101.
+    """
+
+    fn: Callable
+    sweep: Sequence[Tuple[Any, ...]]
+    max_lowerings: int = 1
+    grad_path: bool = False
+    allow_f64: bool = False
+
+
+#: name -> builder() -> AuditSpec
+_REGISTRY: Dict[str, Callable[[], AuditSpec]] = {}
+
+#: modules whose import registers the shipped entry points — the audit
+#: imports these lazily; a module that cannot import on this backend
+#: surfaces as a loud audit error, not a silently shorter registry
+ENTRY_POINT_MODULES = (
+    "fedml_tpu.algorithms.fedavg",
+    "fedml_tpu.algorithms.fedopt",
+    "fedml_tpu.parallel.spmd",
+    "fedml_tpu.ops.flash_attention",
+)
+
+
+def hot_entry_point(name: str) -> Callable:
+    """Decorator: register ``builder`` under ``name``. Re-registration
+    under the same name replaces (idempotent under module reload)."""
+
+    def deco(builder: Callable[[], AuditSpec]):
+        _REGISTRY[name] = builder
+        return builder
+
+    return deco
+
+
+def load_entry_points(extra_modules: Sequence[str] = ()) -> Dict[str, Callable]:
+    """Import the registering modules and return the registry snapshot."""
+    for mod in tuple(ENTRY_POINT_MODULES) + tuple(extra_modules):
+        importlib.import_module(mod)
+    return dict(_REGISTRY)
+
+
+def get(name: str) -> Optional[Callable[[], AuditSpec]]:
+    return _REGISTRY.get(name)
+
+
+def names() -> List[str]:
+    return sorted(_REGISTRY)

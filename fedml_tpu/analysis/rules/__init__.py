@@ -1,0 +1,31 @@
+"""Rule registry: one module per project-specific rule.
+
+Each rule carries an id (FT001..FT006), a docstring explaining the
+hazard in THIS codebase's terms, and a fix hint. ``all_rules()`` is the
+canonical ordered instantiation the engine and the CLI share.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from fedml_tpu.analysis.lint import Rule
+from fedml_tpu.analysis.rules.broad_except import BroadExceptRule
+from fedml_tpu.analysis.rules.donation import DonatedReuseRule
+from fedml_tpu.analysis.rules.float64 import Float64Rule
+from fedml_tpu.analysis.rules.host_sync import HostSyncRule
+from fedml_tpu.analysis.rules.jit_static import JitScalarArgRule
+from fedml_tpu.analysis.rules.rng import GlobalRngRule
+
+_RULES = (GlobalRngRule, DonatedReuseRule, HostSyncRule,
+          JitScalarArgRule, BroadExceptRule, Float64Rule)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULES]
+
+
+def rule_table() -> List[dict]:
+    """id/title/hint rows for --list-rules and the README table."""
+    return [{"id": cls.id, "title": cls.title, "hint": cls.hint}
+            for cls in _RULES]

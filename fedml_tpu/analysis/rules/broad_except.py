@@ -1,0 +1,86 @@
+"""FT005 — broad ``except`` that swallows errors.
+
+A ``except Exception: pass`` in a thread target is how the PR 2 RNG
+race stayed invisible: the prefetch worker's failure surfaced rounds
+later as a corrupt cohort instead of a stack trace. The federation's
+actor threads (silo clients, the prefetch worker, the watchdog) must
+either re-raise or leave a traceback in the log.
+
+A broad handler (``except Exception`` / ``except BaseException`` /
+bare ``except``) is compliant when it demonstrably propagates the
+error, i.e. its body contains any of:
+
+- a ``raise`` (re-raise or raise-from);
+- ``logging.exception`` / ``logger.exception(...)`` or
+  ``traceback.print_exc()`` / ``print_exception(...)``;
+- any call carrying ``exc_info=...``;
+- a *use* of the bound exception name (``except ... as exc`` where
+  ``exc`` is read — stored for a later re-raise, recorded, or included
+  in a log message).
+
+Anything else needs an explicit ``# ft: allow[FT005]`` pragma with its
+rationale (e.g. best-effort ``__del__`` shutdown paths).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, Rule, dotted_name
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        name = dotted_name(t) or ""
+        return name.split(".")[-1] in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            (dotted_name(e) or "").split(".")[-1] in BROAD for e in t.elts)
+    return False
+
+
+def _propagates(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name.endswith((".exception", ".print_exc",
+                              ".print_exception")):
+                return True
+            if any(kw.arg == "exc_info" for kw in node.keywords):
+                return True
+        if bound and isinstance(node, ast.Name) and node.id == bound \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+class BroadExceptRule(Rule):
+    id = "FT005"
+    title = "broad except that swallows the error"
+    hint = ("narrow the exception type, re-raise, log with exc_info=True, "
+            "or pragma the intentional best-effort site: "
+            "# ft: allow[FT005] <why>")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _propagates(node):
+                continue
+            what = ("bare except" if node.type is None else
+                    f"except {ast.unparse(node.type)}")
+            yield ctx.finding(
+                self, node,
+                f"{what} neither re-raises, logs exc_info, nor uses the "
+                "bound exception — in a thread/worker target the failure "
+                "vanishes")

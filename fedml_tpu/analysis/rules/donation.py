@@ -1,0 +1,140 @@
+"""FT002 — reuse of a buffer after passing it to a donating jit.
+
+Every fused driver here donates its dead global-model buffer
+(``jax.jit(round_fn, donate_argnums=(0,))``) so XLA reuses the HBM for
+the new model instead of holding both live. Donation makes the argument
+buffer INVALID after the call: reading it again raises on TPU
+(``Invalid buffer passed``) or, worse on some backends, silently reads
+reused memory. The sanctioned pattern is the same-statement overwrite::
+
+    self.variables, stats = self._round_fn(self.variables, ...)
+
+The rule tracks, per module, names bound to ``jax.jit(...,
+donate_argnums=...)`` (including ``self.attr`` bindings), then walks
+each function linearly: an argument passed at a donated position that is
+*read again* before being *reassigned* is flagged. Assignment targets of
+the calling statement count as reassigned (the pattern above is safe).
+
+Known limits (by design, to stay quiet rather than guess): donation
+metadata is not propagated across function returns (``make_spmd_round``
+callers), ``*args`` splats hide positions, and control flow is
+approximated by statement order.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, Rule, dotted_name
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Dotted names stored by this statement (assign/augassign/for/with)."""
+    out: Set[str] = set()
+
+    def add_target(tgt: ast.expr) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                add_target(e)
+        else:
+            name = dotted_name(tgt)
+            if name:
+                out.add(name)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            add_target(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        add_target(stmt.target)
+    elif isinstance(stmt, ast.For):
+        add_target(stmt.target)
+    elif isinstance(stmt, ast.With):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                add_target(item.optional_vars)
+    return out
+
+
+def _loads_in(node: ast.AST, name: str) -> Optional[ast.AST]:
+    """First Load of dotted ``name`` inside ``node``, or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(sub, "ctx", None), ast.Load):
+            if dotted_name(sub) == name:
+                return sub
+    return None
+
+
+def _flat_statements(body: List[ast.stmt]) -> List[ast.stmt]:
+    """Statements in source order, flattened through compound statements
+    (linear over-approximation of control flow)."""
+    out: List[ast.stmt] = []
+    for stmt in body:
+        out.append(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            out.extend(_flat_statements(getattr(stmt, field, []) or []))
+        for handler in getattr(stmt, "handlers", []) or []:
+            out.extend(_flat_statements(handler.body))
+    return out
+
+
+class DonatedReuseRule(Rule):
+    id = "FT002"
+    title = "variable reused after donation to a jit(donate_argnums=...) call"
+    hint = ("rebind the result over the donated input in the same statement "
+            "(x = f(x, ...)), or drop donate_argnums for buffers that must "
+            "stay live")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        donors = {name: b for name, b in ctx.jit_bindings.items() if b.donate}
+        if not donors:
+            return
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_function(ctx, func, donors)
+
+    def _donated_args(self, call: ast.Call, donate: Set[int]) -> List[str]:
+        names: List[str] = []
+        for pos, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break  # positions past a splat are unresolvable
+            if pos in donate:
+                name = dotted_name(arg)
+                if name:
+                    names.append(name)
+        return names
+
+    def _check_function(self, ctx: FileContext, func, donors
+                        ) -> Iterator[Finding]:
+        stmts = [s for s in _flat_statements(func.body)
+                 if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.ClassDef))]
+        # (donated name, call lineno, statement index) worklist
+        pending: List[Tuple[str, int, int]] = []
+        for i, stmt in enumerate(stmts):
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    callee = dotted_name(node.func)
+                    if callee in donors:
+                        for name in self._donated_args(node,
+                                                       donors[callee].donate):
+                            pending.append((name, node.lineno, i))
+        for name, call_line, start in pending:
+            # the calling statement's own targets re-bind the name
+            if name in _assigned_names(stmts[start]):
+                continue
+            for stmt in stmts[start + 1:]:
+                load = _loads_in(stmt, name)
+                stores = _assigned_names(stmt)
+                if load is not None and name not in stores:
+                    yield ctx.finding(
+                        self, load,
+                        f"`{name}` was donated to a jit call at line "
+                        f"{call_line} (donate_argnums) and is read again — "
+                        "the buffer is invalid after donation")
+                    break
+                if name in stores:
+                    break

@@ -1,0 +1,67 @@
+"""FT006 — float64 literals/dtypes outside the modules that mean it.
+
+The device stack runs x64-off: a ``float64`` dtype reaching jnp is
+silently truncated to f32 (masking an intent bug), and with x64
+enabled it doubles HBM traffic and halves MXU throughput. A handful of
+host-side modules use f64 deliberately and are allowlisted:
+
+- ``contribution/shap.py`` — KernelSHAP's least-squares solve;
+- ``core/mpc.py`` — the fixed-point secret-share codec;
+- ``algorithms/turboaggregate.py`` — secure-sum fixed-point staging;
+- ``comm/grpc_proto.py`` — the reference wire format's f64->f32 rule;
+- ``data/`` — host-side dataset generation/statistics (never shipped
+  to the device; loaders cast to f32 at pack time);
+- ``analysis/`` — this subsystem's own detector pattern strings.
+
+New intentional sites: extend the allowlist (with a rationale in this
+docstring) for a whole module, or pragma a single line, or baseline it
+with a note — in that order of preference.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_test_path)
+
+ALLOWED_FILES = (
+    "fedml_tpu/contribution/shap.py",
+    "fedml_tpu/core/mpc.py",
+    "fedml_tpu/algorithms/turboaggregate.py",
+    "fedml_tpu/comm/grpc_proto.py",
+)
+ALLOWED_DIRS = ("fedml_tpu/data/", "fedml_tpu/analysis/")
+
+
+class Float64Rule(Rule):
+    id = "FT006"
+    title = "float64 dtype outside the intentional-f64 modules"
+    hint = ("use f32 (the device dtype) or jnp.asarray(..., jnp.float32); "
+            "if the f64 is intentional host math, allowlist the module in "
+            "analysis/rules/float64.py or pragma the line")
+
+    def applies(self, relpath: str) -> bool:
+        if is_test_path(relpath):
+            return False  # tests compute f64 references on purpose
+        if relpath.endswith(ALLOWED_FILES):
+            return False
+        return not any(d in relpath for d in ALLOWED_DIRS)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                base = dotted_name(node.value)
+                if base in ("np", "numpy", "jnp", "jax.numpy"):
+                    yield ctx.finding(
+                        self, node,
+                        f"{base}.float64 in a module outside the "
+                        "intentional-f64 allowlist (x64-off truncates it "
+                        "silently; x64-on doubles bandwidth)")
+            elif isinstance(node, ast.Constant) and node.value == "float64":
+                yield ctx.finding(
+                    self, node,
+                    "'float64' dtype string outside the intentional-f64 "
+                    "allowlist")

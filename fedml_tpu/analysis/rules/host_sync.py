@@ -1,0 +1,79 @@
+"""FT003 — host-sync primitives in hot-path modules.
+
+The whole point of the fused drivers (``FusedRounds``, the mesh block
+scans) is that the host enqueues R rounds and syncs ONCE at the eval
+boundary. A stray ``.item()`` / ``jax.device_get`` /
+``jax.block_until_ready`` in those modules re-serializes host and
+device every round — the r04 femnist flagship's "571 s/eval" was
+exactly a sync landing inside the wrong phase. ``np.asarray`` is
+flagged only inside nested defs (the closures handed to jit/vmap/scan,
+where it would silently call back to the host on a tracer); top-level
+host packing code uses numpy legitimately.
+
+Intentional syncs — the ``device_wait`` timer phases at eval
+boundaries — carry ``# ft: allow[FT003]`` pragmas with their rationale.
+
+Scope: the hot modules only (``parallel/`` compiled drivers +
+``algorithms/fedavg.py``, which hosts ``FusedRounds``). Host-side
+coordination modules (``parallel/prefetch.py``, ``parallel/multihost.py``)
+are excluded: they ARE the host side.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_corpus_path)
+
+HOT_PATH_FILES = (
+    "fedml_tpu/parallel/spmd.py",
+    "fedml_tpu/parallel/gspmd_round.py",
+    "fedml_tpu/parallel/fsdp.py",
+    "fedml_tpu/parallel/tensor.py",
+    "fedml_tpu/parallel/sequence.py",
+    "fedml_tpu/parallel/pipeline.py",
+    "fedml_tpu/parallel/expert.py",
+    "fedml_tpu/algorithms/fedavg.py",
+)
+
+SYNC_CALLS = {"jax.device_get": "device_get",
+              "jax.block_until_ready": "block_until_ready"}
+
+
+class HostSyncRule(Rule):
+    id = "FT003"
+    title = "host-device sync primitive in a hot-path module"
+    hint = ("keep hot paths async (enqueue-only); sync once at the eval "
+            "boundary inside a timer phase, and pragma that one site with "
+            "its rationale")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.endswith(HOT_PATH_FILES) or is_corpus_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in SYNC_CALLS:
+                yield ctx.finding(
+                    self, node,
+                    f"{name} blocks the host on device compute in a "
+                    "hot-path module")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "item" and not node.args):
+                yield ctx.finding(
+                    self, node,
+                    ".item() forces a device->host transfer (and a full "
+                    "queue drain) in a hot-path module")
+            elif (name in ("np.asarray", "numpy.asarray", "np.array",
+                           "numpy.array")
+                  and ctx.in_nested_def(node.lineno)):
+                yield ctx.finding(
+                    self, node,
+                    f"{name} inside a traced closure pulls a tracer to the "
+                    "host (ConcretizationError at best, silent sync at "
+                    "worst)")

@@ -1,0 +1,94 @@
+"""FT004 — Python scalars at jit call sites without static_argnums.
+
+The r5 bench artifact (577.8 tok/s) was a recompile landing inside a
+timed region because two call sites fed the same jitted program
+different *signatures* for the same logical argument. Python scalars
+are the usual culprit: ``f(x, r)`` traces ``r`` as a weak-typed scalar,
+while the other caller's ``f(x, jnp.uint32(r))`` traces a strong-typed
+one — two cache entries, and the second compile lands wherever the
+second caller runs (a bench window, a receive thread). Booleans are
+worse: they are almost always branch selectors that belong in
+``static_argnums``.
+
+The rule flags, at call sites of module-local jitted callables
+(``x = jax.jit(...)`` / ``self.y = jax.jit(...)`` / ``@jax.jit`` defs):
+
+- Python int/float/bool literals at non-static positions or keywords;
+- a ``for``-loop variable over ``range(...)`` passed positionally (the
+  host round loop's ``f(vars, r)`` spelling — the tree's sanctioned
+  form is ``f(vars, jnp.uint32(r))``).
+
+Positions past a ``*args`` splat are unresolvable and skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, JitBinding, Rule, dotted_name
+
+
+class JitScalarArgRule(Rule):
+    id = "FT004"
+    title = "Python scalar / shape-varying arg at a jit call site"
+    hint = ("pass a typed device scalar (jnp.uint32(r) / jnp.asarray(v, "
+            "dtype)) so every caller shares one signature, or add the "
+            "position to static_argnums if it selects a program variant")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.jit_bindings:
+            return
+        yield from self._walk(ctx, ctx.tree, {})
+
+    def _walk(self, ctx: FileContext, node: ast.AST,
+              range_vars: Dict[str, int]) -> Iterator[Finding]:
+        """DFS carrying the set of in-scope ``for x in range(...)`` vars."""
+        for child in ast.iter_child_nodes(node):
+            local = range_vars
+            if isinstance(child, ast.For) and isinstance(child.iter, ast.Call):
+                if dotted_name(child.iter.func) == "range" and isinstance(
+                        child.target, ast.Name):
+                    local = dict(range_vars)
+                    local[child.target.id] = child.lineno
+            if isinstance(child, ast.Call):
+                yield from self._check_call(ctx, child, range_vars)
+            yield from self._walk(ctx, child, local)
+
+    def _check_call(self, ctx: FileContext, call: ast.Call,
+                    range_vars: Dict[str, int]) -> Iterator[Finding]:
+        callee = dotted_name(call.func)
+        binding = ctx.jit_bindings.get(callee or "")
+        if binding is None:
+            return
+        for pos, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break  # positions past a splat are unknown
+            if pos in binding.static_nums:
+                continue
+            yield from self._check_arg(ctx, callee, arg, f"position {pos}",
+                                       range_vars)
+        for kw in call.keywords:
+            if kw.arg is None or kw.arg in binding.static_names:
+                continue
+            yield from self._check_arg(ctx, callee, kw.value,
+                                       f"keyword {kw.arg!r}", range_vars)
+
+    def _check_arg(self, ctx: FileContext, callee: str, arg: ast.expr,
+                   where: str, range_vars: Dict[str, int]
+                   ) -> Iterator[Finding]:
+        if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, (bool, int, float)):
+            kind = type(arg.value).__name__
+            yield ctx.finding(
+                self, arg,
+                f"Python {kind} literal {arg.value!r} at {where} of jitted "
+                f"`{callee}` traces a weak-typed signature any other caller "
+                "can miss (recompile)")
+        elif isinstance(arg, ast.Name) and arg.id in range_vars:
+            yield ctx.finding(
+                self, arg,
+                f"range() loop variable `{arg.id}` passed to jitted "
+                f"`{callee}` at {where} as a Python int — a second caller "
+                "passing a device scalar forks the jit cache")

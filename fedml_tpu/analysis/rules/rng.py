@@ -1,0 +1,81 @@
+"""FT001 — global numpy RNG mutation outside the core.sampling lock.
+
+The PR 2 race, as a rule: ``core.sampling.sample_clients`` implements
+the reference's sampling contract by seeding the PROCESS-GLOBAL numpy
+RNG per round. The async round pipeline's prefetch worker (and the
+cross-silo silo threads) share that process, so any other
+``np.random.*`` draw on the global stream can interleave with a
+seed/draw pair and corrupt a cohort — observed only as a *flaky* parity
+test until the seed+draw was made atomic under
+``core.sampling._GLOBAL_RNG_LOCK``.
+
+Safe spellings the rule recognizes:
+
+- a local stream: ``np.random.RandomState(seed)`` /
+  ``np.random.default_rng(seed)`` / ``np.random.Generator`` (these
+  CONSTRUCT a stream; draws on the instance never touch global state);
+- a draw lexically inside ``with locked_global_numpy_rng(...)`` (or a
+  direct ``with _GLOBAL_RNG_LOCK``) — the sanctioned way to keep the
+  reference's global-stream bit-parity where a contract requires it.
+
+Scope: library code only. ``tests/`` is exempt (pytest runs the
+process single-threaded before any prefetcher exists).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, Rule, dotted_name, is_test_path
+
+#: np.random functions that mutate the process-global stream
+GLOBAL_MUTATORS = frozenset({
+    "seed", "choice", "shuffle", "permutation", "randint", "rand",
+    "randn", "random", "random_sample", "ranf", "sample", "dirichlet",
+    "normal", "uniform", "binomial", "beta", "poisson", "multinomial",
+    "standard_normal", "exponential", "gamma", "lognormal", "bytes",
+    "set_state", "get_state",
+})
+
+#: constructors of LOCAL streams — never a finding
+LOCAL_STREAM_CTORS = frozenset({
+    "RandomState", "Generator", "default_rng", "SeedSequence", "PCG64",
+    "Philox", "MT19937",
+})
+
+
+class GlobalRngRule(Rule):
+    id = "FT001"
+    title = "global numpy RNG use outside the core.sampling lock"
+    hint = ("draw from a local np.random.Generator/RandomState, or hold "
+            "core.sampling.locked_global_numpy_rng() across the seed+draws "
+            "when the reference contract pins the global stream")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            parts = name.split(".")
+            if len(parts) < 3 or parts[-2] != "random" or parts[0] not in (
+                    "np", "numpy"):
+                continue
+            fn = parts[-1]
+            if fn in LOCAL_STREAM_CTORS:
+                continue
+            if fn not in GLOBAL_MUTATORS:
+                continue
+            if ctx.under_rng_lock(node.lineno):
+                continue
+            yield ctx.finding(
+                self, node,
+                f"np.random.{fn} mutates the process-global RNG stream that "
+                "core.sampling's per-round seed/draw contract shares with "
+                "the prefetch worker and silo threads")

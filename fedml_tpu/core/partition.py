@@ -19,6 +19,8 @@ from typing import Dict, List, Sequence, Union
 
 import numpy as np
 
+from fedml_tpu.core.sampling import locked_global_numpy_rng
+
 MIN_SAMPLES_PER_CLIENT = 10
 
 
@@ -36,8 +38,13 @@ def partition_class_samples_with_dirichlet_distribution(
     and splits the shuffled pool at the cumulative cut points. Returns the
     grown per-client index lists and the current minimum client size.
     """
-    np.random.shuffle(idx_k)
-    proportions = np.random.dirichlet(np.repeat(alpha, client_num))
+    # reference parity rides on the GLOBAL stream seeded by the caller
+    # (data loaders: np.random.seed(seed) then this exact draw sequence);
+    # the reentrant lock keeps a concurrent sample_clients from
+    # interleaving its own seed/draw pair into the partition stream
+    with locked_global_numpy_rng():
+        np.random.shuffle(idx_k)
+        proportions = np.random.dirichlet(np.repeat(alpha, client_num))
     # clients at or beyond their fair share stop receiving from this class
     proportions = np.array(
         [p * (len(batch) < N / client_num) for p, batch in zip(proportions, idx_batch)]
@@ -111,16 +118,18 @@ def non_iid_partition_with_dirichlet_distribution(
                 )
 
     net_dataidx_map = {}
-    for i in range(client_num):
-        np.random.shuffle(idx_batch[i])
-        net_dataidx_map[i] = idx_batch[i]
+    with locked_global_numpy_rng():
+        for i in range(client_num):
+            np.random.shuffle(idx_batch[i])
+            net_dataidx_map[i] = idx_batch[i]
     return net_dataidx_map
 
 
 def homo_partition(n_samples: int, client_num: int) -> Dict[int, np.ndarray]:
     """IID partition: shuffle then split evenly (reference cifar10
     data_loader.py ``partition_data`` 'homo' branch)."""
-    idxs = np.random.permutation(n_samples)
+    with locked_global_numpy_rng():
+        idxs = np.random.permutation(n_samples)
     return {i: batch for i, batch in enumerate(np.array_split(idxs, client_num))}
 
 

@@ -14,6 +14,7 @@ reference FedAVGTrainer.update_dataset semantics).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Optional, Sequence
 
@@ -26,8 +27,33 @@ import numpy as np
 #: cross-silo silo threads) drawing round r+1 concurrently with the main
 #: thread's round r would interleave seed/draw pairs and corrupt both
 #: cohorts. Each call re-seeds, so mutual exclusion alone restores the
-#: exact per-round stream regardless of thread arrival order.
-_GLOBAL_RNG_LOCK = threading.Lock()
+#: exact per-round stream regardless of thread arrival order. RLock, not
+#: Lock: callers holding the lock across a seed+draw sequence (the
+#: partitioners) nest inside per-draw acquisitions without deadlocking.
+_GLOBAL_RNG_LOCK = threading.RLock()
+
+
+@contextlib.contextmanager
+def locked_global_numpy_rng(seed: Optional[int] = None):
+    """THE sanctioned way to touch the process-global numpy RNG.
+
+    Everything outside this module that the reference contract pins to
+    the global stream (the LDA/homo partitioners' exact
+    seed-then-draw-sequence bit-parity, topology coin flips) holds this
+    lock across the whole seed+draws sequence, so no concurrent
+    ``sample_clients`` (prefetch worker, silo thread) can interleave
+    with — and corrupt — either stream. Reentrant: a partitioner
+    holding the outer lock may call helpers that take it per draw.
+
+    ``seed`` is applied inside the lock (atomically with the caller's
+    subsequent draws). Yields the ``np.random`` module so call sites
+    read as draws on the locked stream. The static analyzer (rule
+    FT001) recognizes draws lexically inside this context as safe.
+    """
+    with _GLOBAL_RNG_LOCK:
+        if seed is not None:
+            np.random.seed(seed)
+        yield np.random
 
 #: sentinel fold indices OUTSIDE the client-id range: client c's training
 #: key is fold_in(round_key, c), so server-side draws use ids no client can

@@ -19,6 +19,8 @@ import abc
 
 import numpy as np
 
+from fedml_tpu.core.sampling import locked_global_numpy_rng
+
 
 def ring_lattice_adjacency(n: int, k: int) -> np.ndarray:
     """Adjacency of a ring lattice where each node links to k//2 neighbors on
@@ -110,10 +112,15 @@ class AsymmetricTopologyManager(BaseTopologyManager):
         # coin-flip extra directed links on the zero entries, avoiding
         # creating a link where the reverse direction was already added this way
         added = set()
+        # the coin flips ride the caller-seeded GLOBAL stream (the
+        # decentralized driver's reference parity); lock so a concurrent
+        # sample_clients cannot interleave its seed/draw pair
+        with locked_global_numpy_rng():
+            flip_rows = [np.random.randint(2, size=len(np.where(base[i] == 0)[0]))
+                         for i in range(self.n)]
         for i in range(self.n):
             zeros = np.where(base[i] == 0)[0]
-            flips = np.random.randint(2, size=len(zeros))
-            for j, flip in zip(zeros, flips):
+            for j, flip in zip(zeros, flip_rows[i]):
                 if flip == 1 and (j, i) not in added:
                     base[i, j] = 1
                     added.add((i, j))

@@ -21,6 +21,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from fedml_tpu.core.partition import partition_data
+from fedml_tpu.core.sampling import locked_global_numpy_rng
 from fedml_tpu.data.base import FederatedDataset
 
 CIFAR10_MEAN = np.asarray([0.4914, 0.4822, 0.4465], np.float32)
@@ -94,9 +95,11 @@ def load_partition_data_cifar(
     x_train = _normalize(x_train, mean, std)
     x_test = _normalize(x_test, mean, std)
 
-    np.random.seed(seed)
-    mapping = partition_data(y_train, partition_method, client_number,
-                             alpha=partition_alpha, class_num=class_num)
+    # seed + partition draws are one atomic sequence on the locked global
+    # stream (reference bit-parity; no thread can interleave a draw)
+    with locked_global_numpy_rng(seed):
+        mapping = partition_data(y_train, partition_method, client_number,
+                                 alpha=partition_alpha, class_num=class_num)
     train_local: Dict[int, Tuple] = {}
     test_local: Dict[int, Optional[Tuple]] = {}
     for c, idxs in mapping.items():

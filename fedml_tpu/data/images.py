@@ -22,6 +22,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from fedml_tpu.core.partition import partition_data
+from fedml_tpu.core.sampling import locked_global_numpy_rng
 from fedml_tpu.data.base import FederatedDataset
 
 
@@ -43,9 +44,9 @@ def load_partition_data_imagenet(
     """ImageNet from an array pack, LDA/homo partitioned (the reference's
     per-client splits, ImageNet/data_loader.py:~300)."""
     x_train, y_train, x_test, y_test = _load_pack(pack_path)
-    np.random.seed(seed)
-    mapping = partition_data(y_train, partition_method, client_number,
-                             alpha=partition_alpha, class_num=class_num)
+    with locked_global_numpy_rng(seed):  # atomic seed+draws, ref parity
+        mapping = partition_data(y_train, partition_method, client_number,
+                                 alpha=partition_alpha, class_num=class_num)
     train_local = {c: (x_train[np.asarray(i)].astype(np.float32),
                        y_train[np.asarray(i)])
                    for c, i in mapping.items()}

@@ -17,6 +17,7 @@ from typing import Optional
 import numpy as np
 
 from fedml_tpu.core.partition import partition_data
+from fedml_tpu.core.sampling import locked_global_numpy_rng
 from fedml_tpu.data.base import FederatedDataset
 
 
@@ -69,9 +70,9 @@ def make_blob_federated(
     y = rng.randint(0, class_num, n_samples).astype(np.int32)
     x = (centers[y] + noise * rng.randn(n_samples, dim)).astype(np.float32)
 
-    np.random.seed(seed)
-    mapping = partition_data(y, partition_method, client_num,
-                             alpha=partition_alpha, class_num=class_num)
+    with locked_global_numpy_rng(seed):  # atomic seed+draws, ref parity
+        mapping = partition_data(y, partition_method, client_num,
+                                 alpha=partition_alpha, class_num=class_num)
     train_local, test_local = {}, {}
     for c, idxs in mapping.items():
         idxs = np.asarray(idxs)
@@ -196,9 +197,9 @@ def make_image_blob_federated(
                      for c in range(class_num)])  # [C, H, W, 3]
     x = (sigs[y] + 0.3 * rng.randn(n, s, s, 3)).astype(np.float32)
 
-    np.random.seed(seed)
-    mapping = partition_data(y, partition_method, client_num,
-                             alpha=partition_alpha, class_num=class_num)
+    with locked_global_numpy_rng(seed):  # atomic seed+draws, ref parity
+        mapping = partition_data(y, partition_method, client_num,
+                                 alpha=partition_alpha, class_num=class_num)
     train_local, test_local = {}, {}
     for c, idxs in mapping.items():
         idxs = np.asarray(idxs)

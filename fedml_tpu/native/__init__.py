@@ -314,5 +314,5 @@ class NativeRouter:
     def __del__(self) -> None:
         try:
             self.stop()
-        except Exception:
-            pass
+        except Exception:  # ft: allow[FT005] interpreter-teardown __del__:
+            pass           # logging/raising here can itself crash

@@ -314,3 +314,31 @@ def make_flash_attention(block_q=128, block_k=128, interpret: bool = False,
     def attn(q, k, v, causal: bool = True):
         return flash_attention(q, k, v, causal, block_q, block_k, interpret)
     return attn
+
+
+# -- static-analysis hook (fedml_tpu.analysis layer 2) ----------------------
+from fedml_tpu.analysis.registry import AuditSpec, hot_entry_point  # noqa: E402
+
+
+@hot_entry_point("ops.flash_attention_fwd_bwd")
+def _audit_flash_fwd_bwd() -> AuditSpec:
+    """Forward + backward through the Pallas kernel's custom VJP (the
+    transformer path's hot op), traced in interpret mode so the audit
+    runs on the CPU CI backend. grad_path=True: a float upcast sneaking
+    into the FA-2 recurrence (e.g. an accidental f32->f64 promotion in
+    the lse/delta math) fails here."""
+    import numpy as np
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True,
+                               interpret=True).sum()
+
+    fwd_bwd = jax.value_and_grad(loss, argnums=(0, 1, 2))
+    rng = np.random.RandomState(0)
+    qkv = tuple(jnp.asarray(rng.randn(1, 128, 2, 32), jnp.float32)
+                for _ in range(3))
+    # two equivalent arg tuples (fresh strong-typed f32 arrays) — the
+    # kernel's signature must not depend on call-site identity
+    qkv2 = tuple(jnp.asarray(np.asarray(a), jnp.float32) for a in qkv)
+    return AuditSpec(fn=fwd_bwd, sweep=[qkv, qkv2],
+                     max_lowerings=1, grad_path=True)

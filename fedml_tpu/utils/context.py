@@ -56,5 +56,5 @@ def federation_guard(errors: FederationErrors,
         for m in managers:
             try:
                 m.finish()
-            except Exception:  # noqa: BLE001 — best-effort shutdown
-                pass
+            except Exception:  # ft: allow[FT005] best-effort shutdown —
+                pass           # the ORIGINAL failure re-raises below

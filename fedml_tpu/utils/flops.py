@@ -96,8 +96,8 @@ def _jaxpr_flops(jaxpr) -> float:
                 total += _jaxpr_flops(getattr(inner, "jaxpr", inner))
             else:
                 total += _eqn_flops(eqn)
-        except Exception:  # noqa: BLE001 — unknown primitive shapes: bill 0
-            pass
+        except Exception:  # ft: allow[FT005] unknown primitive shapes are
+            pass           # billed 0 by contract (documented under-count)
     return total
 
 

@@ -53,6 +53,9 @@ class MetricsSink:
                 self._wandb = wandb.init(project=project, config=config,
                                          dir=run_dir)
             except Exception:  # offline / not installed / not logged in
+                import logging
+                logging.info("wandb logging disabled (init failed)",
+                             exc_info=True)
                 self._wandb = None
 
     def log(self, metrics: Dict[str, Any],

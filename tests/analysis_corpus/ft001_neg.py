@@ -1,0 +1,19 @@
+"""FT001 negative: local streams, and locked global-stream access."""
+import numpy as np
+
+from fedml_tpu.core.sampling import locked_global_numpy_rng
+
+
+def sample_cohort_local(seed, n, k):
+    rng = np.random.RandomState(seed)  # local stream: always fine
+    return rng.choice(n, k, replace=False)
+
+
+def sample_cohort_locked(round_idx, n, k):
+    # reference bit-parity on the global stream, atomically
+    with locked_global_numpy_rng(round_idx):
+        return np.random.choice(n, k, replace=False)
+
+
+def modern(seed):
+    return np.random.default_rng(seed).integers(0, 10)

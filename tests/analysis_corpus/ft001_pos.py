@@ -1,0 +1,13 @@
+"""FT001 positive: global-stream draws outside the sampling lock."""
+import numpy as np
+
+
+def sample_cohort(round_idx, n, k):
+    # the PR 2 race verbatim: seed+draw on the process-global stream,
+    # no lock — a concurrent prefetch worker interleaves and corrupts
+    np.random.seed(round_idx)
+    return np.random.choice(n, k, replace=False)
+
+
+def jitter(scale):
+    return scale * np.random.rand()

@@ -1,0 +1,21 @@
+"""FT002 negative: the sanctioned same-statement overwrite, and reads
+of a NON-donated argument."""
+import jax
+
+
+def _round(variables, grads):
+    return variables, grads
+
+
+round_fn = jax.jit(_round, donate_argnums=(0,))
+
+
+def run(variables, grads):
+    variables, stats = round_fn(variables, grads)  # rebinds the donated name
+    return variables, stats, grads  # grads (position 1) was not donated
+
+
+def loop(variables, grads):
+    for _ in range(3):
+        variables, _ = round_fn(variables, grads)
+    return variables

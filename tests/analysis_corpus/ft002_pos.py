@@ -1,0 +1,16 @@
+"""FT002 positive: buffer read after being donated to a jit call."""
+import jax
+
+
+def _round(variables, grads):
+    return variables, grads
+
+
+round_fn = jax.jit(_round, donate_argnums=(0,))
+
+
+def run(variables, grads):
+    new_vars, _ = round_fn(variables, grads)
+    # `variables` was donated above — this read hits an invalid buffer
+    delta = variables
+    return new_vars, delta

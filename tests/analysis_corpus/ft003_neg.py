@@ -1,0 +1,15 @@
+"""FT003 negative: pragma'd eval-boundary sync; host-level numpy."""
+import jax
+import numpy as np
+
+
+def eval_boundary(timer, variables):
+    with timer.phase("device_wait"):
+        # ft: allow[FT003] eval-boundary sync, by design
+        jax.block_until_ready(variables)
+    return variables
+
+
+def pack_host(xs):
+    # top-level (non-nested) host packing code uses numpy freely
+    return np.asarray(xs, np.float32)

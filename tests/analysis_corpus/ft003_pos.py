@@ -1,0 +1,18 @@
+"""FT003 positive: host syncs in (what the rule treats as) a hot path."""
+import jax
+import numpy as np
+
+
+def dispatch_round(fn, variables, x):
+    variables = fn(variables, x)
+    jax.block_until_ready(variables)  # per-round drain, not eval-boundary
+    loss = variables["loss"].item()   # device->host per round
+    host = jax.device_get(variables)
+    return variables, loss, host
+
+
+def make_round(fn):
+    def round_body(variables, x):
+        # np.asarray on a tracer inside the traced closure
+        return fn(np.asarray(variables), x)
+    return round_body

@@ -1,0 +1,20 @@
+"""FT004 negative: typed device scalars, static argnums/argnames."""
+import jax
+import jax.numpy as jnp
+
+
+def _round(variables, round_idx, flag=False):
+    return variables
+
+
+round_fn = jax.jit(_round)
+round_fn_static = jax.jit(_round, static_argnums=(1,),
+                          static_argnames=("flag",))
+
+
+def run(variables):
+    for r in range(10):
+        variables = round_fn(variables, jnp.uint32(r))  # one signature
+    variables = round_fn_static(variables, 3)           # static: compiles per value, on purpose
+    variables = round_fn_static(variables, 0, flag=True)
+    return variables

@@ -1,0 +1,17 @@
+"""FT004 positive: Python scalars at jit call sites."""
+import jax
+
+
+def _round(variables, round_idx, flag=False):
+    return variables
+
+
+round_fn = jax.jit(_round)
+
+
+def run(variables):
+    variables = round_fn(variables, 3)            # int literal
+    variables = round_fn(variables, 0, flag=True)  # bool literal keyword
+    for r in range(10):
+        variables = round_fn(variables, r)        # range var as Python int
+    return variables

@@ -1,0 +1,38 @@
+"""FT005 negative: broad handlers that demonstrably propagate."""
+import logging
+
+
+def worker(queue, produce, errors):
+    try:
+        queue.put(produce())
+    except Exception as exc:
+        errors.record(exc)  # bound exception is used (stored for re-raise)
+
+
+def probe(fn):
+    try:
+        return fn()
+    except Exception:
+        logging.warning("probe failed", exc_info=True)
+        return None
+
+
+def strict(fn):
+    try:
+        return fn()
+    except Exception:
+        raise  # re-raise
+
+
+def narrow(fn):
+    try:
+        return fn()
+    except (OSError, ValueError):  # narrow: not the rule's business
+        return None
+
+
+def teardown(handle):
+    try:
+        handle.close()
+    except Exception:  # ft: allow[FT005] best-effort __del__-style close
+        pass

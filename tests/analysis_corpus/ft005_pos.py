@@ -1,0 +1,16 @@
+"""FT005 positive: broad handlers that swallow the error."""
+
+
+def worker(queue, produce):
+    while True:
+        try:
+            queue.put(produce())
+        except Exception:  # the thread dies silently; rounds later a
+            break          # parity test flakes
+
+
+def probe(fn):
+    try:
+        return fn()
+    except:  # noqa: E722 — bare except, nothing propagated
+        return None

@@ -1,0 +1,14 @@
+"""FT006 negative: device dtype, and a pragma'd intentional site."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def accumulate(stats):
+    acc = np.zeros(4, np.float32)
+    acc += np.asarray(stats, dtype="float32")
+    return jnp.asarray(acc, jnp.float32)
+
+
+def host_reference(x):
+    # ft: allow[FT006] host-side reference solve needs the precision
+    return np.linalg.lstsq(x.astype(np.float64), x[:, 0], rcond=None)[0]

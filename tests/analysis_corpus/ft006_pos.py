@@ -1,0 +1,9 @@
+"""FT006 positive: f64 dtypes outside the intentional-f64 modules."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def accumulate(stats):
+    acc = np.zeros(4, np.float64)
+    acc += np.asarray(stats, dtype="float64")
+    return jnp.asarray(acc, jnp.float64)
