@@ -636,6 +636,70 @@ def bench_powerlaw_1000() -> dict:
     }
 
 
+def bench_cross_silo_compression() -> dict:
+    """The cross-silo WIRE cost axis: the same federation run at policy
+    ``none`` vs ``topk_ef_int8`` (top-k + error feedback uplink, mirror
+    delta downlink — comm/policy.py), with ``comm_bytes_up``/
+    ``comm_bytes_down`` measured from the ACTUAL encoded frames the
+    transport ships (RoundTimer counters fed by the comm backends). The
+    BENCH trajectory can now track bytes/round the way it tracks
+    rounds/sec: on a WAN-bound cross-silo deployment the compression
+    ratio IS the round-rate multiplier, so a regression here is a
+    regression in the paper's own bottleneck dimension."""
+    from fedml_tpu.algorithms.fedavg_cross_silo import run_fedavg_cross_silo
+    from fedml_tpu.comm.policy import parse_policy
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+    from fedml_tpu.utils.tracing import RoundTimer
+
+    rounds, workers = 10, 4
+    ds = make_blob_federated(client_num=workers, dim=256, class_num=10,
+                             n_samples=800, seed=0, noise=10.0)
+    tcfg = TrainConfig(epochs=1, batch_size=20, lr=0.05)
+
+    def run(policy):
+        timer = RoundTimer()
+        t0 = time.perf_counter()
+        _, history = run_fedavg_cross_silo(
+            ds, LogisticRegression(num_classes=10), worker_num=workers,
+            comm_round=rounds, train_cfg=tcfg, compression=policy,
+            timer=timer)
+        wall = time.perf_counter() - t0
+        total = timer.comm_bytes_up + timer.comm_bytes_down
+        return {
+            "rounds_per_sec": round(rounds / wall, 3),
+            "bytes_per_round_up": round(timer.comm_bytes_up / rounds, 1),
+            "bytes_per_round_down": round(timer.comm_bytes_down / rounds,
+                                          1),
+            "bytes_per_round_total": round(total / rounds, 1),
+            "final_test_loss": _nn(history[-1]["test_loss"]
+                                   if history else float("nan")),
+            "final_test_acc": _nn(history[-1]["test_acc"]
+                                  if history else float("nan")),
+        }
+
+    # resolved instances, not strings: a set $FEDML_TPU_COMPRESSION must
+    # not silently override BOTH legs of the comparison into one policy
+    none = run(parse_policy("none"))
+    topk = run(parse_policy("topk_ef_int8:0.05"))
+    return {
+        "policy_none": none,
+        "policy_topk_ef_int8": topk,
+        "compression_ratio_x": round(none["bytes_per_round_total"]
+                                     / max(1.0,
+                                           topk["bytes_per_round_total"]),
+                                     2),
+        "loss_delta_vs_none": _nn(topk["final_test_loss"]
+                                  - none["final_test_loss"]),
+        "note": "INPROC wire-codec transport on one host: bytes are real "
+                "encoded frames, rounds/sec excludes WAN latency — the "
+                "ratio is the wire-bound speedup a DCN/WAN deployment "
+                "realizes. Downlink round 0 is full precision (silos "
+                "hold no base), amortized across the window.",
+    }
+
+
 #: shared shape for the fused-round stages (VERDICT r3 #1 contract point:
 #: R=20 blocks on the 1000-client power-law flagship). R=20 is also the
 #: sweet spot: the block packs at the max cohort bucket over its R
@@ -1304,6 +1368,9 @@ _STAGES = (
      lambda: bench_transformer_flash(), ("flash", "transformer_flash")),
     ("fedavg_powerlaw_1000", "fedavg_powerlaw_1000",
      lambda: bench_powerlaw_1000(), ("powerlaw",)),
+    ("cross_silo_compression", "cross_silo_compression",
+     lambda: bench_cross_silo_compression(),
+     ("compression", "cross_silo", "wire")),
     ("fedavg_fused_rounds", "fedavg_fused_rounds",
      lambda: bench_fused_rounds(), ("fused", "fused_rounds")),
     ("fedavg_fused_device_sampling", "fedavg_fused_device_sampling",

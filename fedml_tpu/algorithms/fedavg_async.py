@@ -89,6 +89,10 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
 
     # -- protocol ----------------------------------------------------------
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        # note the base BEFORE the staleness discard: a straggler's stale
+        # reply still reports which model structure the silo holds (the
+        # downlink fallback trigger)
+        self._note_worker_base(msg)
         if msg.get_params().get(MSG_ARG_KEY_ROUND,
                                 self.round_idx) != self.round_idx:
             return  # stale straggler reply from a closed round: discard
@@ -133,14 +137,10 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             return
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
-        with _DEVICE_LOCK:  # D2H transfer is a device dispatch too
-            payload = _to_numpy(self.global_model)
-        for worker in range(1, self.size):
-            msg = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, worker)
-            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
-            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
-            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
-            self.send_message(msg)
+        # shared broadcast incl. the downlink compression path: every
+        # silo receives every broadcast in order (reliable transports),
+        # so stragglers stay based even when their replies are discarded
+        self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL, idxs)
         self._arm_deadline()
 
     def finish(self) -> None:
@@ -155,6 +155,20 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
                  max_updates: int = 100, **kw):
         kw.setdefault("comm_round", max_updates)
         super().__init__(*args, **kw)
+        if self._policy.enabled:
+            # LOUD guard (was only a docstring note): FedAsync has no
+            # stable base on EITHER direction — the global moves every
+            # update, so a client's delta base is stale at decompression
+            # time and a mirror model cannot exist. Stay full precision.
+            import logging
+            logging.warning(
+                "compression policy %r requested with the FedAsync "
+                "server — FedAsync has no stable delta base (the global "
+                "model moves every update); staying FULL PRECISION. Use "
+                "the round-based or quorum server for wire compression.",
+                self._policy.name)
+            from fedml_tpu.comm.policy import CompressionPolicy
+            self._policy = CompressionPolicy("none")
         self.alpha = alpha
         self.poly_a = poly_a
         self.max_updates = max_updates
@@ -181,10 +195,10 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
             # client — fail fast and LOUD by tearing the federation down
             import logging
             self.config_error = ValueError(
-                "FedAsync cannot use int8 delta compression: the global "
-                "model moves every update, so the client's base model is "
-                "already stale at decompression time — run clients with "
-                "compress=False")
+                "FedAsync cannot use delta compression (int8 or top-k): "
+                "the global model moves every update, so the client's "
+                "base model is already stale at decompression time — run "
+                "clients with compression policy 'none'")
             logging.error("%s", self.config_error)
             for worker in range(1, self.size):
                 self.send_message(
@@ -225,7 +239,8 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                      poly_a: float = 0.5, max_updates: int = 20,
                      train_cfg=None, seed: int = 0,
                      backend: str = "INPROC", addresses=None,
-                     wire_codec: bool = False):
+                     wire_codec: bool = False, compression=None,
+                     timer=None):
     """Launch a straggler-tolerant federation (server + worker silos as
     actor threads over any comm backend) and block until it completes.
     ``mode="quorum"`` closes rounds at (all | deadline & quorum);
@@ -239,10 +254,27 @@ def run_fedavg_async(dataset, module, task: str = "classification",
     :func:`~fedml_tpu.algorithms.fedavg_cross_silo.launch_federation` —
     only the server flavor differs."""
     from fedml_tpu.algorithms.fedavg_cross_silo import launch_federation
+    from fedml_tpu.comm.policy import (CompressionPolicy,
+                                       resolve_compression)
 
     if mode not in ("quorum", "fedasync"):
         raise ValueError(f"unknown async mode: {mode!r} "
                          "(quorum | fedasync)")
+    policy = resolve_compression(compression)
+    if mode == "fedasync" and policy.enabled:
+        # the loud launch-time guard (satellite of the docstring-only
+        # exclusion): FedAsync has no stable delta base — warn HERE so a
+        # misconfigured launcher learns before round 0, and force every
+        # silo to full precision so the server's defensive config_error
+        # path never has to tear the federation down
+        import logging
+        logging.warning(
+            "compression policy %r requested with mode='fedasync' — "
+            "FedAsync's global model moves every update, so delta "
+            "compression has no stable base; running FULL PRECISION "
+            "(use mode='quorum' or the round-based server to compress)",
+            policy.name)
+        policy = CompressionPolicy("none")
 
     def server_factory(size, server_com, aggregator, global_model,
                        on_round_done):
@@ -251,7 +283,7 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                 0, size, server_com, aggregator, comm_round,
                 dataset.client_num, global_model, quorum=quorum,
                 round_deadline_s=round_deadline_s,
-                on_round_done=on_round_done)
+                on_round_done=on_round_done, compression=policy)
         return AsyncFedAvgServerManager(
             0, size, server_com, aggregator,
             client_num_in_total=dataset.client_num,
@@ -263,4 +295,5 @@ def run_fedavg_async(dataset, module, task: str = "classification",
     return launch_federation(dataset, module, task, worker_num, train_cfg,
                              server_factory, backend=backend,
                              addresses=addresses, seed=seed,
-                             wire_codec=wire_codec, raise_on_timeout=True)
+                             wire_codec=wire_codec, compression=policy,
+                             timer=timer, raise_on_timeout=True)

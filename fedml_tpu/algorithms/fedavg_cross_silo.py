@@ -19,11 +19,22 @@ TPU-first deltas: each silo's local training is the jitted
 if a silo packs several virtual clients they are vmapped; aggregation is a
 jitted weighted tree-mean on the server's device; transport frames are the
 zero-copy codec, not pickled dicts.
+
+Wire compression (comm/policy.py ladder, ``--compression``): uplink
+replies compress the delta against the silo's held global (int8 and/or
+top-k with a per-silo error-feedback residual, checkpointed under
+``checkpoint_dir/silo_<rank>/``); the round-based servers compress
+downlink broadcasts against the *mirror* — the model state every silo
+holds, advanced by exactly what each broadcast decodes to — falling back
+to full precision on the first broadcast and whenever a silo's reported
+base fingerprint mismatches. Wire bytes are counted from actual encoded
+frames into the launcher's RoundTimer (``comm_bytes_up``/``_down``).
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -34,6 +45,7 @@ import numpy as np
 from fedml_tpu.comm import (ClientManager, Message, ServerManager,
                             create_comm_manager)
 from fedml_tpu.comm.inproc import InProcRouter
+from fedml_tpu.comm.policy import resolve_compression
 from fedml_tpu.core import pytree as pt
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.base import FederatedDataset
@@ -50,6 +62,13 @@ MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
 MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
 MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
 MSG_ARG_KEY_ROUND = "round_idx"
+#: broadcast sequence number: the silo's held-model version, echoed back
+#: on replies so the server knows which base each silo confirmed holding
+MSG_ARG_KEY_BCAST_SEQ = "bcast_seq"
+MSG_ARG_KEY_BASE_SEQ = "base_seq"
+#: structure fingerprint of the silo's held model — the server's
+#: automatic full-precision fallback trigger on mismatch
+MSG_ARG_KEY_BASE_FP = "base_fp"
 
 #: All silo actors in one process share one physical device, which has ONE
 #: dispatch queue anyway — serializing jax compute across actor threads
@@ -151,7 +170,7 @@ class FedAvgServerManager(ServerManager):
                  aggregator: FedAvgAggregator, comm_round: int,
                  client_num_in_total: int, global_model,
                  on_round_done=None, checkpoint_mgr=None,
-                 resume: bool = False):
+                 resume: bool = False, compression=None):
         super().__init__(rank, size, com_manager)
         self.aggregator = aggregator
         self.comm_round = comm_round
@@ -161,6 +180,17 @@ class FedAvgServerManager(ServerManager):
         self.on_round_done = on_round_done
         self.worker_num = size - 1
         self.checkpoint_mgr = checkpoint_mgr
+        # -- downlink compression state (comm/policy.py) --------------------
+        self._policy = resolve_compression(compression)
+        self._bcast_seq = -1
+        #: the model state every silo holds: advanced by exactly what each
+        #: broadcast decodes to, so with downlink compression it trails the
+        #: exact global by the not-yet-sent delta mass (implicit error
+        #: feedback — the gap rides in the next round's delta)
+        self._mirror = None
+        self._mirror_fp = None
+        #: worker -> (held seq, held structure fp) from its last reply
+        self._worker_base: Dict[int, tuple] = {}
         if checkpoint_mgr is not None and resume:
             # resume = restart the protocol at the checkpointed round: the
             # init broadcast carries (restored model, restored round), and
@@ -194,29 +224,112 @@ class FedAvgServerManager(ServerManager):
             return
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
-        payload = _to_numpy(self.global_model)
-        for worker in range(1, self.size):
-            msg = Message(MSG_TYPE_S2C_INIT_CONFIG, self.rank, worker)
-            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
-            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
-            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
-            self.send_message(msg)
+        # first broadcast of a (possibly resumed) run: the mirror is unset,
+        # so _encode_broadcast sends full precision and (re)bases everyone
+        self._broadcast_model(MSG_TYPE_S2C_INIT_CONFIG, idxs)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_MODEL,
             self.handle_message_receive_model_from_client)
 
+    # -- downlink compression (comm/policy.py, comm/compression.py) ---------
+    def _silos_in_sync(self) -> bool:
+        """True iff at least one silo has confirmed a base and every
+        reported (seq, fingerprint) matches the mirror exactly. An fp
+        mismatch (version skew, a silo rebuilt with different shapes) is
+        loud; a seq mismatch is value-level staleness — a broadcast that
+        left the server but never reached the silo (dropped link) would
+        leave its base VALUES behind while the structural fp still
+        matches, so both degrade to a full-precision rebase: a shared
+        compressed broadcast is only decodable when every silo holds the
+        SAME mirror. In the all-received server every fresh reply
+        reports the current seq, so steady-state compression is
+        unaffected; a quorum straggler costs one full broadcast and
+        re-syncs on its next reply."""
+        if not self._worker_base:
+            return False
+        for worker, (seq, fp) in self._worker_base.items():
+            if fp != self._mirror_fp:
+                logging.warning(
+                    "silo %d reports base fingerprint %s but the mirror is "
+                    "%s — falling back to a full-precision broadcast",
+                    worker + 1, fp, self._mirror_fp)
+                return False
+            if seq != self._bcast_seq:
+                logging.debug(
+                    "silo %d last confirmed broadcast seq %d (current %d) "
+                    "— full-precision rebase", worker + 1, seq,
+                    self._bcast_seq)
+                return False
+        return True
+
+    def _encode_broadcast(self):
+        """Encode the global model for this round's broadcast.
+
+        Full precision the first time (INIT, incl. after resume — fresh
+        silos hold nothing) and whenever :meth:`_silos_in_sync` fails;
+        otherwise a compressed delta against the mirror. The mirror then
+        advances by exactly what the silos will decode, so downlink
+        compression error (top-k truncation, int8 rounding) feeds back
+        implicitly: un-sent mass stays in the next (global - mirror) gap.
+        """
+        from fedml_tpu.comm.compression import (compress_for_policy,
+                                                decompress,
+                                                tree_fingerprint)
+        pol = self._policy
+        # the sync check compares silo reports against the seq they
+        # could have seen — BEFORE this broadcast takes the next one
+        in_sync = (pol.downlink_enabled and self._mirror is not None
+                   and self._silos_in_sync())
+        self._bcast_seq += 1
+        with _DEVICE_LOCK:  # D2H transfer is a device dispatch
+            full = _to_numpy(self.global_model)
+        if not in_sync:
+            self._mirror = full
+            self._mirror_fp = tree_fingerprint(full)
+            return full
+        with _DEVICE_LOCK:  # delta compression is device compute
+            key = jax.random.fold_in(jax.random.key(1733), self._bcast_seq)
+            payload, _ = compress_for_policy(full, self._mirror, None, key,
+                                             pol)
+            self._mirror = _to_numpy(decompress(payload, self._mirror))
+        return payload
+
+    def _broadcast_model(self, msg_type: int, idxs) -> None:
+        """One shared payload (full or mirror-delta) to every silo."""
+        payload = self._encode_broadcast()
+        for worker in range(1, self.size):
+            msg = Message(msg_type, self.rank, worker)
+            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
+            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
+            msg.add(MSG_ARG_KEY_BCAST_SEQ, self._bcast_seq)
+            self.send_message(msg)
+
+    def _note_worker_base(self, msg: Message) -> None:
+        """Record which model version/structure the silo reports holding
+        (compressed-reply decode base + the downlink fallback trigger)."""
+        params = msg.get_params()
+        if MSG_ARG_KEY_BASE_FP in params:
+            self._worker_base[msg.get_sender_id() - 1] = (
+                int(params.get(MSG_ARG_KEY_BASE_SEQ, -1)),
+                params[MSG_ARG_KEY_BASE_FP])
+
     def _decode_model_payload(self, payload):
-        """Int8 delta replies are rebuilt against the round's broadcast
-        model (comm/compression.py); full-precision replies pass through."""
-        from fedml_tpu.comm.compression import decompress_delta, is_compressed
+        """Compressed replies are rebuilt against the MIRROR — the model
+        state the silos actually hold (equal to the round's broadcast;
+        with downlink compression that trails the exact global model).
+        Full-precision replies pass through."""
+        from fedml_tpu.comm.compression import decompress, is_compressed
         if not is_compressed(payload):
             return payload
-        return decompress_delta(payload, self.global_model)
+        base = self._mirror if self._mirror is not None else self.global_model
+        return decompress(payload, base)
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         worker = msg.get_sender_id() - 1
+        self._note_worker_base(msg)
         with _DEVICE_LOCK:  # delta decompression is device compute
             payload = self._decode_model_payload(
                 msg.get(MSG_ARG_KEY_MODEL_PARAMS))
@@ -241,13 +354,7 @@ class FedAvgServerManager(ServerManager):
             return
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
-        payload = _to_numpy(self.global_model)
-        for worker in range(1, self.size):
-            msg = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, worker)
-            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
-            msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
-            msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
-            self.send_message(msg)
+        self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL, idxs)
 
 
 class FedOptServerManager(FedAvgServerManager):
@@ -309,7 +416,9 @@ class FedAvgClientManager(ClientManager):
     def __init__(self, rank: int, size: int, com_manager,
                  dataset: FederatedDataset, module, task: str,
                  train_cfg: TrainConfig, seed: int = 0,
-                 compress: bool = False, prefetch_depth: int = 2):
+                 compress: bool = False, compression=None,
+                 state_dir: Optional[str] = None, resume: bool = False,
+                 prefetch_depth: int = 2):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
         from fedml_tpu.trainer.functional import validate_accum_steps
@@ -319,7 +428,23 @@ class FedAvgClientManager(ClientManager):
         self._n_pad = dataset.padded_len(train_cfg.batch_size)
         self._bsz = train_cfg.batch_size
         self._base_key = jax.random.key(seed)
-        self.compress = compress
+        # -- wire compression (comm/policy.py) ------------------------------
+        self._policy = resolve_compression(compression, compress=compress)
+        self.compress = self._policy.enabled  # legacy introspection
+        #: last applied global model (numpy) — the uplink delta base AND
+        #: the downlink decode base (the server's mirror of this silo)
+        self._held = None
+        self._held_seq = -1
+        #: uplink error-feedback residual (flat f32, quantize_tree layout):
+        #: the mass top-k did NOT send, added to the next round's delta so
+        #: the biased compressor still converges (EF-SGD). Checkpointed
+        #: per silo under ``state_dir`` so resume keeps the EF trajectory.
+        self._residual = None
+        self._resume_residual = bool(resume)
+        self._state_ckpt = None
+        if state_dir and self._policy.uplink_topk:
+            from fedml_tpu.utils.checkpoint import CheckpointManager
+            self._state_ckpt = CheckpointManager(state_dir)
         # async round pipeline (parallel/prefetch.py): the server's
         # client_sampling is the deterministic shared stream
         # (core/sampling.sample_clients), so this silo can predict which
@@ -375,10 +500,59 @@ class FedAvgClientManager(ClientManager):
             self._prefetch.close()
         self.finish()
 
+    def _apply_broadcast(self, msg: Message):
+        """Decode this round's global model: full payloads install
+        directly; compressed downlink deltas rebuild against the held
+        model (the structural fingerprint guard inside ``decompress``
+        raises loudly on skew). Returns the numpy model tree."""
+        from fedml_tpu.comm.compression import decompress, is_compressed
+        variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        if is_compressed(variables):
+            if self._held is None:
+                raise RuntimeError(
+                    "silo received a compressed broadcast before any "
+                    "full-precision model — the server must send INIT "
+                    "full (transport reordering or a protocol bug)")
+            with _DEVICE_LOCK:  # delta rebuild is device compute
+                variables = _to_numpy(decompress(variables, self._held))
+        self._held = variables
+        seq = msg.get_params().get(MSG_ARG_KEY_BCAST_SEQ)
+        if seq is not None:
+            self._held_seq = int(seq)
+        return variables
+
+    def _uplink_residual(self, round_idx: int, variables):
+        """EF residual entering this round. On resume it is restored once
+        from the silo's state checkpoint at the server's resumed round;
+        absent state falls back to zeros (convergence-safe: EF merely
+        re-loses mass that was pending, it never corrupts)."""
+        if self._resume_residual:
+            self._resume_residual = False
+            if self._state_ckpt is not None:
+                d = sum(int(np.prod(np.shape(l)))
+                        for l in jax.tree.leaves(variables))
+                try:
+                    state, _ = self._state_ckpt.restore(
+                        round_idx, {"residual": np.zeros(d, np.float32)})
+                    self._residual = state["residual"]
+                except FileNotFoundError:
+                    logging.info(
+                        "silo%d: no residual checkpoint for round %d — "
+                        "starting error feedback from zero", self.rank,
+                        round_idx)
+        return self._residual
+
+    def _save_residual(self, completed_round: int) -> None:
+        # same round keying as the server's model checkpoint (saved under
+        # rounds-completed), so restore-at-resumed-round lines both up
+        if self._state_ckpt is not None and self._residual is not None:
+            self._state_ckpt.save(completed_round,
+                                  {"residual": np.asarray(self._residual)})
+
     def handle_message_init(self, msg: Message) -> None:
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = msg.get(MSG_ARG_KEY_ROUND)
-        variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        variables = self._apply_broadcast(msg)
         packed = None
         if self._prefetch is not None:
             # keyed on the ACTUAL (round, client): a mispredicted slot
@@ -410,19 +584,37 @@ class FedAvgClientManager(ClientManager):
                 new_vars, _ = self._local_train(
                     variables, jnp.asarray(xb), jnp.asarray(yb),
                     jnp.asarray(maskb), key, lr_scale=scale)
-            if self.compress:
-                from fedml_tpu.comm.compression import compress_delta
+            if self._policy.enabled:
+                from fedml_tpu.comm.compression import compress_for_policy
                 ckey = jax.random.fold_in(jax.random.fold_in(
                     jax.random.key(977), round_idx), self.rank)
-                reply.add(MSG_ARG_KEY_MODEL_PARAMS,
-                          compress_delta(new_vars, variables, ckey))
+                residual = (self._uplink_residual(round_idx, variables)
+                            if self._policy.uplink_topk else None)
+                payload, new_residual = compress_for_policy(
+                    new_vars, variables, residual, ckey, self._policy)
+                if self._policy.uplink_topk:
+                    # committed as-if-delivered. If a QUORUM server later
+                    # discards this reply as stale, the sent top-k mass is
+                    # lost to the EF loop — strictly less than the
+                    # uncompressed quorum protocol loses (it discards the
+                    # ENTIRE stale update), so the EF-convergence claim is
+                    # scoped to rounds whose replies are accepted
+                    self._residual = new_residual
+                reply.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
             else:
                 reply.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))
+        if self._policy.uplink_topk:
+            self._save_residual(round_idx + 1)  # file I/O outside the lock
         n_i = float(self.dataset.train_data_local_num_dict[int(client_idx)])
         reply.add(MSG_ARG_KEY_NUM_SAMPLES, n_i)
         # round/version tag: lets straggler-tolerant servers detect stale
         # replies (fedavg_async.py) — the plain server ignores it
         reply.add(MSG_ARG_KEY_ROUND, round_idx)
+        # held-base report: drives the server's downlink decision and its
+        # automatic full-precision fallback on structure mismatch
+        from fedml_tpu.comm.compression import tree_fingerprint
+        reply.add(MSG_ARG_KEY_BASE_SEQ, self._held_seq)
+        reply.add(MSG_ARG_KEY_BASE_FP, tree_fingerprint(variables))
         self.send_message(reply)
 
 
@@ -432,7 +624,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           train_cfg: Optional[TrainConfig] = None,
                           backend: str = "INPROC",
                           addresses=None, wire_codec: bool = True,
-                          compress: bool = False, token=None,
+                          compress: bool = False, compression=None,
+                          token=None,
                           checkpoint_dir: Optional[str] = None,
                           resume: bool = False,
                           server_optimizer: Optional[str] = None,
@@ -441,9 +634,16 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           seed: int = 0,
                           join_timeout_s: float = 600.0,
                           round_record_hook=None,
+                          timer=None,
                           prefetch_depth: int = 2):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
+
+    ``compression`` selects the wire policy (comm/policy.py:
+    none | delta_int8 | topk_ef | topk_ef_int8, a name or a
+    CompressionPolicy); the legacy boolean ``compress`` maps to
+    delta_int8. ``timer`` (a RoundTimer) receives the wire accounting
+    (``comm_bytes_up``/``comm_bytes_down`` from actual encoded frames).
 
     The reference's equivalent is `mpirun -np worker_num+1 main_fedavg.py`
     (FedAvgAPI.py:20-67 rank dispatch); here ranks are threads over the
@@ -454,11 +654,15 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     if checkpoint_dir:
         from fedml_tpu.utils.checkpoint import CheckpointManager
         checkpoint_mgr = CheckpointManager(checkpoint_dir)
+    # resolve ONCE and hand the instance to both sides, so the server's
+    # downlink and the silos' uplink can never disagree about the policy
+    policy = resolve_compression(compression, compress=compress)
 
     def server_factory(size, server_com, aggregator, global_model,
                        on_round_done):
         common = dict(on_round_done=on_round_done,
-                      checkpoint_mgr=checkpoint_mgr, resume=resume)
+                      checkpoint_mgr=checkpoint_mgr, resume=resume,
+                      compression=policy)
         if server_optimizer:
             return FedOptServerManager(
                 0, size, server_com, aggregator, comm_round,
@@ -472,9 +676,10 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     model, history, _ = launch_federation(
         dataset, module, task, worker_num, train_cfg, server_factory,
         backend=backend, addresses=addresses, wire_codec=wire_codec,
-        compress=compress, token=token, seed=seed,
+        compression=policy, token=token, seed=seed,
+        client_state_dir=checkpoint_dir, resume=resume,
         join_timeout_s=join_timeout_s, round_record_hook=round_record_hook,
-        prefetch_depth=prefetch_depth)
+        timer=timer, prefetch_depth=prefetch_depth)
     return model, history
 
 
@@ -482,18 +687,26 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       worker_num: int, train_cfg: Optional[TrainConfig],
                       server_factory, backend: str = "INPROC",
                       addresses=None, wire_codec: bool = True,
-                      compress: bool = False, token=None, seed: int = 0,
+                      compress: bool = False, compression=None,
+                      token=None, seed: int = 0,
+                      client_state_dir: Optional[str] = None,
+                      resume: bool = False,
                       join_timeout_s: float = 600.0,
                       raise_on_timeout: bool = False,
                       round_record_hook=None,
+                      timer=None,
                       prefetch_depth: int = 2):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
     protocol threads, bounded-join. ``server_factory(size, server_com,
     aggregator, global_model, on_round_done)`` returns the server
-    manager. Returns ``(final global model, history, server)``."""
+    manager (callers that want a non-``none`` downlink construct their
+    server with the same resolved policy). Returns ``(final global
+    model, history, server)`` — the server carries ``round_timer`` with
+    the wire byte accounting."""
     train_cfg = train_cfg or TrainConfig()
+    policy = resolve_compression(compression, compress=compress)
     size = worker_num + 1
     router = InProcRouter() if backend.upper() in ("INPROC", "MPI") else None
 
@@ -536,15 +749,19 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                                      wire_codec=wire_codec, token=token)
     server = server_factory(size, server_com, aggregator, global_model,
                             on_round_done)
+    from fedml_tpu.utils.tracing import RoundTimer
+    server.round_timer = timer if timer is not None else RoundTimer()
     clients = []
     for rank in range(1, size):
         com = create_comm_manager(backend, rank, size, router=router,
                                   addresses=addresses, wire_codec=wire_codec,
                                   token=token)
-        clients.append(FedAvgClientManager(rank, size, com, dataset, module,
-                                           task, train_cfg, seed=seed,
-                                           compress=compress,
-                                           prefetch_depth=prefetch_depth))
+        clients.append(FedAvgClientManager(
+            rank, size, com, dataset, module, task, train_cfg, seed=seed,
+            compression=policy,
+            state_dir=(os.path.join(client_state_dir, f"silo_{rank}")
+                       if client_state_dir else None),
+            resume=resume, prefetch_depth=prefetch_depth))
 
     # Warm the two heavyweight programs ON THE MAIN THREAD before any
     # actor thread starts: one local_train at the padded shape and one
@@ -616,4 +833,14 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
             "for slow-compile hosts", join_timeout_s, len(history))
     for t in threads:
         t.join(timeout=60)
+    # wire accounting from the server's transport endpoint: every uplink
+    # reply lands in bytes_received, every broadcast in bytes_sent —
+    # ACTUAL encoded frame lengths, not array-size estimates. (Quorum's
+    # self-addressed TIMEOUT ticks ride the same endpoint; they are tens
+    # of bytes against multi-KB..MB model frames.) Backends without a
+    # wire (inproc with wire_codec=False) report 0.
+    server.round_timer.count("comm_bytes_down",
+                             int(getattr(server_com, "bytes_sent", 0)))
+    server.round_timer.count("comm_bytes_up",
+                             int(getattr(server_com, "bytes_received", 0)))
     return server.global_model, history, server

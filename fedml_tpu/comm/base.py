@@ -4,6 +4,7 @@ observer.py:4-7)."""
 from __future__ import annotations
 
 import abc
+import threading
 
 from fedml_tpu.comm.message import Message
 
@@ -17,10 +18,29 @@ class Observer(abc.ABC):
 class BaseCommunicationManager(abc.ABC):
     """A transport endpoint for one rank. Backends deliver inbound messages
     by invoking every registered observer (the reference's notify pattern,
-    mpi com_manager.py:80-83)."""
+    mpi com_manager.py:80-83).
+
+    Wire accounting: backends that encode frames credit
+    ``bytes_sent``/``bytes_received`` with the ACTUAL encoded frame
+    lengths (header + framing included), so compression ratios are
+    measured at the wire, not estimated from array sizes. Backends that
+    hand off objects in memory (inproc without the wire codec) have no
+    frames and report 0.
+    """
 
     def __init__(self) -> None:
         self._observers = []
+        self._bytes_lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+    def _count_sent(self, n: int) -> None:
+        with self._bytes_lock:
+            self.bytes_sent += int(n)
+
+    def _count_received(self, n: int) -> None:
+        with self._bytes_lock:
+            self.bytes_received += int(n)
 
     @abc.abstractmethod
     def send_message(self, msg: Message) -> None:

@@ -1,20 +1,25 @@
-"""gRPC backend — cross-silo transport mirroring the reference's proto.
+"""gRPC backend — chunked streaming transport for the cross-silo wire.
 
 The reference defines ``service gRPCCommManager { rpc sendMessage
 (CommRequest) returns (CommResponse) }`` with ``(client_id, message)`` fields
 (gRPC/proto/grpc_comm_manager.proto:1-17) but hardcodes two receiver IPs
-(grpc_comm_manager.py:51-56). Here the same unary-RPC shape is registered as
-a *generic* RPC handler (no protoc code-gen needed: the message field is our
-binary frame, already self-describing), and peer addresses come from an
-explicit ``{rank: (host, port)}`` map. Import is gated so environments
-without grpcio still load the package.
+(grpc_comm_manager.py:51-56). Earlier revisions here kept the unary-RPC
+shape with a lifted-but-hard ``max_message_length`` ceiling (1 GiB): one
+oversized model update would fail the whole federation, and gRPC buffered
+each frame contiguously on both ends. Now ``sendMessage`` is a
+CLIENT-STREAMING rpc: the sender walks the frame's constituent buffers
+(``Message.to_parts`` — header + raw leaf buffers, never joined) and ships
+~``_CHUNK``-byte messages, so the per-message limit only needs to clear one
+chunk and total frame size is unbounded. No protoc code-gen needed: chunks
+are raw bytes of our self-describing binary frame. Import is gated so
+environments without grpcio still load the package.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Dict, Tuple
+from typing import Dict, Iterator, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.message import Message
@@ -28,9 +33,38 @@ except ImportError:  # pragma: no cover
 
 _SERVICE = "fedml_tpu.CommManager"
 _METHOD = f"/{_SERVICE}/sendMessage"
-_MAX_LEN = 1 << 30  # model updates are large; lift the 4 MB default
+#: stream chunk size — the ONLY per-message budget the transport needs;
+#: total frame size is unbounded (the old unary backend's 1 GiB _MAX_LEN
+#: ceiling is gone)
+_CHUNK = 4 << 20
+#: per-message cap: one chunk + protobuf/framing slack
+_MSG_LEN = _CHUNK + (1 << 20)
 
 _STOP = object()
+
+
+def _iter_chunks(parts, chunk: int = _CHUNK) -> Iterator[bytes]:
+    """Walk a ``dumps_parts`` buffer list as ~chunk-byte bytes messages.
+
+    Small parts (the length prefix, the header, scalar-only payloads) are
+    coalesced into one chunk; large array buffers are sliced. Only the
+    per-chunk ``bytes()`` copies are ever materialized — never the frame.
+    """
+    pending: list = []
+    pending_n = 0
+    for p in parts:
+        view = memoryview(p)
+        off = 0
+        while off < len(view):
+            take = min(chunk - pending_n, len(view) - off)
+            pending.append(view[off:off + take])
+            pending_n += take
+            off += take
+            if pending_n == chunk:
+                yield b"".join(pending)
+                pending, pending_n = [], 0
+    if pending:
+        yield b"".join(pending)
 
 
 class GrpcCommManager(BaseCommunicationManager):
@@ -45,37 +79,51 @@ class GrpcCommManager(BaseCommunicationManager):
         self._lock = threading.Lock()
         self._running = False
 
-        def handle(request: bytes, context) -> bytes:
-            self._inbox.put(request)
+        def handle(request_iterator, context) -> bytes:
+            # reassemble into ONE growing buffer (no chunk list + join)
+            buf = bytearray()
+            for chunk in request_iterator:
+                buf.extend(chunk)
+            self._count_received(len(buf))
+            self._inbox.put(buf)
             return b"ok"
 
-        rpc = grpc.unary_unary_rpc_method_handler(
+        rpc = grpc.stream_unary_rpc_method_handler(
             handle, request_deserializer=None, response_serializer=None)
         handler = grpc.method_handlers_generic_handler(
             _SERVICE, {"sendMessage": rpc})
-        opts = [("grpc.max_send_message_length", _MAX_LEN),
-                ("grpc.max_receive_message_length", _MAX_LEN)]
         from concurrent import futures
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8),
-                                   options=opts)
+                                   options=self._options())
         self._server.add_generic_rpc_handlers((handler,))
         host, port = addresses[rank]
         self._server.add_insecure_port(f"{host}:{port}")
         self._server.start()
+
+    @staticmethod
+    def _options():
+        return [("grpc.max_send_message_length", _MSG_LEN),
+                ("grpc.max_receive_message_length", _MSG_LEN)]
 
     def _stub(self, dest: int):
         with self._lock:
             ch = self._channels.get(dest)
             if ch is None:
                 host, port = self.addresses[dest]
-                opts = [("grpc.max_send_message_length", _MAX_LEN),
-                        ("grpc.max_receive_message_length", _MAX_LEN)]
-                ch = grpc.insecure_channel(f"{host}:{port}", options=opts)
+                ch = grpc.insecure_channel(f"{host}:{port}",
+                                           options=self._options())
                 self._channels[dest] = ch
-            return ch.unary_unary(_METHOD)
+            return ch.stream_unary(_METHOD)
 
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.get_receiver_id())(msg.to_bytes(), timeout=60)
+        parts = msg.to_parts()
+        n = sum(len(p) for p in parts)
+        # deadline scales with frame size (floor 8 MB/s): a fixed 60 s
+        # would re-cap exactly the huge-model frames streaming unlocked
+        timeout = 60 + n / (8 << 20)
+        self._stub(msg.get_receiver_id())(_iter_chunks(parts),
+                                          timeout=timeout)
+        self._count_sent(n)
 
     def handle_receive_message(self) -> None:
         self._running = True

@@ -49,7 +49,11 @@ class InProcCommManager(BaseCommunicationManager):
         self._running = False
 
     def send_message(self, msg: Message) -> None:
-        payload = msg.to_bytes() if self.wire_codec else msg
+        if self.wire_codec:
+            payload = msg.to_bytes()
+            self._count_sent(len(payload))
+        else:
+            payload = msg  # object hand-off: no frame, no byte accounting
         self.router.mailbox(msg.get_receiver_id()).put(payload)
 
     def handle_receive_message(self) -> None:
@@ -58,8 +62,10 @@ class InProcCommManager(BaseCommunicationManager):
             item = self._inbox.get()
             if item is _STOP:
                 break
-            msg = Message.from_bytes(item) if isinstance(item, bytes) else item
-            self._notify(msg)
+            if isinstance(item, (bytes, bytearray)):
+                self._count_received(len(item))
+                item = Message.from_bytes(item)
+            self._notify(item)
 
     def stop_receive_message(self) -> None:
         self._running = False

@@ -63,8 +63,14 @@ class Message:
     def to_bytes(self) -> bytes:
         return serialization.dumps(self.msg_params)
 
+    def to_parts(self) -> list:
+        """The encoded frame as its constituent buffers (header + raw leaf
+        buffers) for chunk-aware transports — the frame is never joined
+        into one contiguous copy on the send path."""
+        return serialization.dumps_parts(self.msg_params)
+
     @classmethod
-    def from_bytes(cls, frame: bytes) -> "Message":
+    def from_bytes(cls, frame) -> "Message":
         msg = cls()
         msg.msg_params = serialization.loads(frame)
         return msg

@@ -32,11 +32,17 @@ def _encode(obj: Any, buffers: List[bytes]) -> Any:
         return {"t": "l" if isinstance(obj, list) else "u",
                 "v": [_encode(v, buffers) for v in obj]}
     if hasattr(obj, "__array__") and not np.isscalar(obj):
-        arr = np.ascontiguousarray(np.asarray(obj))
-        # flat byte view (len == nbytes even for ndim>1), no copy
-        buffers.append(arr.data.cast("B"))
+        arr = np.asarray(obj)
+        # the TRUE shape, captured before ascontiguousarray (which
+        # promotes 0-d to (1,)) — shape fidelity feeds the compression
+        # layer's structure fingerprints
+        shape = list(arr.shape)
+        arr = np.ascontiguousarray(arr)
+        # flat byte view (len == nbytes even for ndim>1), no copy; a
+        # zero-size leaf has no castable view — ship an empty buffer slot
+        buffers.append(arr.data.cast("B") if arr.size else b"")
         return {"t": "a", _LEAF: len(buffers) - 1, "dtype": arr.dtype.str,
-                "shape": list(arr.shape)}
+                "shape": shape}
     if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
         return {"t": "s", "v": obj}
     if isinstance(obj, (np.integer, np.floating, np.bool_)):
@@ -60,19 +66,43 @@ def _decode(spec: Any, buffers: List[memoryview]) -> Any:
     return spec["v"]
 
 
-def dumps(tree: Any) -> bytes:
-    """Serialize a pytree of arrays/scalars into one contiguous frame."""
+#: the frame header is length-prefixed with a u32 — a header that does not
+#: fit would silently truncate its own length field and desync every
+#: subsequent frame on the stream, so refuse loudly instead. (Raw array
+#: buffers are NOT subject to this cap: they ride after the header and the
+#: transports use u64 frame lengths.)
+_MAX_HEADER = (1 << 32) - 1
+
+
+def dumps_parts(tree: Any) -> List[Any]:
+    """Serialize to the frame's constituent buffers WITHOUT joining them:
+    ``[u32 len][msgpack header][raw buffer 0][raw buffer 1]...`` as a list.
+
+    Chunk-aware transports (tcp.send_frame, the gRPC streaming call) write
+    the parts straight to the socket, so a multi-hundred-MB model update
+    never materializes as one contiguous copy on the send path.
+    """
     buffers: List[bytes] = []
     spec = _encode(tree, buffers)
     header = msgpack.packb(
         {"spec": spec, "sizes": [len(b) for b in buffers]})
-    parts = [struct.pack("<I", len(header)), header]
-    parts.extend(buffers)
-    return b"".join(parts)
+    if len(header) > _MAX_HEADER:
+        raise ValueError(
+            f"serialized header is {len(header)} bytes — larger than the "
+            "u32 length prefix can carry; refusing to emit a torn frame "
+            "(payload metadata this large means a pathological tree, not "
+            "a big model: array bytes don't count against this cap)")
+    return [struct.pack("<I", len(header)), header, *buffers]
 
 
-def loads(frame: bytes) -> Any:
-    """Decode a frame produced by ``dumps`` with numpy views into ``frame``."""
+def dumps(tree: Any) -> bytes:
+    """Serialize a pytree of arrays/scalars into one contiguous frame."""
+    return b"".join(dumps_parts(tree))
+
+
+def loads(frame) -> Any:
+    """Decode a frame produced by ``dumps`` with numpy views into ``frame``
+    (any buffer type: bytes, bytearray, memoryview)."""
     view = memoryview(frame)
     (hlen,) = struct.unpack_from("<I", view, 0)
     header = msgpack.unpackb(bytes(view[4:4 + hlen]))

@@ -20,24 +20,45 @@ from fedml_tpu.comm.message import Message
 
 _LEN = struct.Struct("<Q")
 _STOP = object()
+_CHUNK = 1 << 20  # per-recv_into slice; bounds kernel copy granularity
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes into ONE preallocated buffer.
+
+    ``recv_into`` on memoryview slices replaces the old chunks-list +
+    ``b"".join`` pattern, which held a multi-hundred-MB model frame in
+    memory TWICE (the chunk list plus the joined copy) at the join point.
+    """
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:got + min(n - got, _CHUNK)])
+        if r == 0:
             raise ConnectionError("peer closed")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
-def send_frame(sock: socket.socket, frame: bytes) -> None:
-    sock.sendall(_LEN.pack(len(frame)) + frame)
+def send_frame(sock: socket.socket, frame) -> int:
+    """Write a length-prefixed frame; returns the payload byte count.
+
+    ``frame`` is one bytes-like object OR a list of buffers (a
+    ``serialization.dumps_parts`` output): parts are written straight to
+    the socket, so serialization and socket I/O overlap instead of first
+    materializing one contiguous frame copy.
+    """
+    parts = ((frame,) if isinstance(frame, (bytes, bytearray, memoryview))
+             else tuple(frame))
+    total = sum(len(p) for p in parts)
+    sock.sendall(_LEN.pack(total))
+    for p in parts:
+        sock.sendall(p)
+    return total
 
 
-def recv_frame(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket) -> bytearray:
     (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     return _recv_exact(sock, size)
 
@@ -52,7 +73,8 @@ class _Peer:
         self.lock = threading.Lock()
         self.sock: socket.socket | None = None
 
-    def send(self, frame: bytes) -> None:
+    def send(self, frame) -> None:
+        """``frame``: bytes-like or a parts list (see ``send_frame``)."""
         with self.lock:
             if self.sock is None:
                 self.sock = socket.create_connection(self.address, timeout=30)
@@ -105,12 +127,18 @@ class TcpCommManager(BaseCommunicationManager):
             peer = self._peers.get(dest)
             if peer is None:
                 peer = self._peers[dest] = _Peer(self.addresses[dest])
-        peer.send(msg.to_bytes())
+        # parts, not one joined frame: a model update goes header-then-
+        # buffers straight to the socket with no contiguous copy
+        parts = msg.to_parts()
+        peer.send(parts)
+        self._count_sent(sum(len(p) for p in parts))
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while self._running:
-                self._inbox.put(recv_frame(conn))
+                frame = recv_frame(conn)
+                self._count_received(len(frame))
+                self._inbox.put(frame)
         except (ConnectionError, OSError):
             pass
         finally:

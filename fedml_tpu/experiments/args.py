@@ -87,9 +87,20 @@ def add_federated_args(parser: argparse.ArgumentParser):
     parser.add_argument("--use_wandb", action="store_true")
     parser.add_argument("--checkpoint_dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--compression", type=str, default=None,
+                        help="cross-silo wire policy: none | delta_int8 | "
+                             "topk_ef | topk_ef_int8 (append :frac for the "
+                             "top-k keep fraction, e.g. topk_ef_int8:0.05). "
+                             "Compresses BOTH directions: uplink deltas "
+                             "(with error feedback for top-k) and downlink "
+                             "broadcasts against the silo mirror. "
+                             "$FEDML_TPU_COMPRESSION overrides. FedAsync "
+                             "warns and stays full precision.")
     parser.add_argument("--compress", action="store_true",
-                        help="int8 delta compression for client->server "
-                             "model updates (cross-silo backends)")
+                        help="deprecated: the exact pre-policy behavior "
+                             "(uplink int8 model-update deltas only, "
+                             "full-precision broadcasts) — use "
+                             "--compression for the bidirectional stack")
     parser.add_argument("--ci", type=int, default=0,
                         help="1 = tiny smoke-run truncation (reference --ci)")
     return parser

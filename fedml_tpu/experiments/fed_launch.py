@@ -216,6 +216,8 @@ def run_algo(args):
             comm_round=args.comm_round, train_cfg=tcfg, seed=args.seed,
             checkpoint_dir=args.checkpoint_dir or None,
             resume=args.resume,
+            compress=getattr(args, "compress", False),
+            compression=getattr(args, "compression", None),
             prefetch_depth=getattr(args, "prefetch_depth", 2),
             # scale the join budget with the local work — on a 1-core
             # host the silo threads SERIALIZE, so the budget grows with
@@ -483,7 +485,9 @@ def run_algo(args):
             comm_round=args.comm_round, quorum=args.quorum,
             round_deadline_s=args.round_deadline_s,
             alpha=args.async_alpha, poly_a=args.async_poly_a,
-            max_updates=args.max_updates, train_cfg=tcfg, seed=args.seed)
+            max_updates=args.max_updates, train_cfg=tcfg, seed=args.seed,
+            # fedasync mode warns and forces full precision inside
+            compression=getattr(args, "compression", None))
         for rec in history:
             sink.log(rec, step=rec["round"])
         final = dict(history[-1]) if history else {}

@@ -125,6 +125,7 @@ def run_cross_silo(args, ds, model, task, sink):
         comm_round=args.comm_round, train_cfg=make_train_config(args),
         backend=args.backend, addresses=addresses,
         compress=getattr(args, "compress", False),
+        compression=getattr(args, "compression", None),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         prefetch_depth=getattr(args, "prefetch_depth", 2),
         # fedopt-style server step when the launcher passes the fedopt flags

@@ -45,9 +45,23 @@ class RoundTimer:
             self.counts[name] += 1
 
     def count(self, name: str, n: int = 1) -> None:
-        """Bump an event counter (e.g. ``prefetch_hit``/``prefetch_miss``)."""
+        """Bump an event counter (e.g. ``prefetch_hit``/``prefetch_miss``,
+        or the wire accounting ``comm_bytes_up``/``comm_bytes_down``)."""
         with self._lock:
             self.counters[name] += n
+
+    @property
+    def comm_bytes_up(self) -> int:
+        """Client->server wire bytes (actual encoded frame lengths,
+        credited by the cross-silo launcher from the comm backends)."""
+        with self._lock:
+            return self.counters["comm_bytes_up"]
+
+    @property
+    def comm_bytes_down(self) -> int:
+        """Server->client wire bytes (actual encoded frame lengths)."""
+        with self._lock:
+            return self.counters["comm_bytes_down"]
 
     def means(self) -> Dict[str, float]:
         with self._lock:

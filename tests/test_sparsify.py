@@ -1,0 +1,79 @@
+"""Top-k sparsification kernels + error-feedback identities."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.ops.sparsify import (k_for, topk_densify, topk_dequantize,
+                                    topk_quantize, topk_sparsify)
+
+
+class TestKFor:
+    def test_ceil_and_clamps(self):
+        assert k_for(1000, 0.01) == 10
+        assert k_for(1001, 0.01) == 11       # ceil, not floor
+        assert k_for(3, 0.01) == 1           # never zero
+        assert k_for(10, 1.0) == 10          # never above d
+        with pytest.raises(ValueError, match="fraction"):
+            k_for(10, 0.0)
+        with pytest.raises(ValueError, match="fraction"):
+            k_for(10, 1.5)
+
+
+class TestTopkSparsify:
+    def test_selects_largest_magnitudes(self):
+        x = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.05, 0.0, 4.0, -2.0])
+        idx, vals, residual = topk_sparsify(x, 3)
+        assert sorted(np.asarray(idx).tolist()) == [1, 3, 6]
+        # values are the ORIGINAL signed entries, not |x|
+        got = dict(zip(np.asarray(idx).tolist(), np.asarray(vals).tolist()))
+        assert got[1] == -5.0 and got[3] == 3.0 and got[6] == 4.0
+
+    def test_residual_plus_densified_is_identity(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1000), jnp.float32)
+        idx, vals, residual = topk_sparsify(x, 50)
+        dense = topk_densify(idx, vals, 1000)
+        np.testing.assert_array_equal(np.asarray(dense + residual),
+                                      np.asarray(x))
+        # the residual is exactly zero at every selected index
+        assert not np.any(np.asarray(residual)[np.asarray(idx)])
+
+
+class TestTopkQuantize:
+    def test_error_feedback_identity(self):
+        """densify(wire) + residual == x: the EF loop sees the EXACT
+        wire-vs-truth gap, including int8 rounding of the survivors."""
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(2048) * 0.1, jnp.float32)
+        idx, q, scales, residual = topk_quantize(x, jax.random.key(0), 128,
+                                                 interpret=True)
+        dense = topk_dequantize(idx, q, scales, 2048, interpret=True)
+        np.testing.assert_allclose(np.asarray(dense + residual),
+                                   np.asarray(x), rtol=0, atol=1e-6)
+
+    def test_survivor_quantization_bounded(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(512), jnp.float32)
+        k = 64
+        idx, q, scales, _ = topk_quantize(x, jax.random.key(1), k,
+                                          interpret=True)
+        dense = np.asarray(topk_dequantize(idx, q, scales, 512,
+                                           interpret=True))
+        sel = np.asarray(idx)
+        err = np.abs(dense[sel] - np.asarray(x)[sel])
+        # one stochastic-rounding step of the survivors' block absmax
+        step = np.abs(np.asarray(x)[sel]).max() / 127.0
+        assert err.max() <= 1.5 * step
+
+    def test_unselected_entries_ship_zero(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(256), jnp.float32)
+        idx, q, scales, _ = topk_quantize(x, jax.random.key(2), 16,
+                                          interpret=True)
+        dense = np.asarray(topk_dequantize(idx, q, scales, 256,
+                                           interpret=True))
+        mask = np.ones(256, bool)
+        mask[np.asarray(idx)] = False
+        assert not np.any(dense[mask])
