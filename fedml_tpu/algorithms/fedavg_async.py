@@ -26,66 +26,39 @@ replies (``MSG_ARG_KEY_ROUND``, already part of every S2C message).
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
 from fedml_tpu.algorithms.fedavg_cross_silo import (
     MSG_ARG_KEY_CLIENT_INDEX, MSG_ARG_KEY_MODEL_PARAMS,
     MSG_ARG_KEY_NUM_SAMPLES, MSG_ARG_KEY_ROUND, MSG_TYPE_C2S_SEND_MODEL,
-    MSG_TYPE_S2C_FINISH, MSG_TYPE_S2C_INIT_CONFIG, MSG_TYPE_S2C_SYNC_MODEL,
-    FedAvgAggregator, FedAvgClientManager, FedAvgServerManager,
-    _DEVICE_LOCK, _to_numpy)
+    MSG_TYPE_ROUND_TIMEOUT, MSG_TYPE_S2C_FINISH, MSG_TYPE_S2C_INIT_CONFIG,
+    MSG_TYPE_S2C_SYNC_MODEL, FedAvgAggregator, FedAvgClientManager,
+    FedAvgServerManager, _DEVICE_LOCK, _to_numpy)
 from fedml_tpu.comm.message import Message
 from fedml_tpu.core import pytree as pt
 
-MSG_TYPE_ROUND_TIMEOUT = 9
-
 
 class QuorumFedAvgServerManager(FedAvgServerManager):
-    """All-received barrier relaxed to (all | deadline & quorum)."""
+    """All-received barrier relaxed to (all | deadline & quorum).
+
+    The deadline-timer plumbing (self-addressed TIMEOUT ticks, arm on
+    every broadcast) is the parent's; only the CLOSE policy differs —
+    an absolute ``quorum`` count instead of the parent's
+    live-set-fraction + eviction semantics."""
 
     def __init__(self, *args, quorum: int = 1,
                  round_deadline_s: float = 10.0, **kw):
+        # the parent's deadline kwarg stays None: quorum keeps its own
+        # timeout policy (no liveness eviction), but reuses the timer
+        # by setting round_deadline_s after init
         super().__init__(*args, **kw)
         if not (1 <= quorum <= self.worker_num):
             raise ValueError(f"quorum {quorum} outside [1, {self.worker_num}]")
         self.quorum = quorum
         self.round_deadline_s = round_deadline_s
-        self._timer: Optional[threading.Timer] = None
         self.partial_rounds: List[int] = []  # rounds closed below strength
-
-    # -- timer plumbing (single-threaded state machine preserved) ----------
-    def _arm_deadline(self) -> None:
-        self._cancel_deadline()
-        round_idx = self.round_idx
-
-        def fire():
-            tick = Message(MSG_TYPE_ROUND_TIMEOUT, self.rank, self.rank)
-            tick.add(MSG_ARG_KEY_ROUND, round_idx)
-            try:
-                self.send_message(tick)
-            except OSError:  # backend already shut down
-                pass
-
-        self._timer = threading.Timer(self.round_deadline_s, fire)
-        self._timer.daemon = True
-        self._timer.start()
-
-    def _cancel_deadline(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
-
-    def send_init_msg(self) -> None:
-        super().send_init_msg()
-        self._arm_deadline()
-
-    def register_message_receive_handlers(self) -> None:
-        super().register_message_receive_handlers()
-        self.register_message_receive_handler(MSG_TYPE_ROUND_TIMEOUT,
-                                              self.handle_round_timeout)
 
     # -- protocol ----------------------------------------------------------
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
@@ -95,6 +68,7 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
         self._note_worker_base(msg)
         if msg.get_params().get(MSG_ARG_KEY_ROUND,
                                 self.round_idx) != self.round_idx:
+            self.ft_counters["stale_replies"] += 1
             return  # stale straggler reply from a closed round: discard
         worker = msg.get_sender_id() - 1
         with _DEVICE_LOCK:  # delta decompression is device compute
@@ -103,7 +77,9 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
         self.aggregator.add_local_trained_result(
             worker, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES))
         if self.aggregator.check_whether_all_receive():
-            self._close_round()
+            # all reported: aggregate_available == aggregate, and the
+            # flag array was just reset by the barrier check
+            self._close_round(partial=True)
 
     def handle_round_timeout(self, msg: Message) -> None:
         if msg.get(MSG_ARG_KEY_ROUND) != self.round_idx:
@@ -111,41 +87,13 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
         received = self.aggregator.received_count()
         if received >= self.quorum:
             self.partial_rounds.append(self.round_idx)
-            self._close_round()
+            # shared broadcast incl. the downlink compression path: every
+            # silo receives every broadcast in order (reliable
+            # transports), so stragglers stay based even when their
+            # replies are discarded
+            self._close_round(partial=True)
         else:
             self._arm_deadline()  # below quorum: keep waiting
-
-    def _close_round(self) -> None:
-        # NOTE: in single-process actor mode the lock below also waits for
-        # any straggler local_train already ON the shared device — the
-        # deadline can fire at t but the close lands when the device frees
-        # up. That is shared-chip physics (one dispatch queue), not a
-        # protocol property; multi-process deployments (one device per
-        # silo) close at the deadline proper.
-        self._cancel_deadline()
-        with _DEVICE_LOCK:  # aggregate: device compute
-            self.global_model = self.aggregator.aggregate_available()
-        if self.on_round_done is not None:
-            # outside the lock: eval re-locks internally, sink I/O doesn't
-            self.on_round_done(self.round_idx, self.global_model)
-        self.round_idx += 1
-        if self.round_idx == self.comm_round:
-            for worker in range(1, self.size):
-                self.send_message(
-                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
-            self.finish()
-            return
-        idxs = self.aggregator.client_sampling(
-            self.round_idx, self.client_num_in_total, self.worker_num)
-        # shared broadcast incl. the downlink compression path: every
-        # silo receives every broadcast in order (reliable transports),
-        # so stragglers stay based even when their replies are discarded
-        self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL, idxs)
-        self._arm_deadline()
-
-    def finish(self) -> None:
-        self._cancel_deadline()
-        super().finish()
 
 
 class AsyncFedAvgServerManager(FedAvgServerManager):
@@ -240,7 +188,8 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                      train_cfg=None, seed: int = 0,
                      backend: str = "INPROC", addresses=None,
                      wire_codec: bool = False, compression=None,
-                     timer=None):
+                     timer=None, heartbeat_s: float = 0.0,
+                     fault_plan=None):
     """Launch a straggler-tolerant federation (server + worker silos as
     actor threads over any comm backend) and block until it completes.
     ``mode="quorum"`` closes rounds at (all | deadline & quorum);
@@ -296,4 +245,5 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                              server_factory, backend=backend,
                              addresses=addresses, seed=seed,
                              wire_codec=wire_codec, compression=policy,
-                             timer=timer, raise_on_timeout=True)
+                             timer=timer, raise_on_timeout=True,
+                             heartbeat_s=heartbeat_s, fault_plan=fault_plan)

@@ -34,8 +34,11 @@ frames into the launcher's RoundTimer (``comm_bytes_up``/``_down``).
 from __future__ import annotations
 
 import logging
+import math
 import os
 import threading
+import time
+from collections import defaultdict
 from typing import Dict, List, Optional
 
 import jax
@@ -51,12 +54,22 @@ from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.trainer.functional import (TrainConfig, make_eval,
                                           make_local_train, round_lr_scale)
+from fedml_tpu.utils.watchdog import SiloLivenessTable
 
 # -- message schema (reference message_define.py) ---------------------------
 MSG_TYPE_S2C_INIT_CONFIG = 1
 MSG_TYPE_S2C_SYNC_MODEL = 2
 MSG_TYPE_S2C_FINISH = 3
 MSG_TYPE_C2S_SEND_MODEL = 4
+#: self-addressed deadline tick (the quorum/deadline servers' timer posts
+#: it so the state machine stays single-threaded)
+MSG_TYPE_ROUND_TIMEOUT = 9
+#: periodic proof of life from an idle silo; ANY inbound silo message
+#: (model replies included) also beats the server's liveness table
+MSG_TYPE_C2S_HEARTBEAT = 10
+#: a restarted or evicted silo asking back in; the server re-admits it
+#: with a full-precision resync of the silo mirror
+MSG_TYPE_C2S_JOIN = 11
 
 MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
 MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
@@ -69,6 +82,9 @@ MSG_ARG_KEY_BASE_SEQ = "base_seq"
 #: structure fingerprint of the silo's held model — the server's
 #: automatic full-precision fallback trigger on mismatch
 MSG_ARG_KEY_BASE_FP = "base_fp"
+#: JOIN payload: how many rounds the (re)joining silo completed before it
+#: went away — logged, and available for smarter re-admission policies
+MSG_ARG_KEY_ROUNDS_COMPLETED = "rounds_completed"
 
 #: All silo actors in one process share one physical device, which has ONE
 #: dispatch queue anyway — serializing jax compute across actor threads
@@ -166,11 +182,29 @@ class FedAvgAggregator:
 
 
 class FedAvgServerManager(ServerManager):
+    """Round-based cross-silo server.
+
+    Fault tolerance (opt-in via ``round_deadline_s``): the all-received
+    barrier is taken against the LIVE silo set (a per-silo
+    ``SiloLivenessTable`` beaten by every inbound silo message); when the
+    per-round deadline passes with at least
+    ``ceil(min_quorum_frac * live)`` reports in, the round closes with a
+    weighted PARTIAL aggregate and the non-reporting silos are EVICTED
+    from the live set (their pending EF residual mass is dropped — the
+    documented quorum-discard loss class). An evicted or restarted silo
+    sends JOIN and is re-admitted with a full-precision resync of the
+    silo mirror, so the downlink compression chain stays coherent.
+    Without ``round_deadline_s`` the behavior is the original strict
+    all-of-``worker_num`` barrier, unchanged.
+    """
+
     def __init__(self, rank: int, size: int, com_manager,
                  aggregator: FedAvgAggregator, comm_round: int,
                  client_num_in_total: int, global_model,
                  on_round_done=None, checkpoint_mgr=None,
-                 resume: bool = False, compression=None):
+                 resume: bool = False, compression=None,
+                 round_deadline_s: Optional[float] = None,
+                 min_quorum_frac: float = 0.5):
         super().__init__(rank, size, com_manager)
         self.aggregator = aggregator
         self.comm_round = comm_round
@@ -180,6 +214,26 @@ class FedAvgServerManager(ServerManager):
         self.on_round_done = on_round_done
         self.worker_num = size - 1
         self.checkpoint_mgr = checkpoint_mgr
+        # -- fault tolerance (liveness / deadline / eviction / rejoin) ------
+        if not 0.0 < min_quorum_frac <= 1.0:
+            raise ValueError(f"min_quorum_frac must be in (0, 1], got "
+                             f"{min_quorum_frac}")
+        self.round_deadline_s = round_deadline_s
+        self.min_quorum_frac = min_quorum_frac
+        #: deadline-evicted straggler semantics ON (False = the strict
+        #: all-received barrier; the quorum subclass reuses the timer
+        #: plumbing but keeps its own absolute-quorum policy)
+        self._evict_on_deadline = bool(round_deadline_s
+                                       and round_deadline_s > 0)
+        self.liveness = SiloLivenessTable(range(self.worker_num))
+        #: per-round {round, reported, live, partial} records (FT mode)
+        self.live_history: List[Dict] = []
+        self.ft_counters: Dict[str, int] = defaultdict(int)
+        self._timer: Optional[threading.Timer] = None
+        #: worker -> round of its last JOIN resync: a silo retrying JOIN on
+        #: its heartbeat cadence gets ONE full-model resync per round, not
+        #: one per tick (full-precision frames are the expensive ones)
+        self._resynced_round: Dict[int, int] = {}
         # -- downlink compression state (comm/policy.py) --------------------
         self._policy = resolve_compression(compression)
         self._bcast_seq = -1
@@ -209,29 +263,90 @@ class FedAvgServerManager(ServerManager):
     def _load_state(self, state) -> None:
         self.global_model = state["variables"]
 
-    def _aggregate_round(self):
-        """Close the round: default is the plain sample-weighted average;
-        FedOpt overrides with a persistent server-optimizer step."""
-        return self.aggregator.aggregate()
+    def _aggregate_round(self, partial: bool = False):
+        """Close the round: default is the plain sample-weighted average
+        (over every reporter when ``partial`` — the weighted
+        straggler-tolerant close); FedOpt overrides with a persistent
+        server-optimizer step."""
+        return (self.aggregator.aggregate_available() if partial
+                else self.aggregator.aggregate())
 
     def send_init_msg(self) -> None:
         if self.round_idx >= self.comm_round:
             # resumed from a checkpoint of an already-finished run
-            for worker in range(1, self.size):
-                self.send_message(
-                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
-            self.finish()
+            self._finish_federation()
             return
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
         # first broadcast of a (possibly resumed) run: the mirror is unset,
         # so _encode_broadcast sends full precision and (re)bases everyone
         self._broadcast_model(MSG_TYPE_S2C_INIT_CONFIG, idxs)
+        self._arm_deadline()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_MODEL,
             self.handle_message_receive_model_from_client)
+        self.register_message_receive_handler(
+            MSG_TYPE_ROUND_TIMEOUT, self.handle_round_timeout)
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_HEARTBEAT, self.handle_message_heartbeat)
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_JOIN, self.handle_message_join)
+
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        # liveness piggybacks on EVERY inbound silo message — a silo
+        # mid-local-train proves life with its reply, idle silos with the
+        # periodic heartbeat
+        sender = msg.get_sender_id()
+        if sender != self.rank:
+            self.liveness.beat(sender - 1)
+        super().receive_message(msg_type, msg)
+
+    # -- deadline timer (single-threaded state machine preserved) -----------
+    def _arm_deadline(self) -> None:
+        """Post a self-addressed TIMEOUT tick ``round_deadline_s`` from
+        now (no-op without a deadline). The timer thread never touches
+        protocol state — the tick rides the normal receive loop."""
+        if not self.round_deadline_s:
+            return
+        self._cancel_deadline()
+        round_idx = self.round_idx
+
+        def fire():
+            tick = Message(MSG_TYPE_ROUND_TIMEOUT, self.rank, self.rank)
+            tick.add(MSG_ARG_KEY_ROUND, round_idx)
+            try:
+                self.send_message(tick)
+            except OSError as exc:  # backend already shut down
+                logging.debug("round-%d deadline tick not delivered (%r)",
+                              round_idx, exc)
+
+        self._timer = threading.Timer(self.round_deadline_s, fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _cancel_deadline(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def finish(self) -> None:
+        self._cancel_deadline()
+        super().finish()
+
+    def _finish_federation(self) -> None:
+        """FINISH every silo (evicted ones included — a dead peer's send
+        failure is logged, not fatal: the federation is done either way)
+        and stop the server loop."""
+        for worker in range(1, self.size):
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
+            except OSError as exc:
+                logging.warning("FINISH to silo %d failed (%r) — peer "
+                                "already gone", worker, exc)
+        self.finish()
 
     # -- downlink compression (comm/policy.py, comm/compression.py) ---------
     def _silos_in_sync(self) -> bool:
@@ -297,15 +412,33 @@ class FedAvgServerManager(ServerManager):
         return payload
 
     def _broadcast_model(self, msg_type: int, idxs) -> None:
-        """One shared payload (full or mirror-delta) to every silo."""
+        """One shared payload (full or mirror-delta) to every silo.
+
+        FT mode broadcasts to the LIVE set only (evicted silos come back
+        through JOIN + resync, never a shared compressed delta they have
+        no base for), and a send that exhausts its transport retries
+        evicts the peer instead of killing the server loop."""
         payload = self._encode_broadcast()
+        live = self.liveness.live_workers()
         for worker in range(1, self.size):
+            if self._evict_on_deadline and (worker - 1) not in live:
+                continue
             msg = Message(msg_type, self.rank, worker)
             msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
             msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
             msg.add(MSG_ARG_KEY_BCAST_SEQ, self._bcast_seq)
-            self.send_message(msg)
+            try:
+                self.send_message(msg)
+            except OSError as exc:
+                if not self._evict_on_deadline:
+                    raise
+                if self.liveness.evict(worker - 1):
+                    self._worker_base.pop(worker - 1, None)
+                    logging.warning(
+                        "broadcast to silo %d failed after transport "
+                        "retries (%r) — EVICTED from the live set; it "
+                        "re-admits via JOIN", worker, exc)
 
     def _note_worker_base(self, msg: Message) -> None:
         """Record which model version/structure the silo reports holding
@@ -330,15 +463,70 @@ class FedAvgServerManager(ServerManager):
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         worker = msg.get_sender_id() - 1
         self._note_worker_base(msg)
-        with _DEVICE_LOCK:  # delta decompression is device compute
-            payload = self._decode_model_payload(
-                msg.get(MSG_ARG_KEY_MODEL_PARAMS))
+        if self._evict_on_deadline:
+            r = msg.get_params().get(MSG_ARG_KEY_ROUND, self.round_idx)
+            if r != self.round_idx:
+                # a straggler's reply for an already-closed round: its
+                # update is stale against the advanced global — discard
+                # (the silo stays live; it got/gets the next broadcast)
+                self.ft_counters["stale_replies"] += 1
+                return
+            if self.liveness.admit(worker):
+                # a current-round reply from an evicted silo IS proof of
+                # life and a usable contribution — re-admit
+                logging.info("silo %d re-admitted on a live round-%d "
+                             "reply", worker + 1, r)
+        try:
+            with _DEVICE_LOCK:  # delta decompression is device compute
+                payload = self._decode_model_payload(
+                    msg.get(MSG_ARG_KEY_MODEL_PARAMS))
+        except Exception:
+            if not self._evict_on_deadline:
+                raise
+            # corrupted frame (the payload-level guards — structure
+            # fingerprint, top-k index bounds — refused to rebuild):
+            # drop the reply, poison the silo's reported base so the next
+            # broadcast falls back to FULL precision via _silos_in_sync,
+            # and let the deadline close the round without this reply
+            self.ft_counters["corrupt_frames"] += 1
+            self._worker_base[worker] = (-2, "corrupt-frame")
+            logging.warning(
+                "silo %d round-%d reply failed to decode — dropping the "
+                "reply and forcing a full-precision rebase", worker + 1,
+                self.round_idx, exc_info=True)
+            return
         self.aggregator.add_local_trained_result(
             worker, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES))
-        if not self.aggregator.check_whether_all_receive():
+        if self._evict_on_deadline:
+            live = self.liveness.live_workers()
+            reported = set(self.aggregator.model_dict)
+            if live <= reported:
+                self._close_round(partial=len(reported) < self.worker_num)
             return
+        if self.aggregator.check_whether_all_receive():
+            self._close_round()
+
+    def _close_round(self, partial: bool = False) -> None:
+        """Aggregate (full or weighted-partial), advance, broadcast the
+        next round or FINISH. Shared by the strict barrier, the
+        deadline-eviction close, and the quorum subclass."""
+        # NOTE: in single-process actor mode the device lock below also
+        # waits for any straggler local_train already ON the shared device
+        # — a deadline can fire at t but the close lands when the device
+        # frees up. That is shared-chip physics (one dispatch queue), not
+        # a protocol property; multi-process deployments (one device per
+        # silo) close at the deadline proper.
+        self._cancel_deadline()
+        if self._evict_on_deadline:
+            self.live_history.append({
+                "round": self.round_idx,
+                "reported": sorted(self.aggregator.model_dict),
+                "live": sorted(self.liveness.live_workers()),
+                "partial": bool(partial)})
+            if partial:
+                self.ft_counters["partial_rounds"] += 1
         with _DEVICE_LOCK:
-            self.global_model = self._aggregate_round()
+            self.global_model = self._aggregate_round(partial=partial)
         if self.on_round_done is not None:
             # outside the lock: eval re-locks internally, sink I/O doesn't
             self.on_round_done(self.round_idx, self.global_model)
@@ -347,14 +535,101 @@ class FedAvgServerManager(ServerManager):
             self.checkpoint_mgr.save(self.round_idx,
                                      self._checkpoint_state())
         if self.round_idx == self.comm_round:
-            for worker in range(1, self.size):
-                self.send_message(
-                    Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
-            self.finish()
+            self._finish_federation()
             return
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
         self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL, idxs)
+        self._arm_deadline()
+
+    # -- fault-tolerance handlers (deadline / heartbeat / rejoin) -----------
+    def handle_round_timeout(self, msg: Message) -> None:
+        """Deadline policy: close with a weighted partial aggregate once
+        ≥ ceil(min_quorum_frac · live) reports are in, EVICTING the
+        non-reporting live silos; below quorum, extend the deadline (a
+        premature close with almost no mass would poison the global
+        model). The quorum subclass overrides with its absolute-count
+        policy."""
+        if msg.get(MSG_ARG_KEY_ROUND) != self.round_idx:
+            return  # timer from an already-closed round
+        if not self._evict_on_deadline:
+            return
+        live = self.liveness.live_workers()
+        reported = set(self.aggregator.model_dict)
+        need = max(1, math.ceil(self.min_quorum_frac * max(1, len(live))))
+        if len(reported) < need:
+            self.ft_counters["deadline_extensions"] += 1
+            logging.warning(
+                "round %d deadline passed with %d/%d reports (quorum %d) "
+                "— extending the deadline", self.round_idx, len(reported),
+                len(live), need)
+            self._arm_deadline()
+            return
+        for w in sorted(live - reported):
+            if self.liveness.evict(w):
+                self._worker_base.pop(w, None)
+                logging.warning(
+                    "silo %d missed the %.1fs round-%d deadline — "
+                    "EVICTED from the live set (its pending "
+                    "error-feedback residual mass is dropped: the same "
+                    "loss class as the quorum server's stale-reply "
+                    "discard; it re-admits via JOIN with a full resync)",
+                    w + 1, self.round_deadline_s, self.round_idx)
+        self._close_round(partial=True)
+
+    def handle_message_heartbeat(self, msg: Message) -> None:
+        # the beat itself landed in receive_message; the handler only
+        # keeps the count observable
+        self.ft_counters["heartbeats"] += 1
+
+    def handle_message_join(self, msg: Message) -> None:
+        """Re-admit a restarted/evicted silo: mark live, forget its stale
+        base report, and resync it with the FULL-precision silo mirror —
+        the model every in-sync silo currently holds — so the shared
+        downlink compression chain stays coherent (the rejoined silo
+        decodes the next mirror delta like everyone else)."""
+        worker = msg.get_sender_id() - 1
+        done = msg.get_params().get(MSG_ARG_KEY_ROUNDS_COMPLETED, None)
+        if self.liveness.is_live(worker) \
+                and worker in self.aggregator.model_dict:
+            # a live silo that already reported this round is just waiting
+            # out the deadline with us — it is not lost, so no resync
+            # (which would only trigger a redundant retrain)
+            return
+        self.liveness.admit(worker)
+        self._worker_base.pop(worker, None)
+        if not self._evict_on_deadline:
+            # strict-barrier server: JOIN is proof of life only (a resync
+            # reply could double-feed the all-received barrier)
+            return
+        if self.round_idx >= self.comm_round:
+            return  # schedule done; _finish_federation already ran/runs
+        if self._resynced_round.get(worker) == self.round_idx:
+            return  # already resynced this round; its reply is in flight
+        self._resynced_round[worker] = self.round_idx
+        self.ft_counters["join_resyncs"] += 1
+        logging.info(
+            "silo %d JOIN (rounds_completed=%s) — re-admitted with a "
+            "full-precision mirror resync at round %d", worker + 1, done,
+            self.round_idx)
+        if self._mirror is not None:
+            payload = self._mirror
+        else:
+            with _DEVICE_LOCK:  # D2H transfer is a device dispatch
+                payload = _to_numpy(self.global_model)
+        idxs = self.aggregator.client_sampling(
+            self.round_idx, self.client_num_in_total, self.worker_num)
+        out = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, worker + 1)
+        out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+        out.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker]))
+        out.add(MSG_ARG_KEY_ROUND, self.round_idx)
+        out.add(MSG_ARG_KEY_BCAST_SEQ, self._bcast_seq)
+        try:
+            self.send_message(out)
+        except OSError as exc:
+            if self.liveness.evict(worker):
+                logging.warning("resync to rejoining silo %d failed "
+                                "(%r) — evicted again", worker + 1, exc)
 
 
 class FedOptServerManager(FedAvgServerManager):
@@ -399,8 +674,9 @@ class FedOptServerManager(FedAvgServerManager):
         self.global_model = state["variables"]
         self.server_opt_state = state["server_opt"]
 
-    def _aggregate_round(self):
-        avg = self.aggregator.aggregate()
+    def _aggregate_round(self, partial: bool = False):
+        avg = (self.aggregator.aggregate_available() if partial
+               else self.aggregator.aggregate())
         new_params, self.server_opt_state = self._opt_step(
             self.global_model["params"], avg["params"],
             self.server_opt_state)
@@ -418,9 +694,32 @@ class FedAvgClientManager(ClientManager):
                  train_cfg: TrainConfig, seed: int = 0,
                  compress: bool = False, compression=None,
                  state_dir: Optional[str] = None, resume: bool = False,
-                 prefetch_depth: int = 2):
+                 prefetch_depth: int = 2,
+                 heartbeat_s: float = 0.0,
+                 rejoin_idle_s: Optional[float] = None,
+                 join_on_start: bool = False):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
+        # -- fault tolerance ------------------------------------------------
+        #: periodic proof of life (0 = off, the legacy behavior); the
+        #: server ALSO counts every reply as a beat, so the periodic
+        #: message only matters while this silo is idle
+        self.heartbeat_s = float(heartbeat_s or 0.0)
+        #: no server traffic for this long -> assume evicted/forgotten and
+        #: send JOIN (the rejoin protocol's client half); default 3 beats
+        self.rejoin_idle_s = (rejoin_idle_s if rejoin_idle_s is not None
+                              else 3.0 * self.heartbeat_s)
+        #: a RESTARTED silo announces itself instead of waiting for a
+        #: broadcast that will never come (it is not in the live set)
+        self.join_on_start = bool(join_on_start)
+        self.rounds_completed = 0
+        self._last_s2c = time.monotonic()
+        #: True while a broadcast handler (local training) is running —
+        #: the heartbeat thread must not mistake a long local_train for
+        #: an eviction and escalate to JOIN mid-round
+        self._busy = False
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
         from fedml_tpu.trainer.functional import validate_accum_steps
         validate_accum_steps(train_cfg, dataset.train_data_local_num_dict)
         self._local_train = _shared_local_train(module, task, train_cfg)
@@ -493,9 +792,53 @@ class FedAvgClientManager(ClientManager):
         self.register_message_receive_handler(
             MSG_TYPE_S2C_FINISH, self._handle_finish)
 
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        if self.join_on_start:
+            self._send_join()
+        if self.heartbeat_s > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"silo{self.rank}-heartbeat")
+            self._hb_thread.start()
+        try:
+            self.com_manager.handle_receive_message()
+        finally:
+            self._hb_stop.set()
+
+    def _send_join(self) -> None:
+        msg = Message(MSG_TYPE_C2S_JOIN, self.rank, 0)
+        msg.add(MSG_ARG_KEY_ROUNDS_COMPLETED, self.rounds_completed)
+        try:
+            self.send_message(msg)
+        except OSError as exc:
+            # the server itself may be down: the next heartbeat tick
+            # retries the JOIN (the transport already retried the send)
+            logging.warning("silo %d: JOIN not delivered (%r) — will "
+                            "retry on the heartbeat cadence", self.rank,
+                            exc)
+
+    def _heartbeat_loop(self) -> None:
+        """Periodic beat while idle; escalates to JOIN when the server has
+        been silent past ``rejoin_idle_s`` (we were evicted, or the
+        server restarted and forgot us)."""
+        while not self._hb_stop.wait(self.heartbeat_s):
+            idle = time.monotonic() - self._last_s2c
+            if not self._busy \
+                    and idle > max(self.rejoin_idle_s, self.heartbeat_s):
+                self._send_join()
+                continue
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_C2S_HEARTBEAT, self.rank, 0))
+            except OSError as exc:
+                logging.debug("silo %d heartbeat failed: %r", self.rank,
+                              exc)
+
     def _handle_finish(self, msg: Message) -> None:
         # nothing follows FINISH: release speculated shards + the worker
         # thread, then shut the protocol down
+        self._hb_stop.set()
         if self._prefetch is not None:
             self._prefetch.close()
         self.finish()
@@ -550,6 +893,18 @@ class FedAvgClientManager(ClientManager):
                                   {"residual": np.asarray(self._residual)})
 
     def handle_message_init(self, msg: Message) -> None:
+        self._last_s2c = time.monotonic()  # server traffic: not forgotten
+        # busy-flag the whole handler: local_train can legitimately run
+        # far longer than rejoin_idle_s, and the heartbeat thread must
+        # not read that as "the server forgot us" and JOIN mid-round
+        self._busy = True
+        try:
+            self._train_and_reply(msg)
+        finally:
+            self._busy = False
+            self._last_s2c = time.monotonic()
+
+    def _train_and_reply(self, msg: Message) -> None:
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = msg.get(MSG_ARG_KEY_ROUND)
         variables = self._apply_broadcast(msg)
@@ -616,6 +971,7 @@ class FedAvgClientManager(ClientManager):
         reply.add(MSG_ARG_KEY_BASE_SEQ, self._held_seq)
         reply.add(MSG_ARG_KEY_BASE_FP, tree_fingerprint(variables))
         self.send_message(reply)
+        self.rounds_completed += 1
 
 
 def run_fedavg_cross_silo(dataset: FederatedDataset, module,
@@ -635,7 +991,11 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           join_timeout_s: float = 600.0,
                           round_record_hook=None,
                           timer=None,
-                          prefetch_depth: int = 2):
+                          prefetch_depth: int = 2,
+                          round_deadline_s: Optional[float] = None,
+                          min_quorum_frac: float = 0.5,
+                          heartbeat_s: float = 0.0,
+                          fault_plan=None):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -643,7 +1003,16 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     none | delta_int8 | topk_ef | topk_ef_int8, a name or a
     CompressionPolicy); the legacy boolean ``compress`` maps to
     delta_int8. ``timer`` (a RoundTimer) receives the wire accounting
-    (``comm_bytes_up``/``comm_bytes_down`` from actual encoded frames).
+    (``comm_bytes_up``/``comm_bytes_down`` from actual encoded frames)
+    plus the fault-tolerance counters (retries, evictions, rejoins, ...).
+
+    Fault tolerance: ``round_deadline_s`` turns on deadline rounds —
+    the server closes with a weighted partial aggregate once the deadline
+    passes with ≥ ``min_quorum_frac`` of LIVE silos reported, evicting
+    the non-reporters; evicted/restarted silos rejoin via JOIN + a
+    full-precision mirror resync. ``heartbeat_s`` makes idle silos beat
+    (and auto-JOIN after ~3 silent beats). ``fault_plan`` (DSL/JSON, see
+    comm/faults.py) wraps every endpoint in the seeded chaos harness.
 
     The reference's equivalent is `mpirun -np worker_num+1 main_fedavg.py`
     (FedAvgAPI.py:20-67 rank dispatch); here ranks are threads over the
@@ -662,7 +1031,9 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                        on_round_done):
         common = dict(on_round_done=on_round_done,
                       checkpoint_mgr=checkpoint_mgr, resume=resume,
-                      compression=policy)
+                      compression=policy,
+                      round_deadline_s=round_deadline_s,
+                      min_quorum_frac=min_quorum_frac)
         if server_optimizer:
             return FedOptServerManager(
                 0, size, server_com, aggregator, comm_round,
@@ -679,7 +1050,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         compression=policy, token=token, seed=seed,
         client_state_dir=checkpoint_dir, resume=resume,
         join_timeout_s=join_timeout_s, round_record_hook=round_record_hook,
-        timer=timer, prefetch_depth=prefetch_depth)
+        timer=timer, prefetch_depth=prefetch_depth,
+        heartbeat_s=heartbeat_s, fault_plan=fault_plan)
     return model, history
 
 
@@ -695,7 +1067,9 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       raise_on_timeout: bool = False,
                       round_record_hook=None,
                       timer=None,
-                      prefetch_depth: int = 2):
+                      prefetch_depth: int = 2,
+                      heartbeat_s: float = 0.0,
+                      fault_plan=None):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
@@ -709,6 +1083,10 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     policy = resolve_compression(compression, compress=compress)
     size = worker_num + 1
     router = InProcRouter() if backend.upper() in ("INPROC", "MPI") else None
+    # parse ONCE: one seeded plan instance shared by every endpoint, so
+    # per-rank RNG streams come from the same seed (comm/faults.py)
+    from fedml_tpu.comm.faults import parse_fault_plan
+    plan = parse_fault_plan(fault_plan)
 
     sample_x = dataset.train_data_global[0][:1]
     global_model = module.init(jax.random.key(seed), jnp.asarray(sample_x),
@@ -746,22 +1124,26 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     aggregator = FedAvgAggregator(worker_num)
     server_com = create_comm_manager(backend, 0, size, router=router,
                                      addresses=addresses,
-                                     wire_codec=wire_codec, token=token)
+                                     wire_codec=wire_codec, token=token,
+                                     fault_plan=plan)
     server = server_factory(size, server_com, aggregator, global_model,
                             on_round_done)
     from fedml_tpu.utils.tracing import RoundTimer
     server.round_timer = timer if timer is not None else RoundTimer()
     clients = []
+    client_coms = []
     for rank in range(1, size):
         com = create_comm_manager(backend, rank, size, router=router,
                                   addresses=addresses, wire_codec=wire_codec,
-                                  token=token)
+                                  token=token, fault_plan=plan)
+        client_coms.append(com)
         clients.append(FedAvgClientManager(
             rank, size, com, dataset, module, task, train_cfg, seed=seed,
             compression=policy,
             state_dir=(os.path.join(client_state_dir, f"silo_{rank}")
                        if client_state_dir else None),
-            resume=resume, prefetch_depth=prefetch_depth))
+            resume=resume, prefetch_depth=prefetch_depth,
+            heartbeat_s=heartbeat_s))
 
     # Warm the two heavyweight programs ON THE MAIN THREAD before any
     # actor thread starts: one local_train at the padded shape and one
@@ -843,4 +1225,27 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                              int(getattr(server_com, "bytes_sent", 0)))
     server.round_timer.count("comm_bytes_up",
                              int(getattr(server_com, "bytes_received", 0)))
+    # fault-tolerance roll-up: transport counters (retries, dedup drops,
+    # injected faults) summed over EVERY endpoint, protocol counters
+    # (evictions, rejoins, corrupt frames, partial closes) from the
+    # server. Counted even when zero so the keys are always present.
+    transport = defaultdict(int)
+    for com in [server_com, *client_coms]:
+        counters = (com.all_counters() if hasattr(com, "all_counters")
+                    else getattr(com, "counters", {}))
+        for k, v in dict(counters).items():
+            transport[k] += int(v)
+    tmr = server.round_timer
+    tmr.count("ft_retries", transport["retries"])
+    tmr.count("ft_dedup_drops", transport["dedup_drops"])
+    tmr.count("ft_conn_errors", transport["conn_errors"])
+    tmr.count("ft_faults_injected", transport["faults_injected"])
+    liveness = getattr(server, "liveness", None)
+    tmr.count("ft_evictions",
+              int(getattr(liveness, "evictions", 0)))
+    tmr.count("ft_rejoins", int(getattr(liveness, "rejoins", 0)))
+    ftc = getattr(server, "ft_counters", {})
+    for key in ("partial_rounds", "stale_replies", "corrupt_frames",
+                "join_resyncs", "heartbeats", "deadline_extensions"):
+        tmr.count(f"ft_{key}", int(ftc.get(key, 0)))
     return server.global_model, history, server

@@ -1,6 +1,6 @@
 """Rule registry: one module per project-specific rule.
 
-Each rule carries an id (FT001..FT006), a docstring explaining the
+Each rule carries an id (FT001..FT007), a docstring explaining the
 hazard in THIS codebase's terms, and a fix hint. ``all_rules()`` is the
 canonical ordered instantiation the engine and the CLI share.
 """
@@ -11,6 +11,7 @@ from typing import List
 
 from fedml_tpu.analysis.lint import Rule
 from fedml_tpu.analysis.rules.broad_except import BroadExceptRule
+from fedml_tpu.analysis.rules.comm_timeouts import CommTimeoutRule
 from fedml_tpu.analysis.rules.donation import DonatedReuseRule
 from fedml_tpu.analysis.rules.float64 import Float64Rule
 from fedml_tpu.analysis.rules.host_sync import HostSyncRule
@@ -18,7 +19,8 @@ from fedml_tpu.analysis.rules.jit_static import JitScalarArgRule
 from fedml_tpu.analysis.rules.rng import GlobalRngRule
 
 _RULES = (GlobalRngRule, DonatedReuseRule, HostSyncRule,
-          JitScalarArgRule, BroadExceptRule, Float64Rule)
+          JitScalarArgRule, BroadExceptRule, Float64Rule,
+          CommTimeoutRule)
 
 
 def all_rules() -> List[Rule]:

@@ -1,0 +1,148 @@
+"""FT007 — unbounded blocking or swallowed I/O errors in comm modules.
+
+The exact bug class PR 5's fault-tolerance work fixed, frozen as a rule
+so it cannot regress:
+
+1. **Swallowed socket errors** — an ``except OSError:`` (or
+   ``ConnectionError`` / ``socket.error`` / a tuple of them) whose body
+   is ONLY ``pass``/``...`` silently loses a frame with no error, no
+   counter, no log (the old ``tcp._Peer.send`` drop — the server then
+   waits forever on a reply that no longer exists). Handlers that
+   count, log, re-raise, or use the bound exception are compliant;
+   intentional best-effort shutdown paths carry a
+   ``# ft: allow[FT007]`` pragma with their rationale.
+
+2. **Blocking calls without a deadline** — in a federation, an
+   unbounded block IS a hang:
+
+   - ``socket.create_connection(...)`` without a ``timeout=`` kwarg;
+   - ``sock.settimeout(None)`` (explicitly removing a deadline);
+   - invoking a gRPC callable — direct
+     ``channel.stream_unary(...)(...)`` chains or a name bound from
+     ``unary_unary``/``stream_unary``/``unary_stream``/``stream_stream``
+     — without a ``timeout=`` kwarg.
+
+Scope: ``fedml_tpu/comm/`` only (plus the analysis corpus). Protocol
+modules above the transport have their own deadline machinery
+(``round_deadline_s``) and different idioms.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_corpus_path)
+
+#: exception names whose silent swallow loses I/O errors
+_NET_EXCS = frozenset({"OSError", "IOError", "ConnectionError",
+                       "ConnectionResetError", "ConnectionRefusedError",
+                       "BrokenPipeError", "TimeoutError", "error"})
+
+#: grpc channel methods returning a blocking RPC callable
+_RPC_FACTORIES = frozenset({"unary_unary", "stream_unary", "unary_stream",
+                            "stream_stream"})
+
+
+def _names_net_exc(node: ast.expr) -> bool:
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return (dotted_name(node) or "").split(".")[-1] in _NET_EXCS
+    if isinstance(node, ast.Tuple):
+        return any(_names_net_exc(e) for e in node.elts)
+    return False
+
+
+def _body_is_only_pass(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(stmt, ast.Pass)
+               or (isinstance(stmt, ast.Expr)
+                   and isinstance(stmt.value, ast.Constant)
+                   and stmt.value.value is Ellipsis)
+               for stmt in handler.body)
+
+
+def _has_timeout_kwarg(call: ast.Call) -> bool:
+    # kw.arg None is a **kwargs splat — unresolvable, benefit of the doubt
+    return any(kw.arg == "timeout" or kw.arg is None
+               for kw in call.keywords)
+
+
+class CommTimeoutRule(Rule):
+    id = "FT007"
+    title = "unbounded blocking / swallowed socket error in a comm module"
+    hint = ("pass timeout= to blocking socket/gRPC calls; make OSError "
+            "handlers count + log (or re-raise) instead of pass; pragma "
+            "intentional best-effort shutdown sites: "
+            "# ft: allow[FT007] <why>")
+
+    def applies(self, relpath: str) -> bool:
+        return "/comm/" in f"/{relpath}" or is_corpus_path(relpath)
+
+    def _rpc_bindings(self, ctx: FileContext) -> Set[str]:
+        """Names (incl. self-attrs) bound from a gRPC rpc-factory call:
+        ``stub = ch.stream_unary(...)`` — later bare ``stub(req)`` calls
+        must carry a deadline."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            fname = dotted_name(node.value.func) or ""
+            if fname.split(".")[-1] in _RPC_FACTORIES:
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name:
+                        out.add(name)
+        return out
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        rpc_names = self._rpc_bindings(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler):
+                # bare `except:` is FT005's business; FT007 wants the
+                # narrowed-but-swallowed socket error specifically
+                if node.type is not None and _names_net_exc(node.type) \
+                        and _body_is_only_pass(node):
+                    yield ctx.finding(
+                        self, node,
+                        "socket/conn error swallowed with a bare pass — "
+                        "the frame (and the failure) vanish: count + "
+                        "warn, re-raise, or pragma the intentional "
+                        "shutdown path")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            leaf = fname.split(".")[-1]
+            if leaf == "create_connection" and not _has_timeout_kwarg(node):
+                # positional form: socket.create_connection(addr, timeout)
+                if len(node.args) < 2:
+                    yield ctx.finding(
+                        self, node,
+                        "create_connection without a timeout blocks a "
+                        "send slot for the kernel's connect timeout "
+                        "(minutes) when the peer is dark")
+            elif leaf == "settimeout" and node.args and isinstance(
+                    node.args[0], ast.Constant) \
+                    and node.args[0].value is None:
+                yield ctx.finding(
+                    self, node,
+                    "settimeout(None) removes the socket's deadline — an "
+                    "unbounded block is a federation hang; bound it or "
+                    "pragma the dedicated-reader-thread idiom")
+            elif isinstance(node.func, ast.Call):
+                # direct chain: ch.stream_unary(METHOD)(request_iter, ...)
+                inner = dotted_name(node.func.func) or ""
+                if inner.split(".")[-1] in _RPC_FACTORIES \
+                        and not _has_timeout_kwarg(node):
+                    yield ctx.finding(
+                        self, node,
+                        "gRPC call without a timeout= deadline — a hung "
+                        "stream blocks the sender forever")
+            elif fname in rpc_names and not _has_timeout_kwarg(node):
+                yield ctx.finding(
+                    self, node,
+                    f"{fname} is a gRPC rpc callable invoked without a "
+                    "timeout= deadline — a hung stream blocks the sender "
+                    "forever")

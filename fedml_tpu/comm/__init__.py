@@ -21,11 +21,16 @@ MPI path) or JSON-ified float lists (its MQTT path).
 """
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.faults import (FaultPlan, FaultRule, FaultyCommManager,
+                                   parse_fault_plan)
 from fedml_tpu.comm.manager import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.registry import create_comm_manager
+from fedml_tpu.comm.reliable import RetryPolicy, TransportError
 
 __all__ = [
     "BaseCommunicationManager", "Observer", "Message", "ClientManager",
-    "ServerManager", "create_comm_manager",
+    "ServerManager", "create_comm_manager", "FaultPlan", "FaultRule",
+    "FaultyCommManager", "parse_fault_plan", "RetryPolicy",
+    "TransportError",
 ]

@@ -4,9 +4,27 @@ observer.py:4-7)."""
 from __future__ import annotations
 
 import abc
+import os
 import threading
+from collections import defaultdict
+from typing import Dict, Set, Tuple
 
 from fedml_tpu.comm.message import Message
+
+#: per-(sender stream) ``[epoch, seq]`` stamp, written into the message
+#: header by the sending backend. Retried frames reuse the stamp (stamping
+#: is idempotent), so the receive-side dedup can shed the duplicate a retry
+#: of an already-delivered frame creates — the transports' exactly-once
+#: contract (comm/reliable.py). The epoch is drawn fresh per endpoint
+#: incarnation: a RESTARTED silo's stream starts over at seq 1 under a NEW
+#: epoch, so its frames are not mistaken for duplicates of its previous
+#: life's.
+WIRE_SEQ_KEY = "__wire_seq__"
+
+#: dedup window per sender: seqs older than (highest seen - window) are
+#: treated as duplicates. 4096 in-flight frames per peer is orders of
+#: magnitude beyond the protocol's round-trip pipelining.
+_DEDUP_WINDOW = 4096
 
 
 class Observer(abc.ABC):
@@ -26,6 +44,13 @@ class BaseCommunicationManager(abc.ABC):
     measured at the wire, not estimated from array sizes. Backends that
     hand off objects in memory (inproc without the wire codec) have no
     frames and report 0.
+
+    Reliability: sending backends stamp each message with a per-stream
+    sequence number (:meth:`_stamp_seq`); :meth:`_notify` drops frames
+    whose ``(sender, seq)`` was already delivered, so a transport retry
+    (comm/reliable.py) can never double-deliver. Fault-tolerance event
+    counts land in :attr:`counters` (``retries``, ``dedup_drops``,
+    ``conn_errors``, ...) for the launcher's RoundTimer roll-up.
     """
 
     def __init__(self) -> None:
@@ -33,6 +58,17 @@ class BaseCommunicationManager(abc.ABC):
         self._bytes_lock = threading.Lock()
         self.bytes_sent = 0
         self.bytes_received = 0
+        #: fault-tolerance event counters (retries, dedup_drops, ...)
+        self.counters: Dict[str, int] = defaultdict(int)
+        self._seq_lock = threading.Lock()
+        #: this endpoint incarnation's stream epoch (see WIRE_SEQ_KEY)
+        self._seq_epoch = int.from_bytes(os.urandom(4), "big")
+        self._send_seq: Dict[int, int] = defaultdict(int)
+        #: sender -> (epoch, seen seq set, highest seq seen) — receive dedup
+        self._seen: Dict[int, Tuple[int, Set[int], int]] = {}
+        #: sender -> superseded incarnation epochs (late frames from a
+        #: previous life must stay dropped, not reopen a window)
+        self._old_epochs: Dict[int, Set[int]] = defaultdict(set)
 
     def _count_sent(self, n: int) -> None:
         with self._bytes_lock:
@@ -41,6 +77,57 @@ class BaseCommunicationManager(abc.ABC):
     def _count_received(self, n: int) -> None:
         with self._bytes_lock:
             self.bytes_received += int(n)
+
+    def bump(self, name: str, n: int = 1) -> None:
+        """Increment a fault-tolerance event counter."""
+        with self._bytes_lock:
+            self.counters[name] += int(n)
+
+    # -- reliable-delivery bookkeeping --------------------------------------
+    def _stamp_seq(self, msg: Message) -> None:
+        """Assign the next per-destination-stream sequence number.
+
+        Idempotent: a message that already carries a stamp keeps it, so a
+        retried or fault-injected duplicate ships the SAME seq and the
+        receiver's dedup drops the extra copy.
+        """
+        if WIRE_SEQ_KEY in msg.msg_params:
+            return
+        with self._seq_lock:
+            self._send_seq[msg.get_receiver_id()] += 1
+            seq = self._send_seq[msg.get_receiver_id()]
+        msg.add(WIRE_SEQ_KEY, [self._seq_epoch, seq])
+
+    def _accept(self, msg: Message) -> bool:
+        """Receive-side dedup: True iff this ``(sender, epoch, seq)`` has
+        not been delivered before (unstamped legacy messages always pass).
+        A new epoch from a sender — a restarted silo — resets that
+        sender's window; frames from its previous incarnation still in
+        flight are dropped as stale."""
+        stamp = msg.msg_params.get(WIRE_SEQ_KEY)
+        if stamp is None:
+            return True
+        epoch, seq = int(stamp[0]), int(stamp[1])
+        sender = msg.get_sender_id()
+        with self._seq_lock:
+            cur_epoch, seen, high = self._seen.get(sender,
+                                                   (None, set(), 0))
+            if epoch in self._old_epochs[sender]:
+                return False  # late frame from a superseded incarnation
+            if cur_epoch is not None and epoch != cur_epoch:
+                # fresh incarnation: supersede the old stream, reset window
+                self._old_epochs[sender].add(cur_epoch)
+                seen, high = set(), 0
+            if seq in seen or seq <= high - _DEDUP_WINDOW:
+                return False
+            seen.add(seq)
+            high = max(high, seq)
+            # prune the window so long federations stay O(window) memory
+            if len(seen) > 2 * _DEDUP_WINDOW:
+                floor = high - _DEDUP_WINDOW
+                seen = {s for s in seen if s > floor}
+            self._seen[sender] = (epoch, seen, high)
+        return True
 
     @abc.abstractmethod
     def send_message(self, msg: Message) -> None:
@@ -53,6 +140,9 @@ class BaseCommunicationManager(abc.ABC):
         self._observers.remove(observer)
 
     def _notify(self, msg: Message) -> None:
+        if not self._accept(msg):
+            self.bump("dedup_drops")
+            return
         for obs in list(self._observers):
             obs.receive_message(msg.get_type(), msg)
 

@@ -13,16 +13,27 @@ CLIENT-STREAMING rpc: the sender walks the frame's constituent buffers
 chunk and total frame size is unbounded. No protoc code-gen needed: chunks
 are raw bytes of our self-describing binary frame. Import is gated so
 environments without grpcio still load the package.
+
+Reliability: transient stream failures (``UNAVAILABLE``,
+``DEADLINE_EXCEEDED``) are retried under a seeded backoff policy
+(comm/reliable.py). Each retry restarts the stream FROM CHUNK 0 with the
+same wire seq — a partial first attempt never reaches the inbox (the
+server drops torn streams), and a complete-but-unacknowledged first
+attempt is shed by the receiver's seq dedup (comm/base.py). Permanent
+failures raise a non-transient ``TransportError`` immediately so callers
+can tell a restarting peer from a misconfigured address.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
-from typing import Dict, Iterator, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.reliable import RetryPolicy, retry_call
 
 try:
     import grpc
@@ -67,13 +78,27 @@ def _iter_chunks(parts, chunk: int = _CHUNK) -> Iterator[bytes]:
         yield b"".join(pending)
 
 
+def _is_transient_rpc(exc: BaseException) -> bool:
+    """UNAVAILABLE (peer down/restarting, link flap) and DEADLINE_EXCEEDED
+    (congestion, a stalled stream) are worth a fresh stream; every other
+    status (UNIMPLEMENTED, INVALID_ARGUMENT, resolution failures) is a
+    configuration or protocol error a retry cannot fix."""
+    if grpc is None or not isinstance(exc, grpc.RpcError):
+        return False
+    code = exc.code() if callable(getattr(exc, "code", None)) else None
+    return code in (grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED)
+
+
 class GrpcCommManager(BaseCommunicationManager):
-    def __init__(self, rank: int, addresses: Dict[int, Tuple[str, int]]):
+    def __init__(self, rank: int, addresses: Dict[int, Tuple[str, int]],
+                 retry: Optional[RetryPolicy] = None):
         if not HAS_GRPC:  # pragma: no cover
             raise ImportError("grpcio is not available in this environment")
         super().__init__()
         self.rank = rank
         self.addresses = addresses
+        self.retry = retry if retry is not None else RetryPolicy(seed=rank)
         self._inbox: "queue.Queue" = queue.Queue()
         self._channels: Dict[int, "grpc.Channel"] = {}
         self._lock = threading.Lock()
@@ -82,8 +107,18 @@ class GrpcCommManager(BaseCommunicationManager):
         def handle(request_iterator, context) -> bytes:
             # reassemble into ONE growing buffer (no chunk list + join)
             buf = bytearray()
-            for chunk in request_iterator:
-                buf.extend(chunk)
+            try:
+                for chunk in request_iterator:
+                    buf.extend(chunk)
+            except grpc.RpcError:
+                # torn client stream (sender died / retried): the partial
+                # frame must never reach the inbox — the sender's retry
+                # restarts from chunk 0 and delivers a whole frame
+                self.bump("torn_streams")
+                logging.warning("grpc rank %d: inbound stream torn after "
+                                "%d bytes — dropping partial frame",
+                                self.rank, len(buf))
+                raise
             self._count_received(len(buf))
             self._inbox.put(buf)
             return b"ok"
@@ -116,13 +151,28 @@ class GrpcCommManager(BaseCommunicationManager):
             return ch.stream_unary(_METHOD)
 
     def send_message(self, msg: Message) -> None:
+        # stamp BEFORE encoding: every stream attempt ships the identical
+        # frame/seq, so a duplicate from a completed-but-unacked first
+        # attempt is shed by the receiver's dedup
+        self._stamp_seq(msg)
         parts = msg.to_parts()
         n = sum(len(p) for p in parts)
         # deadline scales with frame size (floor 8 MB/s): a fixed 60 s
         # would re-cap exactly the huge-model frames streaming unlocked
         timeout = 60 + n / (8 << 20)
-        self._stub(msg.get_receiver_id())(_iter_chunks(parts),
-                                          timeout=timeout)
+        dest = msg.get_receiver_id()
+
+        def attempt() -> None:
+            # a FRESH chunk generator per attempt: the retried stream
+            # restarts from chunk 0 (the server drops torn partials)
+            self._stub(dest)(_iter_chunks(parts), timeout=timeout)
+
+        host, port = self.addresses[dest]
+        retry_call(
+            attempt, self.retry,
+            describe=f"grpc sendMessage to rank {dest} ({host}:{port})",
+            is_transient=_is_transient_rpc,
+            on_retry=lambda a, exc: self.bump("retries"))
         self._count_sent(n)
 
     def handle_receive_message(self) -> None:

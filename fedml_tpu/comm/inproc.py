@@ -49,6 +49,10 @@ class InProcCommManager(BaseCommunicationManager):
         self._running = False
 
     def send_message(self, msg: Message) -> None:
+        # stamped like the socket backends: the chaos wrapper
+        # (comm/faults.py) injects duplicates ABOVE this layer, and the
+        # receive-side seq dedup must shed them here too
+        self._stamp_seq(msg)
         if self.wire_codec:
             payload = msg.to_bytes()
             self._count_sent(len(payload))

@@ -91,6 +91,9 @@ class MiniMqttClient:
                  keepalive: int = 0, timeout: float = 10.0):
         self._on_message = on_message
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        # the reader is a dedicated blocking thread; close() tears the
+        # socket down, and recv raising IS the shutdown signal
+        # ft: allow[FT007] dedicated reader thread, shutdown via close()
         self._sock.settimeout(None)
         self._wlock = threading.Lock()
         self._suback = threading.Event()
@@ -145,6 +148,7 @@ class MiniMqttClient:
                 elif ptype == SUBACK & 0xF0:
                     self._suback.set()
                 # PINGRESP and others: ignore
+        # ft: allow[FT007] reader-loop exit: the torn socket IS the stop
         except (ConnectionError, OSError, ValueError):
             pass  # socket closed or torn down
 
@@ -152,10 +156,12 @@ class MiniMqttClient:
         self._running = False
         try:
             self._send(bytes([DISCONNECT, 0]))
+        # ft: allow[FT007] best-effort courtesy DISCONNECT at shutdown
         except OSError:
             pass
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
+        # ft: allow[FT007] best-effort shutdown of an already-dead socket
         except OSError:
             pass
         self._sock.close()
@@ -285,6 +291,7 @@ class MiniMqttBroker:
                         for t in self._subs.get(topic, ()):
                             try:
                                 t.sendall(frame)
+                            # ft: allow[FT007] dead sub detaches itself
                             except OSError:
                                 pass
                 elif ptype == PINGREQ & 0xF0:
@@ -292,6 +299,7 @@ class MiniMqttBroker:
                         conn.sendall(bytes([PINGRESP, 0]))
                 elif ptype == DISCONNECT & 0xF0:
                     break
+        # ft: allow[FT007] torn client conn ends its loop; finally detaches
         except (ConnectionError, OSError, ValueError):
             pass
         finally:
@@ -305,5 +313,6 @@ class MiniMqttBroker:
         self._running = False
         try:
             self._server.close()
+        # ft: allow[FT007] best-effort close of the broker listener
         except OSError:
             pass

@@ -14,39 +14,54 @@ def create_comm_manager(
         router: Optional[InProcRouter] = None,
         addresses: Optional[Dict[int, Tuple[str, int]]] = None,
         wire_codec: bool = False,
-        token: Optional[bytes] = None) -> BaseCommunicationManager:
+        token: Optional[bytes] = None,
+        fault_plan=None) -> BaseCommunicationManager:
     """``backend``: "INPROC" (simulation/tests), "TCP" (framed sockets,
     cross-host), "GRPC" (cross-silo RPC), "ROUTED" (dial-out frames through
     the native C++ broker, native/router.cpp — the NAT-friendly star
     topology of the reference's MQTT path). The reference's "MPI" maps to
-    INPROC for single-host and TCP for multi-host."""
+    INPROC for single-host and TCP for multi-host.
+
+    ``fault_plan`` (a ``comm.faults.FaultPlan``, DSL string, or JSON —
+    see ``parse_fault_plan``) wraps the endpoint in the seeded chaos
+    harness; ``None``/empty specs return the bare backend untouched."""
+    from fedml_tpu.comm.faults import FaultyCommManager, parse_fault_plan
+    plan = parse_fault_plan(fault_plan)
+
+    def wrap(inner):
+        if plan is None or plan.empty:
+            return inner
+        return FaultyCommManager(inner, plan, rank)
+
     key = backend.upper()
     if key in ("ROUTED", "BROKER"):
         if addresses is None or "router" not in addresses:
             raise ValueError(
                 'ROUTED backend needs addresses={"router": (host, port)}')
         from fedml_tpu.comm.routed import RoutedCommManager
-        return RoutedCommManager(rank, addresses["router"], token=token)
+        return wrap(RoutedCommManager(rank, addresses["router"],
+                                      token=token))
     if key in ("INPROC", "MPI"):
         if router is None:
             raise ValueError("INPROC backend needs a shared InProcRouter")
-        return InProcCommManager(router, rank, size, wire_codec=wire_codec)
+        return wrap(InProcCommManager(router, rank, size,
+                                      wire_codec=wire_codec))
     if key == "TCP":
         if addresses is None:
             raise ValueError("TCP backend needs {rank: (host, port)}")
         from fedml_tpu.comm.tcp import TcpCommManager
-        return TcpCommManager(rank, addresses)
+        return wrap(TcpCommManager(rank, addresses))
     if key == "GRPC":
         if addresses is None:
             raise ValueError("GRPC backend needs {rank: (host, port)}")
         from fedml_tpu.comm.grpc_backend import GrpcCommManager
-        return GrpcCommManager(rank, addresses)
+        return wrap(GrpcCommManager(rank, addresses))
     if key == "GRPC_PROTO":
         # reference-wire-compatible mode (grpc_comm_manager.proto)
         if addresses is None:
             raise ValueError("GRPC_PROTO backend needs {rank: (host, port)}")
         from fedml_tpu.comm.grpc_proto import ProtoGrpcCommManager
-        return ProtoGrpcCommManager(rank, addresses)
+        return wrap(ProtoGrpcCommManager(rank, addresses))
     if key == "MQTT":
         # broker pub/sub with the reference topic scheme + JSON payloads
         if addresses is None or "broker" not in addresses:
@@ -54,6 +69,6 @@ def create_comm_manager(
                 'MQTT backend needs addresses={"broker": (host, port)}')
         from fedml_tpu.comm.mqtt import MqttCommManager
         host, port = addresses["broker"]
-        return MqttCommManager(host, port, client_id=rank,
-                               client_num=size - 1)
+        return wrap(MqttCommManager(host, port, client_id=rank,
+                                    client_num=size - 1))
     raise ValueError(f"unknown backend: {backend!r}")

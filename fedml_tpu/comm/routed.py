@@ -50,6 +50,9 @@ class RoutedCommManager(BaseCommunicationManager):
         self.rank = rank
         self._sock = socket.create_connection(router_address,
                                               timeout=connect_timeout)
+        # the reader is a dedicated blocking thread; stop tears the socket
+        # down and the resulting error is routed to the inbox
+        # ft: allow[FT007] dedicated reader thread, shutdown via close()
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if token:
@@ -120,6 +123,7 @@ class RoutedCommManager(BaseCommunicationManager):
         self._inbox.put(_STOP)
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
+        # ft: allow[FT007] best-effort shutdown of an already-dead socket
         except OSError:
             pass
         self._sock.close()

@@ -5,22 +5,36 @@ prototype flaws (hardcoded receiver IPs at :51-56, a channel per message):
 addresses come from an explicit ``{rank: (host, port)}`` map, connections are
 cached per peer, and frames are the binary codec's output (serialization.py)
 — so a multi-MB model update is two syscalls, not a JSON encode.
+
+Reliability: sends run under a bounded, seeded exponential-backoff
+``RetryPolicy`` (comm/reliable.py) — a failed/partial write drops the
+socket, reconnects, and resends the SAME stamped frame; the receive side
+dedups by sequence number (comm/base.py), so a retry of a frame that DID
+land is shed instead of double-delivered. Exhausted retries raise
+``TransportError`` loudly — the old behavior (drop the socket, swallow the
+``OSError``, hope the next send reconnects) silently lost the frame.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import socket
 import struct
 import threading
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.reliable import RetryPolicy, retry_call
 
 _LEN = struct.Struct("<Q")
 _STOP = object()
 _CHUNK = 1 << 20  # per-recv_into slice; bounds kernel copy granularity
+
+#: a connect attempt must not block a send slot unboundedly — failed
+#: connects feed the retry loop, which owns the waiting
+_CONNECT_TIMEOUT_S = 30.0
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -68,32 +82,53 @@ class _Peer:
     different peers never serialize behind each other (or behind one slow
     connect)."""
 
-    def __init__(self, address: Tuple[str, int]):
+    def __init__(self, address: Tuple[str, int], retry: RetryPolicy,
+                 bump=None):
         self.address = address
+        self.retry = retry
         self.lock = threading.Lock()
         self.sock: socket.socket | None = None
+        self._bump = bump or (lambda name, n=1: None)
+
+    def _send_once(self, frame) -> None:
+        """One attempt: (re)connect if needed, write the frame. A failed
+        or partial write desyncs the length-prefixed stream, so the socket
+        is dropped before the error propagates — the NEXT attempt starts
+        from a clean connection."""
+        if self.sock is None:
+            self.sock = socket.create_connection(
+                self.address, timeout=_CONNECT_TIMEOUT_S)
+        try:
+            send_frame(self.sock, frame)
+        except OSError:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+            raise
 
     def send(self, frame) -> None:
-        """``frame``: bytes-like or a parts list (see ``send_frame``)."""
+        """``frame``: bytes-like or a parts list (see ``send_frame``).
+
+        Retried under the peer's policy; raises ``TransportError`` after
+        the budget is spent — never a silent drop. The retried frame
+        carries the same wire seq (stamped before encoding), so a
+        duplicate from a send that failed AFTER delivery is shed by the
+        receiver's dedup.
+        """
         with self.lock:
-            if self.sock is None:
-                self.sock = socket.create_connection(self.address, timeout=30)
-            try:
-                send_frame(self.sock, frame)
-            except OSError:
-                # a failed/partial write desyncs the stream — drop the socket
-                # so the next send reconnects cleanly
-                try:
-                    self.sock.close()
-                finally:
-                    self.sock = None
-                raise
+            retry_call(
+                lambda: self._send_once(frame), self.retry,
+                describe=f"tcp send to {self.address[0]}:{self.address[1]}",
+                is_transient=lambda exc: isinstance(exc, OSError),
+                on_retry=lambda attempt, exc: self._bump("retries"))
 
     def close(self) -> None:
         with self.lock:
             if self.sock is not None:
                 try:
                     self.sock.close()
+                # ft: allow[FT007] best-effort close of a dead socket
                 except OSError:
                     pass
                 self.sock = None
@@ -108,10 +143,15 @@ class TcpCommManager(BaseCommunicationManager):
     locking, same as the inproc/gRPC backends.
     """
 
-    def __init__(self, rank: int, addresses: Dict[int, Tuple[str, int]]):
+    def __init__(self, rank: int, addresses: Dict[int, Tuple[str, int]],
+                 retry: Optional[RetryPolicy] = None):
         super().__init__()
         self.rank = rank
         self.addresses = addresses
+        #: seeded per rank: deterministic backoff schedules, decorrelated
+        #: across ranks so a partitioned federation doesn't retry in
+        #: lockstep against the same recovering peer
+        self.retry = retry if retry is not None else RetryPolicy(seed=rank)
         host, port = addresses[rank]
         self._server = socket.create_server((host, port), reuse_port=False)
         self._server.listen(16)
@@ -126,7 +166,11 @@ class TcpCommManager(BaseCommunicationManager):
         with self._peers_lock:  # dict access only; I/O under the peer lock
             peer = self._peers.get(dest)
             if peer is None:
-                peer = self._peers[dest] = _Peer(self.addresses[dest])
+                peer = self._peers[dest] = _Peer(self.addresses[dest],
+                                                 self.retry, bump=self.bump)
+        # stamp BEFORE encoding: every retry ships the identical frame,
+        # so the receiver's dedup recognizes the duplicate
+        self._stamp_seq(msg)
         # parts, not one joined frame: a model update goes header-then-
         # buffers straight to the socket with no contiguous copy
         parts = msg.to_parts()
@@ -139,8 +183,14 @@ class TcpCommManager(BaseCommunicationManager):
                 frame = recv_frame(conn)
                 self._count_received(len(frame))
                 self._inbox.put(frame)
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError) as exc:
+            # a torn inbound connection is NOT silent: the sender retries
+            # (or raises), but the event is counted and logged so a flaky
+            # link shows up in the RoundTimer roll-up, not just in tails
+            if self._running:
+                self.bump("conn_errors")
+                logging.warning("tcp rank %d: inbound connection dropped "
+                                "(%r) — sender will retry", self.rank, exc)
         finally:
             conn.close()
 

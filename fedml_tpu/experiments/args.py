@@ -101,6 +101,32 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "(uplink int8 model-update deltas only, "
                              "full-precision broadcasts) — use "
                              "--compression for the bidirectional stack")
+    # -- fault tolerance (cross-silo actor backends) ------------------------
+    parser.add_argument("--round_deadline_s", type=float, default=None,
+                        help="cross-silo fault tolerance: close a round "
+                             "with a weighted PARTIAL aggregate once this "
+                             "deadline passes with >= min_quorum_frac of "
+                             "live silos reported, evicting the "
+                             "non-reporters (they rejoin via JOIN + a "
+                             "full-precision resync). Unset = the strict "
+                             "all-received barrier. Also the per-round "
+                             "deadline of --algo fedavg_async quorum mode "
+                             "(its default there is 10).")
+    parser.add_argument("--min_quorum_frac", type=float, default=0.5,
+                        help="fraction of LIVE silos that must report "
+                             "before a deadline close may evict the rest "
+                             "(below it the deadline extends instead)")
+    parser.add_argument("--heartbeat_s", type=float, default=0.0,
+                        help="silo heartbeat period (0 = off): idle silos "
+                             "beat the server's liveness table, and after "
+                             "~3 silent beats send JOIN to re-admit "
+                             "themselves (evicted or restarted silos)")
+    parser.add_argument("--fault_plan", type=str, default=None,
+                        help="seeded chaos harness (comm/faults.py): a "
+                             "DSL string like "
+                             "'seed=7;drop:p=0.1;delay:p=0.2,delay_ms=50', "
+                             "inline JSON, or a .json path. Wraps every "
+                             "comm endpoint; empty/unset = no injection")
     parser.add_argument("--ci", type=int, default=0,
                         help="1 = tiny smoke-run truncation (reference --ci)")
     return parser

@@ -103,8 +103,10 @@ def add_algo_args(parser: argparse.ArgumentParser):
                         help="quorum: close rounds at (all | deadline & "
                              "quorum); fedasync: merge every update with "
                              "a staleness-decayed weight")
+    # --round_deadline_s moved to the shared federated flags (args.py):
+    # it now drives BOTH the quorum server and the cross-silo
+    # deadline-eviction path; quorum mode defaults to 10.0 when unset
     parser.add_argument("--quorum", type=int, default=1)
-    parser.add_argument("--round_deadline_s", type=float, default=10.0)
     parser.add_argument("--async_alpha", type=float, default=0.6)
     parser.add_argument("--async_poly_a", type=float, default=0.5)
     parser.add_argument("--max_updates", type=int, default=20,
@@ -219,6 +221,12 @@ def run_algo(args):
             compress=getattr(args, "compress", False),
             compression=getattr(args, "compression", None),
             prefetch_depth=getattr(args, "prefetch_depth", 2),
+            # fault tolerance: deadline-evicted stragglers + silo rejoin
+            # + the seeded chaos harness (README "Fault tolerance")
+            round_deadline_s=getattr(args, "round_deadline_s", None),
+            min_quorum_frac=getattr(args, "min_quorum_frac", 0.5),
+            heartbeat_s=getattr(args, "heartbeat_s", 0.0),
+            fault_plan=getattr(args, "fault_plan", None),
             # scale the join budget with the local work — on a 1-core
             # host the silo threads SERIALIZE, so the budget grows with
             # epochs x rounds x silos; the 1200 floor absorbs a
@@ -483,11 +491,15 @@ def run_algo(args):
             ds, model, task=task,
             worker_num=args.client_num_per_round, mode=args.async_mode,
             comm_round=args.comm_round, quorum=args.quorum,
-            round_deadline_s=args.round_deadline_s,
+            round_deadline_s=(args.round_deadline_s
+                              if args.round_deadline_s is not None
+                              else 10.0),
             alpha=args.async_alpha, poly_a=args.async_poly_a,
             max_updates=args.max_updates, train_cfg=tcfg, seed=args.seed,
             # fedasync mode warns and forces full precision inside
-            compression=getattr(args, "compression", None))
+            compression=getattr(args, "compression", None),
+            heartbeat_s=getattr(args, "heartbeat_s", 0.0),
+            fault_plan=getattr(args, "fault_plan", None))
         for rec in history:
             sink.log(rec, step=rec["round"])
         final = dict(history[-1]) if history else {}

@@ -128,6 +128,10 @@ def run_cross_silo(args, ds, model, task, sink):
         compression=getattr(args, "compression", None),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         prefetch_depth=getattr(args, "prefetch_depth", 2),
+        round_deadline_s=getattr(args, "round_deadline_s", None),
+        min_quorum_frac=getattr(args, "min_quorum_frac", 0.5),
+        heartbeat_s=getattr(args, "heartbeat_s", 0.0),
+        fault_plan=getattr(args, "fault_plan", None),
         # fedopt-style server step when the launcher passes the fedopt flags
         server_optimizer=getattr(args, "cross_silo_server_optimizer", None),
         server_lr=getattr(args, "server_lr", 1e-3))

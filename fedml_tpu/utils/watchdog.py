@@ -1,12 +1,22 @@
-"""Round watchdog — failure detection for cross-silo federations.
+"""Failure detection for cross-silo federations: per-silo liveness + the
+whole-round stall watchdog.
 
 The reference has no failure detection at all: a silo that dies mid-round
 leaves the server blocked forever in ``check_whether_all_receive``
-(FedAVGAggregator.py:50-56; SURVEY §5.3). The quorum/async servers
-(algorithms/fedavg_async.py) tolerate stragglers by closing rounds early;
-this watchdog covers the remaining case — detecting that a round has made
-NO progress for ``timeout_s`` and surfacing it (log, metric, or a
-caller-supplied abort) instead of hanging silently.
+(FedAVGAggregator.py:50-56; SURVEY §5.3). Two layers here:
+
+- :class:`SiloLivenessTable` — PER-SILO detection: every inbound message
+  from a silo (model replies, heartbeats, JOINs) beats its entry; the
+  fault-tolerant server (algorithms/fedavg_cross_silo.py) consults the
+  live set for its round barrier, EVICTS silos that miss a round
+  deadline, and re-ADMITS them on JOIN. The table is the single source
+  of truth for who participates in a round.
+- :class:`RoundWatchdog` — whole-round stall detection (the pre-existing
+  layer): a round making NO progress for ``timeout_s`` is surfaced (log,
+  metric, or a caller-supplied abort) instead of hanging silently. Pass
+  ``liveness=`` to enrich stall logs with the per-silo staleness
+  breakdown, so "the federation stalled" comes with "...because silo 2
+  has been dark for 241 s".
 
 Usage:
 
@@ -23,15 +33,88 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Iterable, Optional, Set
+
+
+class SiloLivenessTable:
+    """Thread-safe per-silo liveness: last-seen timestamps + the live set.
+
+    Workers are identified by their aggregator index (rank - 1). All
+    workers start LIVE (the launch barrier implies they exist); a worker
+    leaves the live set only through :meth:`evict` (deadline miss) and
+    returns through :meth:`admit` (JOIN / any proof of life the server
+    chooses to honor). ``evictions``/``rejoins`` counters feed the
+    RoundTimer roll-up.
+    """
+
+    def __init__(self, worker_ids: Iterable[int]):
+        now = time.monotonic()
+        self._lock = threading.Lock()
+        self._last_seen: Dict[int, float] = {w: now for w in worker_ids}
+        self._live: Set[int] = set(self._last_seen)
+        self.evictions = 0
+        self.rejoins = 0
+
+    def beat(self, worker: int) -> None:
+        """Record proof of life (piggybacked on ANY inbound message, plus
+        explicit heartbeats). Unknown workers are recorded but NOT
+        auto-admitted to the live set — admission is the server's call."""
+        with self._lock:
+            self._last_seen[worker] = time.monotonic()
+
+    def live_workers(self) -> Set[int]:
+        with self._lock:
+            return set(self._live)
+
+    def is_live(self, worker: int) -> bool:
+        with self._lock:
+            return worker in self._live
+
+    def evict(self, worker: int) -> bool:
+        """Remove from the live set; True if the worker WAS live (the
+        eviction counted)."""
+        with self._lock:
+            if worker not in self._live:
+                return False
+            self._live.discard(worker)
+            self.evictions += 1
+            return True
+
+    def admit(self, worker: int) -> bool:
+        """(Re-)add to the live set; True if this was a REJOIN (the worker
+        was previously evicted or unknown)."""
+        with self._lock:
+            self._last_seen.setdefault(worker, time.monotonic())
+            if worker in self._live:
+                return False
+            self._live.add(worker)
+            self.rejoins += 1
+            return True
+
+    def stale(self, timeout_s: float) -> Set[int]:
+        """Live workers with no proof of life for ``timeout_s``."""
+        cutoff = time.monotonic() - timeout_s
+        with self._lock:
+            return {w for w in self._live
+                    if self._last_seen.get(w, 0.0) < cutoff}
+
+    def snapshot(self) -> Dict[int, Dict[str, float]]:
+        """Per-worker {live, silent_s} for logs and bench artifacts."""
+        now = time.monotonic()
+        with self._lock:
+            return {w: {"live": w in self._live,
+                        "silent_s": round(now - t, 3)}
+                    for w, t in sorted(self._last_seen.items())}
 
 
 class RoundWatchdog:
     def __init__(self, timeout_s: float,
                  on_stall: Optional[Callable[[int, float], None]] = None,
-                 poll_s: Optional[float] = None):
+                 poll_s: Optional[float] = None,
+                 liveness: Optional[SiloLivenessTable] = None):
         self.timeout_s = timeout_s
         self.on_stall = on_stall or self._log_stall
+        self.liveness = liveness
         self._poll_s = poll_s if poll_s is not None else max(
             0.05, timeout_s / 4)
         self._last_beat = time.monotonic()
@@ -93,6 +176,11 @@ class RoundWatchdog:
                 last_round = self._last_round
             if stalled > self.timeout_s:
                 self.stall_count += 1
+                if self.liveness is not None:
+                    # per-silo breakdown turns "stalled" into "stalled
+                    # BECAUSE silo k went dark at t"
+                    logging.warning("per-silo liveness at stall: %s",
+                                    self.liveness.snapshot())
                 try:
                     self.on_stall(last_round, stalled)
                 except Exception:  # noqa: BLE001 — watchdog must survive

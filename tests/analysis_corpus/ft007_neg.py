@@ -1,0 +1,53 @@
+"""FT007 negative: bounded blocking, accounted failures, pragmas."""
+import logging
+import socket
+
+
+def loud_failure(sock, frame, counters):
+    try:
+        sock.sendall(frame)
+    except OSError as exc:
+        counters["send_failures"] += 1
+        logging.warning("send failed: %r", exc)
+        raise
+
+
+def counted_drop(sock, frame, bump):
+    try:
+        sock.sendall(frame)
+    except OSError:
+        bump("conn_errors")  # counted: not a silent loss
+
+
+def connect_bounded(address):
+    return socket.create_connection(address, timeout=30)
+
+
+def connect_bounded_positional(address):
+    return socket.create_connection(address, 30)
+
+
+def bounded(sock):
+    sock.settimeout(0.5)
+
+
+def reader_thread(sock):
+    # ft: allow[FT007] dedicated reader thread, shutdown via close()
+    sock.settimeout(None)
+
+
+def shutdown(sock):
+    try:
+        sock.close()
+    # ft: allow[FT007] best-effort close of an already-dead socket
+    except OSError:
+        pass
+
+
+def rpc_with_deadline(channel, method, payload):
+    return channel.stream_unary(method)(payload, timeout=60)
+
+
+def rpc_bound_with_deadline(channel, method, payload):
+    stub = channel.unary_unary(method)
+    return stub(payload, timeout=60)

@@ -1,0 +1,34 @@
+"""FT007 positive: unbounded blocking + swallowed socket errors in comm
+code (the pre-PR-5 ``tcp._Peer.send`` bug class)."""
+import socket
+
+
+def silent_drop(sock, frame):
+    try:
+        sock.sendall(frame)
+    except OSError:
+        pass  # the frame is gone: no error, no counter, no log
+
+
+def silent_drop_tuple(sock, frame):
+    try:
+        sock.sendall(frame)
+    except (ConnectionError, OSError):
+        ...
+
+
+def connect_forever(address):
+    return socket.create_connection(address)  # kernel-default block
+
+
+def unbound(sock):
+    sock.settimeout(None)
+
+
+def rpc_no_deadline(channel, method, payload):
+    return channel.stream_unary(method)(payload)
+
+
+def rpc_bound_no_deadline(channel, method, payload):
+    stub = channel.unary_unary(method)
+    return stub(payload)
