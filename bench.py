@@ -636,6 +636,92 @@ def bench_powerlaw_1000() -> dict:
     }
 
 
+def bench_population_scale() -> dict:
+    """The million-client population-virtualization axis (ROADMAP
+    north-star): FedAvg rounds at population ∈ {1k, 100k, 1M} with a
+    CONSTANT cohort, clients materialized through the tiered client-state
+    store (fedml_tpu/state/) instead of resident dicts. Each leg runs in
+    its own subprocess (``python -m fedml_tpu.state.population``) because
+    peak host RSS is a process-lifetime high-water mark — sharing one
+    process would let an earlier leg's peak mask a later leg's.
+
+    Acceptance claims this stage measures:
+    - **throughput parity at 1k**: virtualized rounds/sec within 10% of
+      the resident-dict path on the SAME population/cohort/model
+      (``virtual_vs_resident_1k_x``);
+    - **flat memory**: peak RSS at 1M within 2x of 100k
+      (``rss_1m_over_100k_x``) — population grew 10x, memory didn't,
+      because residency is bounded by the cache budget;
+    - store-tier evidence per leg: ``state_cache_hits/misses/evictions``,
+      ``state_bytes_per_round``, ``host_rss_peak_mb``.
+    """
+    import subprocess
+
+    tpu = _is_tpu()
+    rounds = 30 if tpu else 6
+    cohort = 10
+
+    def leg(population: int, mode: str, timeout_s: int = 240) -> dict:
+        cmd = [sys.executable, "-m", "fedml_tpu.state.population",
+               "--population", str(population), "--rounds", str(rounds),
+               "--cohort", str(cohort), "--mode", mode]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return {"error": f"population leg {mode}@{population} hung "
+                             f"for {timeout_s}s"}
+        if proc.returncode != 0:
+            return {"error": f"population leg {mode}@{population} "
+                             f"failed: {proc.stderr[-500:]}"}
+        try:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return {"error": f"population leg {mode}@{population} "
+                             f"unparseable: {proc.stdout[-300:]}"}
+
+    legs = {
+        "resident_1k": leg(1_000, "resident"),
+        "virtual_1k": leg(1_000, "virtual"),
+        "virtual_100k": leg(100_000, "virtual"),
+        "virtual_1m": leg(1_000_000, "virtual", timeout_s=360),
+    }
+
+    def rps(row):
+        return row.get("rounds_per_sec") or float("nan")
+
+    def rss(row):
+        return row.get("host_rss_peak_mb") or float("nan")
+
+    parity = rps(legs["virtual_1k"]) / rps(legs["resident_1k"])
+    rss_ratio = rss(legs["virtual_1m"]) / rss(legs["virtual_100k"])
+    out = {
+        "legs": legs,
+        "rounds_per_leg": rounds,
+        "cohort": cohort,
+        # the acceptance ratios, flat
+        "virtual_vs_resident_1k_x": _nn(round(parity, 3)),
+        "rss_1m_over_100k_x": _nn(round(rss_ratio, 3)),
+        "rss_mb_by_population": {
+            k: _nn(rss(v)) for k, v in legs.items()},
+        "rounds_per_sec_by_population": {
+            k: _nn(rps(v)) for k, v in legs.items()},
+        "memory_flat_1m_within_2x_100k": bool(rss_ratio == rss_ratio
+                                              and rss_ratio <= 2.0),
+        "throughput_parity_within_10pct": bool(parity == parity
+                                               and parity >= 0.9),
+        "note": "each leg is its own subprocess (ru_maxrss is a process "
+                "high-water mark); resident@1M is deliberately absent — "
+                "the resident-dict path at 10^6 clients is the memory "
+                "wall this subsystem removes",
+    }
+    # the dedicated artifact the acceptance criteria point at
+    os.makedirs("runs", exist_ok=True)
+    with open(os.path.join("runs", "population_scale.json"), "w") as f:
+        json.dump(_no_nan(out), f, indent=2)
+    return out
+
+
 def bench_cross_silo_compression() -> dict:
     """The cross-silo WIRE cost axis: the same federation run at policy
     ``none`` vs ``topk_ef_int8`` (top-k + error feedback uplink, mirror
@@ -1440,6 +1526,9 @@ _STAGES = (
      lambda: bench_transformer_flash(), ("flash", "transformer_flash")),
     ("fedavg_powerlaw_1000", "fedavg_powerlaw_1000",
      lambda: bench_powerlaw_1000(), ("powerlaw",)),
+    ("population_scale", "population_scale",
+     lambda: bench_population_scale(),
+     ("million", "population", "virtualization")),
     ("cross_silo_compression", "cross_silo_compression",
      lambda: bench_cross_silo_compression(),
      ("compression", "cross_silo", "wire")),
@@ -1671,6 +1760,7 @@ def _main_framed():
     resnet = labeled.get("resnet18_gn_fedcifar100", {})
     transformer = labeled.get("transformer_flash_s2048", {})
     powerlaw = labeled.get("fedavg_powerlaw_1000", {})
+    population = labeled.get("population_scale", {})
     fused = labeled.get("fedavg_fused_rounds", {})
     fused_dev = labeled.get("fedavg_fused_device_sampling", {})
     par_axes = labeled.get("federated_parallel_axes", {})
@@ -1691,6 +1781,7 @@ def _main_framed():
         "resnet18_gn_fedcifar100": resnet,
         "transformer_flash_s2048": transformer,
         "fedavg_powerlaw_1000": powerlaw,
+        "population_scale": population,
         "fedavg_fused_rounds": fused,
         "fedavg_fused_device_sampling": fused_dev,
         "federated_parallel_axes": par_axes,
@@ -1722,6 +1813,10 @@ def _main_framed():
         "powerlaw_1000_rps": powerlaw.get("rounds_per_sec"),
         "powerlaw_pipeline_speedup_x": powerlaw.get("pipeline_speedup_x"),
         "powerlaw_prefetch_hidden_ms": powerlaw.get("prefetch_hidden_ms"),
+        "population_1m_rss_over_100k_x": population.get(
+            "rss_1m_over_100k_x"),
+        "population_virtual_vs_resident_1k_x": population.get(
+            "virtual_vs_resident_1k_x"),
         "fused_block_rps": fused.get("rounds_per_sec_fused_block"),
         "fused_block_vs_host_cohort_x": fused.get(
             "fused_block_vs_host_cohort_x"),

@@ -1,8 +1,13 @@
 #!/usr/bin/env bash
 # CI fast lane (the reference's per-PR Travis role, CI-script-fedavg.sh):
-# static analysis (analysis CLI: AST lint + jaxpr audit, ~25 s), then
-# unit + integration tests on 8 virtual CPU devices, ~7 min.
+# static analysis (analysis CLI: AST lint + jaxpr audit, ~25 s), then a
+# 100k-client population-virtualization smoke (seconds — FedAvg rounds
+# through the tiered client-state store; the 1M leg lives in the slow
+# lane + the population_scale bench stage), then unit + integration
+# tests on 8 virtual CPU devices, ~7 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./ci/run_static.sh
+JAX_PLATFORMS=cpu python -m fedml_tpu.state.population \
+    --population 100000 --rounds 2 --cohort 10
 exec python -m pytest tests/ -q -m "not slow" "$@"

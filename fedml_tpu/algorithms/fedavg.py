@@ -190,6 +190,13 @@ class FedAvgAPI:
         self._prefetch = None
         from fedml_tpu.utils.tracing import RoundTimer
         self.timer = RoundTimer()
+        # virtualized populations (fedml_tpu/state/) front the per-client
+        # shards with a tiered store; binding its counters here puts
+        # state_cache_hits/misses/evictions + state_bytes_read/written on
+        # the same evidence row as the phase timings
+        store = getattr(dataset, "store", None)
+        if store is not None and hasattr(store, "bind_timer"):
+            store.bind_timer(self.timer)
 
     # -- one round ---------------------------------------------------------
     def _pack_cohort(self, idxs, dataset=None):
@@ -318,7 +325,9 @@ class FedAvgAPI:
         packed slots pinning HBM."""
         pf = self._round_prefetcher()
         if pf is None:
-            return self._prepare_round(round_idx)
+            out = self._prepare_round(round_idx)
+            self.timer.update_rss()  # consume() samples it on the
+            return out               # pipelined path; mirror it here
         from fedml_tpu.parallel.prefetch import consume
         _, idxs, args = consume(pf, round_idx, self.timer, self.dataset,
                                 self._pack_round,

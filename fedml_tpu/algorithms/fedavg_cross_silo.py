@@ -22,8 +22,10 @@ zero-copy codec, not pickled dicts.
 
 Wire compression (comm/policy.py ladder, ``--compression``): uplink
 replies compress the delta against the silo's held global (int8 and/or
-top-k with a per-silo error-feedback residual, checkpointed under
-``checkpoint_dir/silo_<rank>/``); the round-based servers compress
+top-k with a per-silo error-feedback residual, held round-keyed on the
+client-state store under ``checkpoint_dir/silo_<rank>/`` —
+``fedml_tpu.state.residuals``, which also reads the PR-4
+``round_<r>`` msgpack layout for old resumes); the round-based servers compress
 downlink broadcasts against the *mirror* — the model state every silo
 holds, advanced by exactly what each broadcast decodes to — falling back
 to full precision on the first broadcast and whenever a silo's reported
@@ -742,8 +744,8 @@ class FedAvgClientManager(ClientManager):
         self._resume_residual = bool(resume)
         self._state_ckpt = None
         if state_dir and self._policy.uplink_topk:
-            from fedml_tpu.utils.checkpoint import CheckpointManager
-            self._state_ckpt = CheckpointManager(state_dir)
+            from fedml_tpu.state.residuals import SiloResidualStore
+            self._state_ckpt = SiloResidualStore(state_dir)
         # async round pipeline (parallel/prefetch.py): the server's
         # client_sampling is the deterministic shared stream
         # (core/sampling.sample_clients), so this silo can predict which
@@ -874,11 +876,10 @@ class FedAvgClientManager(ClientManager):
             if self._state_ckpt is not None:
                 d = sum(int(np.prod(np.shape(l)))
                         for l in jax.tree.leaves(variables))
-                try:
-                    state, _ = self._state_ckpt.restore(
-                        round_idx, {"residual": np.zeros(d, np.float32)})
-                    self._residual = state["residual"]
-                except FileNotFoundError:
+                restored = self._state_ckpt.load(round_idx, d)
+                if restored is not None:
+                    self._residual = restored
+                else:
                     logging.info(
                         "silo%d: no residual checkpoint for round %d — "
                         "starting error feedback from zero", self.rank,
@@ -890,7 +891,7 @@ class FedAvgClientManager(ClientManager):
         # rounds-completed), so restore-at-resumed-round lines both up
         if self._state_ckpt is not None and self._residual is not None:
             self._state_ckpt.save(completed_round,
-                                  {"residual": np.asarray(self._residual)})
+                                  np.asarray(self._residual))
 
     def handle_message_init(self, msg: Message) -> None:
         self._last_s2c = time.monotonic()  # server traffic: not forgotten
@@ -1136,7 +1137,9 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
         com = create_comm_manager(backend, rank, size, router=router,
                                   addresses=addresses, wire_codec=wire_codec,
                                   token=token, fault_plan=plan)
+        # ft: allow[FT008] one endpoint per SILO at launch — bounded by worker_num (tens), not the client population
         client_coms.append(com)
+        # ft: allow[FT008] one manager per SILO at launch — silo count is the federation's process count, not its population
         clients.append(FedAvgClientManager(
             rank, size, com, dataset, module, task, train_cfg, seed=seed,
             compression=policy,

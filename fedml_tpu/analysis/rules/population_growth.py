@@ -1,0 +1,156 @@
+"""FT008 — unbounded per-client accumulation in algorithm round loops.
+
+The bug class the population-virtualization work (fedml_tpu/state/)
+exists to retire: a driver that does
+
+    for round ...:
+        self.residuals[client_id] = ...        # grows with population
+    for c in cohort:
+        self.per_client_log.append(...)        # grows with rounds*cohort
+
+holds O(population) (or O(rounds)) host memory in a resident Python
+dict/list — exactly what made 10^6-client federations unreachable before
+the tiered client-state store. At million-client scale every per-client
+artifact must either live behind the store's LRU/disk tiers or carry an
+eviction path.
+
+Findings:
+
+1. **client-keyed subscript growth in a loop** — ``X[<client-ish>] =``
+   inside any ``for``/``while`` body, where no eviction for ``X``
+   (``del X[...]`` / ``X.pop`` / ``X.popitem`` / ``X.clear``) appears in
+   the file and ``X`` is not store-backed (its dotted name mentions
+   ``store``/``cache``/``lru`` — those implement the bounded tier).
+2. **append inside a client loop** — ``X.append(...)`` lexically inside
+   a ``for`` whose target is client-ish (``client_idx``, ``cid``,
+   ``silo``, ``rank``, ``c``, ...), same eviction/store suppressions.
+
+Scope: ``fedml_tpu/algorithms/`` only (plus the analysis corpus) — that
+is where round loops live; data/ builders construct bounded federations
+by design and core/ is shared substrate. Intentional resident
+structures (e.g. cross-silo state that scales with SILO count, which is
+tens, not millions) carry ``# ft: allow[FT008] why`` pragmas.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Set, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_corpus_path)
+
+#: identifiers that mean "a client/participant id" in this codebase
+_CLIENTISH_RE = re.compile(
+    r"(?:^|_)(?:client|clients|cid|cids|silo|silos|sender|rank|worker)"
+    r"(?:_|$|\d)|^c$")
+
+#: container names that ARE the bounded tier (or delegate to it)
+_BOUNDED_RE = re.compile(r"store|cache|lru", re.IGNORECASE)
+
+_EVICT_METHODS = frozenset({"pop", "popitem", "clear"})
+
+
+def _is_clientish(name: str) -> bool:
+    return bool(_CLIENTISH_RE.search(name))
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class PopulationGrowthRule(Rule):
+    id = "FT008"
+    title = "unbounded per-client dict/list growth in an algorithm loop"
+    hint = ("route per-client state through fedml_tpu.state's "
+            "ClientStateStore (LRU + disk shards), or evict "
+            "(del/pop/clear) what the round no longer needs; pragma "
+            "structures bounded by silo count: # ft: allow[FT008] <why>")
+
+    def applies(self, relpath: str) -> bool:
+        return "/algorithms/" in f"/{relpath}" or is_corpus_path(relpath)
+
+    # -- suppression substrate --------------------------------------------
+    def _evicted_containers(self, ctx: FileContext) -> Set[str]:
+        """Dotted container names the file evicts from ANYWHERE — a
+        container with any eviction path is bounded by its author's
+        policy, not this rule's business (coarse on purpose: the rule
+        flags structures with NO shrink path at all)."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        name = dotted_name(tgt.value)
+                        if name:
+                            out.add(name)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _EVICT_METHODS):
+                name = dotted_name(node.func.value)
+                if name:
+                    out.add(name)
+        return out
+
+    def _loop_spans(self, ctx: FileContext,
+                    clientish_only: bool) -> List[Tuple[int, int]]:
+        spans = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.While) and not clientish_only:
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+            elif isinstance(node, ast.For):
+                if clientish_only and not any(
+                        _is_clientish(n) for n in _names_in(node.target)):
+                    continue
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+        return spans
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        evicted = self._evicted_containers(ctx)
+        loops = self._loop_spans(ctx, clientish_only=False)
+        client_loops = self._loop_spans(ctx, clientish_only=True)
+
+        def bounded(container: str) -> bool:
+            return (container in evicted
+                    or bool(_BOUNDED_RE.search(container)))
+
+        def in_spans(line: int, spans) -> bool:
+            return any(a < line <= b for a, b in spans)
+
+        for node in ast.walk(ctx.tree):
+            # 1) X[<client-ish>] = ... inside any loop body
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Subscript):
+                        continue
+                    container = dotted_name(tgt.value)
+                    if not container or bounded(container):
+                        continue
+                    if not in_spans(node.lineno, loops):
+                        continue
+                    if any(_is_clientish(n)
+                           for n in _names_in(tgt.slice)):
+                        yield ctx.finding(
+                            self, node,
+                            f"{container}[<client id>] grows inside a "
+                            "loop with no eviction path in this file — "
+                            "O(population) resident host memory; use "
+                            "the client-state store or del/pop what "
+                            "the round no longer needs")
+            # 2) X.append(...) inside a loop over clients
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "append"):
+                container = dotted_name(node.func.value)
+                if not container or bounded(container):
+                    continue
+                if not in_spans(node.lineno, client_loops):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"{container}.append inside a per-client loop with "
+                    "no eviction path in this file — accumulates one "
+                    "entry per sampled client forever; bound it, evict "
+                    "it, or back it with the client-state store")

@@ -61,6 +61,26 @@ def locked_global_numpy_rng(seed: Optional[int] = None):
 AGG_KEY_SENTINEL = 2**31 - 1
 DEVICE_SAMPLE_SENTINEL = 2**31 - 2
 
+#: population size above which ``sample_clients`` switches to the O(k)
+#: virtualized draw (partial Fisher–Yates) instead of numpy's O(N)
+#: permutation-based ``choice``. At or below it the reference's exact
+#: draw stream is preserved bit-for-bit — the threshold sits ABOVE every
+#: population this repo has ever run resident (the largest is
+#: stackoverflow_nwp's 342,477 clients), so no existing scenario's
+#: cohort sequence changes and a pre-virtualization checkpoint resumes
+#: onto the identical trajectory. Above it (the new 10^6 territory)
+#: there is no prior behavior to match, so the virtualized stream
+#: DEFINES the contract at population scale (seeded, deterministic,
+#: thread-safe under the same global-RNG lock).
+#: ``$FEDML_TPU_VIRTUAL_SAMPLE_THRESHOLD`` overrides.
+VIRTUAL_SAMPLE_THRESHOLD = 1 << 19
+
+
+def _virtual_sample_threshold() -> int:
+    import os
+    env = os.environ.get("FEDML_TPU_VIRTUAL_SAMPLE_THRESHOLD")
+    return int(env) if env else VIRTUAL_SAMPLE_THRESHOLD
+
 
 def round_keys(base_key, round_idx, client_ids):
     """The per-round RNG chain EVERY FedAvg-family driver shares:
@@ -93,9 +113,19 @@ def sample_clients(
     ``delete_client`` (leave-one-out contribution measurement, reference
     fedml_api/contribution/horizontal/fedavg_api.py) removes one client from
     the candidate pool before drawing.
+
+    Populations above :data:`VIRTUAL_SAMPLE_THRESHOLD` take the
+    virtualized O(k) path (:func:`sample_clients_virtual`): numpy's
+    ``choice(replace=False)`` materializes a full N-permutation (plus the
+    candidate array) per round, which at N=10^6 is two 8 MB transients
+    and ~10 ms of shuffling for a 10-client cohort — per round. Below
+    the threshold the draw stream is byte-identical to before.
     """
     if client_num_in_total == client_num_per_round and delete_client is None:
         return np.arange(client_num_in_total)
+    if client_num_in_total > _virtual_sample_threshold():
+        return _sample_clients_floyd(round_idx, client_num_in_total,
+                                     client_num_per_round, delete_client)
     num_clients = min(client_num_per_round, client_num_in_total)
     candidates: Sequence[int] = range(client_num_in_total)
     if delete_client is not None:
@@ -104,6 +134,55 @@ def sample_clients(
     with _GLOBAL_RNG_LOCK:  # seed+draw must be atomic across threads
         np.random.seed(round_idx)
         return np.random.choice(candidates, num_clients, replace=False)
+
+
+def sample_clients_virtual(
+    round_idx: int,
+    client_num_in_total: int,
+    client_num_per_round: int,
+    delete_client: Optional[int] = None,
+    threshold: Optional[int] = None,
+) -> np.ndarray:
+    """Population-virtualized cohort sampling — the explicit entry point.
+
+    For populations at or under ``threshold`` (default
+    :data:`VIRTUAL_SAMPLE_THRESHOLD`) this DELEGATES to
+    :func:`sample_clients`, so the cohort is bit-identical to the
+    resident-dict path — the parity hook the exact-equality test hangs
+    on. Above it, a seeded partial Fisher–Yates draws ``k`` distinct ids
+    from ``[0, N)`` in O(k) time and memory — no per-client array of any
+    kind is materialized, which is what lets a 10^6-client population
+    sample in microseconds per round. Same locking contract: the seed
+    and every draw happen atomically under the global-RNG lock.
+    """
+    if threshold is None:
+        threshold = _virtual_sample_threshold()
+    if client_num_in_total <= threshold:
+        return sample_clients(round_idx, client_num_in_total,
+                              client_num_per_round, delete_client)
+    return _sample_clients_floyd(round_idx, client_num_in_total,
+                                 client_num_per_round, delete_client)
+
+
+def _sample_clients_floyd(round_idx: int, total: int, per_round: int,
+                          delete_client: Optional[int]) -> np.ndarray:
+    """k distinct draws from [0, N) via partial Fisher–Yates over a
+    virtual ``arange(N)``: only the swapped positions live in a dict, so
+    cost is O(k) regardless of N. ``delete_client`` shrinks the virtual
+    pool by one and remaps ids past the hole (uniformity preserved)."""
+    pool = total if delete_client is None else total - 1
+    k = min(per_round, pool)
+    out = np.empty(k, dtype=np.int64)
+    with _GLOBAL_RNG_LOCK:  # same seed+draw atomicity as the exact path
+        np.random.seed(round_idx)
+        swaps: dict = {}
+        for i in range(k):
+            j = int(np.random.randint(i, pool))
+            out[i] = swaps.get(j, j)
+            swaps[j] = swaps.get(i, i)
+    if delete_client is not None:
+        out[out >= delete_client] += 1
+    return out
 
 
 def eval_subsample(x, y, limit: Optional[int], seed: int):

@@ -163,9 +163,40 @@ def _build(client_num: int, class_num: int, hw: int, chans: int,
             logging.warning("gen cache %s unreadable (%s); regenerating",
                             cache, exc)
 
+    # one generation definition: the resident dicts here and the
+    # population-scale shard writer both consume stream_client_shards,
+    # so their per-client content cannot drift (bit-parity tested)
+    train_local, test_local = {}, {}
+    for i, train, test in stream_client_shards(
+            client_num, class_num, hw, chans, sizes, seed, noise,
+            label_noise_p, test_fraction, dominant):
+        train_local[i] = train
+        test_local[i] = test
+    if cache:
+        try:
+            _save_cache(cache, train_local, test_local, class_num)
+        except Exception as exc:  # noqa: BLE001 — the cache is a pure
+            # optimization; a failed save (OSError, MemoryError on the
+            # full-federation concatenate, ...) must never fail the build
+            logging.warning("gen cache %s not saved (%s)", cache, exc)
+    return FederatedDataset.from_client_arrays(train_local, test_local,
+                                               class_num)
+
+
+def stream_client_shards(client_num: int, class_num: int, hw: int,
+                         chans: int, sizes: np.ndarray, seed: int,
+                         noise: float, label_noise_p: float,
+                         test_fraction: float, dominant: int = 2):
+    """Generator twin of ``_build``'s client loop: yields ``(cid,
+    (x_train, y_train), (x_test, y_test))`` one client at a time with the
+    EXACT RNG consumption order of the resident builder — consumed start
+    to finish, client c's content is bit-identical to ``_build``'s
+    (parity-tested), but nothing accumulates: the caller decides whether
+    a client's arrays live (resident dict) or stream to shard files
+    (``fedml_tpu.state.population.write_federation_store``). At 10^5+
+    clients the resident dicts are the memory wall this sidesteps."""
     rng = np.random.RandomState(seed)
     protos = _class_prototypes(rng, class_num, hw, chans)
-    train_local, test_local = {}, {}
     for i, n in enumerate(sizes):
         n = int(n)
         dom = rng.choice(class_num, dominant, replace=False)
@@ -177,17 +208,38 @@ def _build(client_num: int, class_num: int, hw: int, chans: int,
         x = np.clip(x, 0.0, 1.0)
         y = apply_label_noise(y_clean, label_noise_p, class_num, rng)
         n_test = max(1, int(n * test_fraction))
-        test_local[i] = (x[:n_test], y[:n_test])
-        train_local[i] = (x[n_test:], y[n_test:])
-    if cache:
-        try:
-            _save_cache(cache, train_local, test_local, class_num)
-        except Exception as exc:  # noqa: BLE001 — the cache is a pure
-            # optimization; a failed save (OSError, MemoryError on the
-            # full-federation concatenate, ...) must never fail the build
-            logging.warning("gen cache %s not saved (%s)", cache, exc)
-    return FederatedDataset.from_client_arrays(train_local, test_local,
-                                               class_num)
+        yield i, (x[n_test:], y[n_test:]), (x[:n_test], y[:n_test])
+
+
+def build_femnist_store_federation(state_dir: str, client_num: int = 3400,
+                                   seed: int = 0,
+                                   target_acc: float = 0.849,
+                                   noise: float = 0.35,
+                                   test_fraction: float = 0.15,
+                                   cache_clients: int = 4096):
+    """FEMNIST-shape federation streamed into client-state shard files
+    instead of a resident ``Dict[int, ndarray]``: the memmap/shard
+    variant of :func:`build_femnist_federation` for populations whose
+    union does not fit host RAM. Returns the store-backed
+    ``VirtualFederatedDataset`` (reopen later with
+    ``fedml_tpu.state.load_federation_store``)."""
+    import os
+
+    from fedml_tpu.state.population import (load_federation_store,
+                                            write_federation_store)
+
+    class_num = 62
+    rng = np.random.RandomState(seed + 1)  # same size stream as resident
+    sizes = np.clip((20 + rng.lognormal(4.9, 0.6, client_num)).astype(int),
+                    20, 400)
+    p = label_noise_for_ceiling(target_acc, class_num)
+    if not os.path.exists(os.path.join(state_dir, "meta.json")):
+        write_federation_store(
+            state_dir,
+            stream_client_shards(client_num, class_num, 28, 1, sizes,
+                                 seed, noise, p, test_fraction),
+            class_num)
+    return load_federation_store(state_dir, cache_clients=cache_clients)
 
 
 def build_femnist_federation(client_num: int = 3400, seed: int = 0,

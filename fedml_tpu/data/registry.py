@@ -106,6 +106,24 @@ def _powerlaw_blob(data_dir, **kw):
         client_num=kw.get("client_num_in_total", 1000))
 
 
+def _virtual_powerlaw(data_dir, **kw):
+    from fedml_tpu.state.population import make_virtual_powerlaw_population
+    return make_virtual_powerlaw_population(
+        client_num=kw.get("client_num_in_total") or 1_000_000,
+        state_dir=kw.get("state_dir"),
+        cache_clients=kw.get("state_cache_clients") or 4096)
+
+
+def _store_federation(data_dir, **kw):
+    from fedml_tpu.state.population import load_federation_store
+    if not data_dir:
+        raise ValueError("dataset 'store' reads a corpus emitted by "
+                         "write_federation_store; pass its directory as "
+                         "--data_dir")
+    return load_federation_store(
+        data_dir, cache_clients=kw.get("state_cache_clients") or 4096)
+
+
 def _seg_shapes(data_dir, **kw):
     from fedml_tpu.data.synthetic import make_shapes_segmentation
     return make_shapes_segmentation(
@@ -206,6 +224,11 @@ LOADERS: Dict[str, Callable[..., FederatedDataset]] = {
     "synthetic": _synthetic_generated,  # generated in-memory (no files)
     "blob": _blob,                      # test/bench workhorse
     "powerlaw_blob": _powerlaw_blob,    # 1000-client power-law scale shape
+    # population-virtualized shapes (fedml_tpu/state/): clients are
+    # sampled into existence through the tiered store, host RSS is
+    # O(cohort + cache) — the 10^6-client north-star shapes
+    "virtual_powerlaw": _virtual_powerlaw,
+    "store": _store_federation,         # reopen a streamed shard corpus
     "seg_shapes": _seg_shapes,          # synthetic segmentation (fedseg)
     "img_blob": _img_blob,              # synthetic NHWC image classification
     "token_blob": _token_blob,          # synthetic token sequences (nwp)
@@ -240,6 +263,7 @@ DEFAULT_MODEL_AND_TASK = {
     "synthetic": ("lr", "classification"),
     "blob": ("lr", "classification"),
     "powerlaw_blob": ("lr", "classification"),
+    "virtual_powerlaw": ("lr", "classification"),
     "seg_shapes": ("segnet", "segmentation"),
     "img_blob": ("resnet56", "classification"),
     "token_blob": ("transformer", "nwp"),

@@ -22,10 +22,29 @@ import numpy as np
 from fedml_tpu.data.base import FederatedDataset
 
 
+#: above this client count the per-client size vector is gathered in
+#: vectorized chunks (virtual populations) and the report adds the
+#: min/p50/p90/max quantiles instead of anything per-client
+SUMMARY_CLIENTS = 10_000
+
+
+def _client_counts(ds) -> np.ndarray:
+    """Per-client sample counts. Virtual populations expose a vectorized
+    ``sizes_for`` — scan it through the shared chunk helper so a
+    10^6-client report never builds a per-client Python structure;
+    resident datasets read the dict."""
+    if hasattr(ds, "sizes_for"):
+        from fedml_tpu.state.population import iter_size_chunks
+        chunks = list(iter_size_chunks(ds.sizes_for, ds.client_num))
+        return (np.concatenate(chunks).astype(np.float64)
+                if chunks else np.zeros(0, np.float64))
+    return np.asarray([ds.train_data_local_num_dict[c]
+                       for c in sorted(ds.train_data_local_num_dict)],
+                      np.float64)
+
+
 def federation_stats(ds: FederatedDataset) -> Dict[str, float]:
-    counts = np.asarray([ds.train_data_local_num_dict[c]
-                         for c in sorted(ds.train_data_local_num_dict)],
-                        np.float64)
+    counts = _client_counts(ds)
     mean = float(counts.mean()) if len(counts) else 0.0
     std = float(counts.std()) if len(counts) else 0.0
     # Fisher-Pearson skewness without scipy (reference uses scipy.stats.skew)
@@ -43,7 +62,16 @@ def federation_stats(ds: FederatedDataset) -> Dict[str, float]:
         "test_samples_total": int(ds.test_data_num),
         "class_num": int(ds.class_num),
     }
-    # per-class histogram over the train union (partition skew at a glance)
+    if len(counts) and ds.client_num > SUMMARY_CLIENTS:
+        out["num_samples_quantiles"] = {
+            "min": int(counts.min()),
+            "p50": int(np.percentile(counts, 50)),
+            "p90": int(np.percentile(counts, 90)),
+            "max": int(counts.max()),
+        }
+    # per-class histogram over the train union (partition skew at a
+    # glance; for virtual populations this union is the fixed seeded
+    # eval cohort, not the unmaterializable full population)
     y = np.asarray(ds.train_data_global[1])
     if y.ndim == 1 and np.issubdtype(y.dtype, np.integer):
         hist = np.bincount(y, minlength=ds.class_num)

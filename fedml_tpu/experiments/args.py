@@ -127,6 +127,27 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "'seed=7;drop:p=0.1;delay:p=0.2,delay_ms=50', "
                              "inline JSON, or a .json path. Wraps every "
                              "comm endpoint; empty/unset = no injection")
+    # -- population virtualization (fedml_tpu/state/) -----------------------
+    parser.add_argument("--population", type=int, default=None,
+                        help="virtualize the client population at this "
+                             "size: overrides --client_num_in_total and "
+                             "routes per-client shards through the "
+                             "tiered client-state store, so host memory "
+                             "is O(cohort + cache) instead of "
+                             "O(population). Datasets 'virtual_powerlaw' "
+                             "and 'store' honor it natively; resident "
+                             "loaders just get the bigger client count.")
+    parser.add_argument("--state_dir", type=str, default=None,
+                        help="client-state store directory (shard files "
+                             "for per-client state: EF residuals, data "
+                             "indices, streamed corpora). Unset = the "
+                             "RAM-only LRU tier (generative datasets) / "
+                             "checkpoint_dir-derived silo state.")
+    parser.add_argument("--state_cache_clients", type=int, default=4096,
+                        help="client-state store LRU budget, in clients: "
+                             "how many clients' shards stay resident in "
+                             "host RAM before write-back/eviction — the "
+                             "knob that bounds RSS at population scale")
     parser.add_argument("--ci", type=int, default=0,
                         help="1 = tiny smoke-run truncation (reference --ci)")
     return parser
@@ -138,10 +159,19 @@ def build_dataset_and_model(args):
     from fedml_tpu.data.registry import (DEFAULT_MODEL_AND_TASK, load_data)
     from fedml_tpu.models import create_model
 
+    client_num = args.client_num_in_total
+    if getattr(args, "population", None):
+        # the population flag IS the client count — and because every
+        # sampler above VIRTUAL_SAMPLE_THRESHOLD draws O(cohort), it can
+        # be 10^6 without the host ever materializing per-client arrays
+        client_num = args.population
     ds = load_data(args.dataset, args.data_dir,
                    partition_method=args.partition_method,
                    partition_alpha=args.partition_alpha,
-                   client_num_in_total=args.client_num_in_total)
+                   client_num_in_total=client_num,
+                   state_dir=getattr(args, "state_dir", None),
+                   state_cache_clients=getattr(args, "state_cache_clients",
+                                               None))
     if args.dataset not in DEFAULT_MODEL_AND_TASK and not args.model:
         import logging
         logging.warning("no reference model pairing for dataset %r; "

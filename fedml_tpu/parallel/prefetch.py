@@ -264,6 +264,15 @@ def consume(pf: RoundPrefetcher, key, timer, dataset, repack,
     contract is ``(dataset, ...)`` — produce snapshots the dataset it
     packed from as element 0.
 
+    With a store-backed virtual population (fedml_tpu/state/), the
+    ``produce`` running on the worker IS the streaming cohort
+    materialization: shard fetch (LRU/disk/generate) + pack + upload for
+    round r+1 overlaps round r's device compute, and the store's cache —
+    not a resident ``_pack_cache`` — is what absorbs repeat-sampled
+    clients. Every consume also samples peak host RSS into the timer's
+    ``host_rss_peak_mb`` gauge: the round loop's choke point is where
+    the O(cohort + cache) memory claim gets measured, round by round.
+
     ``round_bound`` (integer keys only): speculate successor rounds
     strictly below it — the round-loop clamp that keeps the last rounds
     from packing slots nothing will consume."""
@@ -277,4 +286,5 @@ def consume(pf: RoundPrefetcher, key, timer, dataset, repack,
         payload = repack(key)
     timer.add("prefetch_wait", waited)
     timer.count("prefetch_hit" if hit else "prefetch_miss")
+    timer.update_rss()
     return payload

@@ -1,0 +1,25 @@
+"""Tiered client-state subsystem: population >> cohort >> cache.
+
+``store`` — the sharded, disk-backed per-client state store with an LRU
+host-RAM cache (EF residuals, optimizer state, data-shard indices, data
+shards). ``population`` — virtual federated datasets sampled into
+existence per cohort instead of held resident. ``residuals`` — the
+cross-silo EF-residual history on the store API (with the PR-4
+checkpoint layout's backward-compat reader).
+"""
+
+from fedml_tpu.state.population import (VirtualFederatedDataset,
+                                        load_federation_store,
+                                        make_virtual_powerlaw_population,
+                                        pareto_sizes,
+                                        write_federation_store)
+from fedml_tpu.state.residuals import SiloResidualStore
+from fedml_tpu.state.store import (DEFAULT_CACHE_CLIENTS,
+                                   DEFAULT_SHARD_CLIENTS, ClientStateStore)
+
+__all__ = [
+    "ClientStateStore", "DEFAULT_CACHE_CLIENTS", "DEFAULT_SHARD_CLIENTS",
+    "SiloResidualStore", "VirtualFederatedDataset",
+    "load_federation_store", "make_virtual_powerlaw_population",
+    "pareto_sizes", "write_federation_store",
+]

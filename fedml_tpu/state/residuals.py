@@ -1,0 +1,132 @@
+"""Per-silo EF-residual history on the client-state store API.
+
+PR 4 checkpointed each silo's error-feedback residual with a private
+:class:`~fedml_tpu.utils.checkpoint.CheckpointManager` under
+``checkpoint_dir/silo_<rank>/`` — one msgpack blob + json sidecar per
+round. That layout is a per-client-state store in miniature; this module
+re-homes it on :class:`~fedml_tpu.state.store.ClientStateStore` (field
+``"residual"``, keyed by the ROUND index — the store keys by integer id
+and does not care that the integer means "round" here), which buys the
+shared atomic-writeback/LRU/counter machinery and retires the bespoke
+flax serialization for a flat f32 array.
+
+**Backward compatibility is a hard contract**: a silo resumed against a
+PR-4-era directory must restore its residual float-for-float. ``load``
+therefore falls back to reading the legacy ``round_<r>`` msgpack layout
+when the store has no entry for the round (tested: resume-parity against
+artifacts written by the old manager).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.state.store import ClientStateStore
+
+#: rounds of residual history kept, matching the legacy manager's
+#: ``keep_last_n`` default (older rounds are GC'd at save)
+KEEP_LAST_N = 3
+
+#: residual history is tiny (one entry per retained round) — one shard
+#: file per save keeps write-back O(entry), not O(history)
+_SHARD_ROUNDS = 4
+
+
+class SiloResidualStore:
+    def __init__(self, state_dir: str, keep_last_n: int = KEEP_LAST_N,
+                 timer=None):
+        self.state_dir = state_dir
+        self.keep_last_n = int(keep_last_n)
+        self._store = ClientStateStore(state_dir,
+                                       shard_clients=_SHARD_ROUNDS,
+                                       cache_clients=_SHARD_ROUNDS
+                                       * (self.keep_last_n + 1),
+                                       timer=timer)
+        self._store.register_field("residual", persist=True)
+
+    def save(self, round_idx: int, residual: np.ndarray) -> None:
+        """Persist the residual entering ``round_idx`` (same
+        rounds-completed keying as the server's model checkpoint, so
+        restore-at-resumed-round lines both up), GC'ing history beyond
+        ``keep_last_n`` — both the store's own and any legacy files."""
+        self._store.put("residual", round_idx,
+                        np.asarray(residual, dtype=np.float32))
+        for old in self._store.known_ids("residual"):
+            if old <= round_idx - self.keep_last_n:
+                self._store.delete("residual", old)
+        self._store.flush()
+        self._gc_legacy(round_idx)
+
+    def load(self, round_idx: int, dim: int) -> Optional[np.ndarray]:
+        """The residual checkpointed for ``round_idx``, or None when no
+        layout (new or legacy) holds one — the caller's zeros fallback is
+        convergence-safe (EF re-loses pending mass, never corrupts)."""
+        try:
+            arr = self._store.get("residual", round_idx)
+            if arr.shape != (dim,):
+                logging.warning(
+                    "residual checkpoint for round %d has shape %s, "
+                    "expected (%d,) — model changed since the "
+                    "checkpoint; starting error feedback from zero",
+                    round_idx, arr.shape, dim)
+                return None
+            return np.asarray(arr, dtype=np.float32)
+        except KeyError:
+            return self._load_legacy(round_idx, dim)
+
+    # -- PR-4 layout (CheckpointManager: msgpack blob + json sidecar) ------
+    def _legacy_path(self, round_idx: int) -> str:
+        return os.path.join(self.state_dir, f"round_{round_idx:08d}")
+
+    def _load_legacy(self, round_idx: int, dim: int) -> Optional[np.ndarray]:
+        path = self._legacy_path(round_idx)
+        if not (os.path.exists(path) and os.path.exists(path + ".json")):
+            return None
+        import flax.serialization
+
+        with open(path, "rb") as f:
+            state = flax.serialization.from_bytes(
+                {"residual": np.zeros(dim, np.float32)}, f.read())
+        logging.info("restored legacy (PR-4 layout) residual checkpoint "
+                     "%s", path)
+        return np.asarray(state["residual"], dtype=np.float32)
+
+    def _gc_legacy(self, round_idx: int) -> None:
+        """A migrated silo keeps writing rounds forward; its stale legacy
+        files would otherwise live forever. Same retention window."""
+        try:
+            names = os.listdir(self.state_dir)
+        except FileNotFoundError:
+            return
+        for fn in names:
+            if not fn.startswith("round_"):
+                continue
+            try:
+                r = int(fn.split(".")[0].split("_")[1])
+            except (IndexError, ValueError):
+                continue
+            if r <= round_idx - self.keep_last_n:
+                with contextlib.suppress(FileNotFoundError):
+                    os.remove(os.path.join(self.state_dir, fn))
+
+    def latest_round(self) -> Optional[int]:
+        rounds = set(self._store.known_ids("residual"))
+        try:
+            for fn in os.listdir(self.state_dir):
+                if fn.startswith("round_") and not fn.endswith(
+                        (".json", ".tmp")):
+                    stem = fn.split(".")[0]
+                    if os.path.exists(os.path.join(
+                            self.state_dir, stem + ".json")):
+                        rounds.add(int(stem.split("_")[1]))
+        except (FileNotFoundError, ValueError):
+            pass
+        return max(rounds) if rounds else None
+
+    def stats(self) -> dict:
+        return self._store.stats()

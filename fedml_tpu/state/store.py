@@ -1,0 +1,369 @@
+"""Tiered client-state store: disk shards + LRU host cache, O(cache) RSS.
+
+The paper's production claim is federated training over populations of
+*millions* of clients, but every per-client artifact in the repo used to
+be a resident in-process Python dict (partition index maps, EF
+residuals, data shards) — host memory grew linearly with population and
+the largest runnable scenario was 1000 clients. Bonawitz et al.'s system
+design (the pace-steering paper) holds only the *cohort* on the server
+while the population lives in a selected-on-demand store; this module is
+that store, as a host-side subsystem feeding the existing device
+pipeline.
+
+Three tiers:
+
+- **disk**: per-field shard files ``<dir>/<field>/shard_<i>.npz``, each
+  holding ``shard_clients`` consecutive client ids' arrays. Writes are
+  atomic (tmp + ``os.replace``), so a round that dies mid-writeback
+  leaves every shard either the old or the new COMPLETE version — never
+  a torn file (crash-consistency contract, tested).
+- **host RAM**: an LRU of loaded shards bounded by ``cache_clients``
+  (budget in clients, rounded up to whole shards). Eviction writes dirty
+  shards back first; the budget — not the population — is what bounds
+  RSS.
+- **HBM (pin tier)**: the active cohort's *packed* device arrays are the
+  payload slots the round pipeline already holds (parallel/prefetch.py,
+  ≤ depth cohorts in flight) — the store's job ends at handing the pack
+  loop host arrays, so the device tier needs no copy of its own.
+  ``pinned`` shard refcounts keep the LRU from evicting a shard mid-pack
+  while a worker thread gathers from it.
+
+Fields are namespaces ("train_x", "residual", "data_idx", ...); a field
+created with ``persist=False`` is a pure RAM LRU over a generator
+(``get_or_create``) — the 1M-client synthetic bench uses this so it
+never writes a multi-GB corpus to disk, while still exercising the exact
+cache/eviction machinery the disk-backed fields use.
+
+Thread-safe (one RLock): the round prefetcher's worker packs cohort
+r+1 from the store while the main thread closes round r.
+
+Counters (``stats()``, mirrored into a bound
+:class:`~fedml_tpu.utils.tracing.RoundTimer` as ``state_*``):
+``state_cache_hits`` / ``state_cache_misses`` / ``state_evictions`` /
+``state_bytes_read`` / ``state_bytes_written`` — the memory-flat bench
+claim is measured from these plus ``host_rss_peak_mb``, not asserted.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+#: default LRU budget, in clients (flag: --state_cache_clients)
+DEFAULT_CACHE_CLIENTS = 4096
+#: default clients per shard file — small enough that one miss reads
+#: ~shard_clients * per-client bytes, big enough that a cohort of
+#: hundreds touches few files
+DEFAULT_SHARD_CLIENTS = 256
+
+
+class _Shard:
+    """One resident shard: ``entries[cid] -> ndarray`` plus bookkeeping."""
+
+    __slots__ = ("entries", "dirty", "nbytes")
+
+    def __init__(self, entries: Dict[int, np.ndarray], dirty: bool):
+        self.entries = entries
+        self.dirty = dirty
+        self.nbytes = sum(a.nbytes for a in entries.values())
+
+
+class ClientStateStore:
+    """Sharded, disk-backed per-client state with an LRU host-RAM cache.
+
+    ``state_dir=None`` is the pure-RAM mode: every field behaves as
+    ``persist=False`` (LRU over generators, nothing touches disk) —
+    still bounded by ``cache_clients``, still counted.
+    """
+
+    def __init__(self, state_dir: Optional[str] = None,
+                 shard_clients: int = DEFAULT_SHARD_CLIENTS,
+                 cache_clients: int = DEFAULT_CACHE_CLIENTS,
+                 timer=None):
+        if shard_clients <= 0:
+            raise ValueError(f"shard_clients must be >= 1 "
+                             f"(got {shard_clients})")
+        self.state_dir = state_dir
+        # shard geometry is part of the on-disk format: a reader opening
+        # with a different shard_clients would compute wrong shard
+        # indices and report existing clients missing — so the dir
+        # self-describes and an existing store.json ALWAYS wins
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            desc = os.path.join(state_dir, "store.json")
+            if os.path.exists(desc):
+                import json
+                with open(desc) as f:
+                    on_disk = int(json.load(f)["shard_clients"])
+                if on_disk != shard_clients:
+                    logging.debug(
+                        "state store %s: using on-disk shard_clients=%d "
+                        "(caller asked %d)", state_dir, on_disk,
+                        shard_clients)
+                shard_clients = on_disk
+            else:
+                import json
+                tmp = f"{desc}.{os.getpid()}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"shard_clients": int(shard_clients)}, f)
+                os.replace(tmp, desc)
+        self.shard_clients = int(shard_clients)
+        self.cache_shards = max(
+            1, -(-int(max(1, cache_clients)) // self.shard_clients))
+        self._persist: Dict[str, bool] = {}
+        self._shards: "OrderedDict[Tuple[str, int], _Shard]" = OrderedDict()
+        #: shard-key -> pin refcount; keys are pinned whether or not the
+        #: shard is resident yet, so a shard FAULTED IN during a pinned
+        #: gather is protected too (at population scale nearly every
+        #: cohort member is a first-touch load inside the pack loop)
+        self._pins: Dict[Tuple[str, int], int] = {}
+        self._lock = threading.RLock()
+        self._timer = timer
+        self._stats = {"state_cache_hits": 0, "state_cache_misses": 0,
+                       "state_evictions": 0, "state_bytes_read": 0,
+                       "state_bytes_written": 0}
+
+    # -- field + timer plumbing -------------------------------------------
+    def register_field(self, field: str, persist: bool = True) -> None:
+        """Declare a field's disk behavior. Unregistered fields default to
+        persist-iff-``state_dir``; ``persist=False`` keeps the field a
+        RAM-only LRU over its generator (no disk writes ever)."""
+        self._persist[field] = bool(persist) and self.state_dir is not None
+
+    def field_registered(self, field: str) -> bool:
+        """Whether ``register_field`` has been called for ``field`` —
+        layered constructors use this to respect a factory's earlier
+        persistence decision instead of overriding it."""
+        return field in self._persist
+
+    def bind_timer(self, timer) -> None:
+        """Mirror every counter bump into ``timer.count('state_*')`` from
+        now on (drivers bind their RoundTimer at construction) and credit
+        the counts accumulated before binding, so early misses aren't
+        lost to the evidence row."""
+        with self._lock:
+            self._timer = timer
+            if timer is not None:
+                for k, v in self._stats.items():
+                    if v:
+                        timer.count(k, v)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self._stats[name] += n
+        if self._timer is not None:
+            self._timer.count(name, n)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    # -- shard addressing --------------------------------------------------
+    def _field_persists(self, field: str) -> bool:
+        return self._persist.get(field, self.state_dir is not None)
+
+    def _shard_path(self, field: str, shard_idx: int) -> str:
+        assert self.state_dir is not None
+        return os.path.join(self.state_dir, field,
+                            f"shard_{shard_idx:08d}.npz")
+
+    def _load_shard(self, field: str, shard_idx: int) -> _Shard:
+        """Disk -> RAM: read one shard file (or start it empty)."""
+        if self._field_persists(field):
+            path = self._shard_path(field, shard_idx)
+            if os.path.exists(path):
+                with np.load(path) as z:
+                    entries = {int(k[1:]): np.asarray(z[k]) for k in z.files}
+                shard = _Shard(entries, dirty=False)
+                self._count("state_bytes_read", os.path.getsize(path))
+                return shard
+        return _Shard({}, dirty=False)
+
+    def _write_shard(self, field: str, shard_idx: int,
+                     shard: _Shard) -> None:
+        """RAM -> disk, atomically: a crash between tmp-write and replace
+        leaves the previous complete version in place."""
+        path = self._shard_path(field, shard_idx)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if not shard.entries:
+            # a fully-deleted shard removes its file (GC'd residual
+            # history must not leave empty npz husks behind)
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(path)
+            shard.dirty = False
+            return
+        tmp = f"{path}.{os.getpid()}.tmp.npz"  # savez appends .npz itself
+        np.savez(tmp, **{f"c{cid}": arr
+                         for cid, arr in shard.entries.items()})
+        os.replace(tmp, path)
+        self._count("state_bytes_written", os.path.getsize(path))
+        shard.dirty = False
+
+    def _resident(self, field: str, cid: int) -> _Shard:
+        """The shard holding ``cid``, loaded + LRU-promoted; evicts past
+        the budget (caller holds the lock)."""
+        key = (field, cid // self.shard_clients)
+        shard = self._shards.get(key)
+        if shard is not None:
+            self._count("state_cache_hits")
+            self._shards.move_to_end(key)
+            return shard
+        self._count("state_cache_misses")
+        shard = self._load_shard(field, key[1])
+        self._shards[key] = shard
+        self._evict_over_budget()
+        return shard
+
+    def _evict_over_budget(self) -> None:
+        """Drop least-recently-used shards past ``cache_shards``, writing
+        dirty ones back first. Pinned keys are skipped (a thread is
+        mid-gather on them); if everything is pinned the cache
+        overshoots temporarily rather than corrupting a pack."""
+        while len(self._shards) > self.cache_shards:
+            victim = next((k for k in self._shards
+                           if self._pins.get(k, 0) == 0), None)
+            if victim is None:
+                return
+            shard = self._shards.pop(victim)
+            if shard.dirty and self._field_persists(victim[0]):
+                self._write_shard(*victim, shard)
+            elif shard.dirty:
+                logging.debug("state: dropping dirty non-persistent shard "
+                              "%s/%d (regenerable field)", *victim)
+            self._count("state_evictions")
+
+    # -- per-client API ----------------------------------------------------
+    def get(self, field: str, cid: int) -> np.ndarray:
+        """Client ``cid``'s array under ``field``; KeyError if absent."""
+        with self._lock:
+            shard = self._resident(field, int(cid))
+            try:
+                return shard.entries[int(cid)]
+            except KeyError:
+                raise KeyError(f"state {field!r} has no client {cid}") \
+                    from None
+
+    def get_or_create(self, field: str, cid: int,
+                      create: Callable[[int], np.ndarray]) -> np.ndarray:
+        """``get`` with a generator fallback: an entry absent from cache
+        AND disk is created by ``create(cid)`` (pure function of the id),
+        cached, and — for persistent fields — written back on
+        eviction/flush. This is how generative virtual populations ride
+        the same LRU machinery as disk corpora."""
+        cid = int(cid)
+        with self._lock:
+            shard = self._resident(field, cid)
+            arr = shard.entries.get(cid)
+            if arr is None:
+                arr = create(cid)
+                shard.entries[cid] = arr
+                shard.nbytes += arr.nbytes
+                shard.dirty = True
+            return arr
+
+    def put(self, field: str, cid: int, arr: np.ndarray) -> None:
+        cid = int(cid)
+        arr = np.asarray(arr)
+        with self._lock:
+            shard = self._resident(field, cid)
+            old = shard.entries.get(cid)
+            shard.nbytes += arr.nbytes - (old.nbytes if old is not None
+                                          else 0)
+            shard.entries[cid] = arr
+            shard.dirty = True
+
+    def delete(self, field: str, cid: int) -> bool:
+        """Remove one entry (GC of round-keyed residual history). Returns
+        whether it existed anywhere; an emptied persistent shard removes
+        its file on write-back."""
+        cid = int(cid)
+        with self._lock:
+            shard = self._resident(field, cid)
+            old = shard.entries.pop(cid, None)
+            if old is not None:
+                shard.nbytes -= old.nbytes
+                shard.dirty = True
+                return True
+            return False
+
+    def known_ids(self, field: str) -> Iterable[int]:
+        """Every client id present for ``field``, cache AND disk (scans
+        shard files without loading arrays — directory metadata only for
+        unloaded shards' ids via a header read)."""
+        with self._lock:
+            seen = set()
+            for (f, _), shard in self._shards.items():
+                if f == field:
+                    seen.update(shard.entries)
+            if self._field_persists(field):
+                import re
+                fdir = os.path.join(self.state_dir, field)
+                if os.path.isdir(fdir):
+                    for fn in os.listdir(fdir):
+                        # exact-name match so a crash's stray
+                        # shard_*.npz.<pid>.tmp.npz is never parsed
+                        m = re.fullmatch(r"shard_(\d+)\.npz", fn)
+                        if not m:
+                            continue
+                        idx = int(m.group(1))
+                        if (field, idx) in self._shards:
+                            continue  # resident copy is authoritative
+                        with np.load(os.path.join(fdir, fn)) as z:
+                            seen.update(int(k[1:]) for k in z.files)
+            return sorted(seen)
+
+    @contextlib.contextmanager
+    def pinned(self, field: str, cids):
+        """Pin the shard KEYS covering ``cids`` for the duration
+        (refcounted): the pack loop holds this while gathering a cohort
+        so a concurrent thread's miss can't evict a shard out from under
+        the copy — including shards only faulted in partway through the
+        gather (pins are on keys, not on resident shards)."""
+        keys = sorted({(field, int(c) // self.shard_clients) for c in cids})
+        with self._lock:
+            for k in keys:
+                self._pins[k] = self._pins.get(k, 0) + 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                for k in keys:
+                    n = self._pins.get(k, 0) - 1
+                    if n <= 0:
+                        self._pins.pop(k, None)
+                    else:
+                        self._pins[k] = n
+                self._evict_over_budget()
+
+    # -- round-close / lifecycle ------------------------------------------
+    def flush(self) -> int:
+        """Write every dirty persistent shard back (round close). Returns
+        the number of shards written. Each write is individually atomic;
+        a crash mid-flush leaves a prefix of shards at the new version
+        and the rest at the old — all readable."""
+        written = 0
+        with self._lock:
+            for (field, idx), shard in list(self._shards.items()):
+                if shard.dirty and self._field_persists(field):
+                    self._write_shard(field, idx, shard)
+                    written += 1
+        return written
+
+    def drop_cache(self) -> None:
+        """Flush, then empty the RAM tier (tests + memory pressure)."""
+        with self._lock:
+            self.flush()
+            self._shards.clear()
+
+    def resident_clients(self) -> int:
+        with self._lock:
+            return sum(len(s.entries) for s in self._shards.values())
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(s.nbytes for s in self._shards.values())

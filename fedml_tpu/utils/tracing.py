@@ -27,6 +27,9 @@ class RoundTimer:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
         self.counters: Dict[str, int] = defaultdict(int)
+        #: high-water marks (``gauge`` keeps the max, not a sum) —
+        #: ``host_rss_peak_mb`` and friends
+        self.gauges: Dict[str, float] = {}
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -46,9 +49,35 @@ class RoundTimer:
 
     def count(self, name: str, n: int = 1) -> None:
         """Bump an event counter (e.g. ``prefetch_hit``/``prefetch_miss``,
-        or the wire accounting ``comm_bytes_up``/``comm_bytes_down``)."""
+        the wire accounting ``comm_bytes_up``/``comm_bytes_down``, or the
+        client-state store tiers ``state_cache_hits``/``state_cache_misses``/
+        ``state_evictions``/``state_bytes_read``/``state_bytes_written``)."""
         with self._lock:
             self.counters[name] += n
+
+    def gauge(self, name: str, value: float) -> None:
+        """Record a high-water mark: the gauge keeps ``max(old, value)``
+        (peaks must survive aggregation — a mean of RSS samples would
+        hide exactly the spike the memory-flat claim cares about)."""
+        with self._lock:
+            self.gauges[name] = max(self.gauges.get(name, value), value)
+
+    @staticmethod
+    def host_rss_mb() -> float:
+        """This process's peak resident set size in MB (linux ru_maxrss
+        is KB). The population benches read it per leg — each leg runs
+        in its own subprocess because the high-water mark never goes
+        back down."""
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+    def update_rss(self) -> float:
+        """Sample peak host RSS into the ``host_rss_peak_mb`` gauge —
+        called per round from the cohort-consume path so the memory-flat
+        claim is measured by the run itself, not asserted after it."""
+        mb = self.host_rss_mb()
+        self.gauge("host_rss_peak_mb", mb)
+        return mb
 
     @property
     def comm_bytes_up(self) -> int:
@@ -73,9 +102,13 @@ class RoundTimer:
                          for k, v in sorted(self.means().items()))
         with self._lock:
             counters = dict(self.counters)
+            gauges = dict(self.gauges)
         if counters:
             out += " | " + " | ".join(
                 f"{k}: {v}" for k, v in sorted(counters.items()))
+        if gauges:
+            out += " | " + " | ".join(
+                f"{k}: {v:.1f}" for k, v in sorted(gauges.items()))
         return out
 
 

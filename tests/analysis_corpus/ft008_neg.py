@@ -1,0 +1,34 @@
+"""FT008 negative corpus: bounded / store-backed / evicted per-client
+state — every pattern here must stay clean."""
+
+
+class BoundedServer:
+    def __init__(self, store):
+        self.store = store          # fedml_tpu.state ClientStateStore
+        self.window = {}
+        self.history = []
+        self.lru_cache = {}
+
+    def run(self, rounds, sample, train):
+        for r in range(rounds):
+            for client_id in sample(r):
+                # store-backed: the LRU/disk tiers bound residency
+                self.store.put("residual", client_id, train(client_id))
+                # cache-named containers implement the bounded tier
+                self.lru_cache[client_id] = train(client_id)
+            # per-ROUND record in a round loop (not a client loop)
+            self.history.append(r)
+
+    def windowed(self, rounds, sample, train):
+        for r in range(rounds):
+            for client_id in sample(r):
+                self.window[client_id] = train(client_id)
+            # eviction path: the structure has a shrink policy
+            for stale in [c for c in self.window if c not in sample(r)]:
+                del self.window[stale]
+
+    def local_only(self, cohort, train):
+        out = []
+        for batch in range(4):      # not a client loop
+            out.append(train(batch))
+        return out

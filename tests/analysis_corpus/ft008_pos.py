@@ -1,0 +1,30 @@
+"""FT008 positive corpus: unbounded per-client accumulation in loops.
+
+Every statement here grows a resident per-client structure inside a
+round/client loop with no eviction path anywhere in the file — the
+memory wall the tiered client-state store (fedml_tpu/state/) removes.
+"""
+
+
+class LeakyServer:
+    def __init__(self):
+        self.residuals = {}
+        self.per_client_log = []
+        self.opt_states = {}
+
+    def run(self, rounds, population, sample, train):
+        for r in range(rounds):
+            for client_id in sample(r):
+                # per-client dict entry every round, never evicted:
+                # O(population) resident host memory at 10^6 clients
+                self.residuals[client_id] = train(client_id)
+            for c in sample(r):
+                # one log entry per sampled client forever
+                self.per_client_log.append((r, c))
+
+    def assign(self, cohort, fresh):
+        stats = {}
+        for cid in cohort:
+            stats[cid] = fresh(cid)
+            self.opt_states[cid] = fresh(cid)
+        return stats

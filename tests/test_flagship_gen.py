@@ -197,18 +197,22 @@ class TestGenVersionGuard:
         import fedml_tpu.data.flagship_gen as fg
         import fedml_tpu.data.leaf_gen as lg
         src = "".join(inspect.getsource(f) for f in (
-            fg._build, fg._class_prototypes, fg.apply_label_noise,
+            fg._build, fg.stream_client_shards, fg._class_prototypes,
+            fg.apply_label_noise,
             fg.label_noise_for_ceiling, fg.build_femnist_federation,
             fg.build_fedcifar100_federation,
             fg.build_stackoverflow_nwp_federation,
             lg.build_shakespeare_federation))
         return hashlib.sha256(src.encode()).hexdigest()
 
-    # re-pinned without a version bump for the None->empty-test-split
-    # normalization: generated array CONTENT is unchanged, so existing
-    # caches stay valid (a content-changing edit must bump _GEN_VERSION)
-    EXPECTED = ("259b1f57adb063163c149b878c6afa9bb8e42793db17065e4eeb806d"
-                "052863df")
+    # re-pinned without a version bump twice: (r9) the None->empty-test-
+    # split normalization; (r11) the client loop moved into
+    # stream_client_shards — which _build now consumes and this digest
+    # now covers — with per-client CONTENT bit-identical (parity test:
+    # test_population.py TestStoreBackedFederation), so existing caches
+    # stay valid (a content-changing edit must bump _GEN_VERSION)
+    EXPECTED = ("9effdc1d7ae9c8ecfb4a0841828600e68c5376f58f5ed967ac21157e"
+                "70716849")
 
     def test_source_hash_matches_pinned_version(self):
         import fedml_tpu.data.flagship_gen as fg

@@ -1,0 +1,362 @@
+"""Population virtualization: O(k) sampling parity, virtual datasets,
+streaming partition/generation, and the FedAvg driver over a store-backed
+population."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.partition import (STATS_SUMMARY_THRESHOLD,
+                                      homo_partition, partition_data,
+                                      partition_to_store,
+                                      record_data_stats, stream_partition)
+from fedml_tpu.core.sampling import (VIRTUAL_SAMPLE_THRESHOLD,
+                                     locked_global_numpy_rng,
+                                     sample_clients, sample_clients_virtual)
+from fedml_tpu.state.population import (VirtualFederatedDataset,
+                                        load_federation_store,
+                                        make_virtual_powerlaw_population,
+                                        pareto_sizes,
+                                        write_federation_store)
+from fedml_tpu.state.store import ClientStateStore
+
+
+class TestVirtualSampling:
+    def test_bit_identical_to_resident_sampler(self):
+        """ACCEPTANCE: on an in-memory-sized population the virtualized
+        entry point draws the exact cohort ``sample_clients`` draws —
+        bit-for-bit, every round."""
+        for r in range(25):
+            np.testing.assert_array_equal(
+                sample_clients(r, 1000, 10),
+                sample_clients_virtual(r, 1000, 10))
+        # delete_client path too
+        for r in range(5):
+            np.testing.assert_array_equal(
+                sample_clients(r, 200, 20, delete_client=7),
+                sample_clients_virtual(r, 200, 20, delete_client=7))
+
+    def test_floyd_path_seeded_distinct_in_range(self):
+        a = sample_clients_virtual(3, 10_000, 64, threshold=100)
+        b = sample_clients_virtual(3, 10_000, 64, threshold=100)
+        np.testing.assert_array_equal(a, b)  # deterministic per round
+        assert len(set(a.tolist())) == 64    # without replacement
+        assert a.min() >= 0 and a.max() < 10_000
+        c = sample_clients_virtual(4, 10_000, 64, threshold=100)
+        assert set(a.tolist()) != set(c.tolist())  # round-keyed stream
+
+    def test_floyd_delete_client_never_drawn(self):
+        for r in range(10):
+            out = sample_clients_virtual(r, 5000, 100, delete_client=42,
+                                         threshold=100)
+            assert 42 not in out
+            assert len(set(out.tolist())) == 100
+            assert out.max() < 5000
+
+    def test_sample_clients_routes_over_threshold(self):
+        """Above the threshold the resident sampler itself takes the O(k)
+        path — same draws as the explicit virtual entry."""
+        n = VIRTUAL_SAMPLE_THRESHOLD + 1
+        np.testing.assert_array_equal(
+            sample_clients(2, n, 10), sample_clients_virtual(2, n, 10))
+
+    def test_million_draw_is_fast_and_valid(self):
+        import time
+        t0 = time.perf_counter()
+        out = sample_clients(7, 1_000_000, 100)
+        dt = time.perf_counter() - t0
+        assert len(set(out.tolist())) == 100
+        assert out.max() < 1_000_000
+        assert dt < 0.1  # O(k), not an O(N) permutation
+
+
+class TestVirtualDataset:
+    def test_pack_parity_with_resident_materialization(self):
+        """The SAME population materialized resident packs the same
+        bytes the virtual path packs (and the virtual path never holds
+        more than the cache)."""
+        from fedml_tpu.data.base import FederatedDataset
+
+        vds = make_virtual_powerlaw_population(client_num=50, dim=8,
+                                               seed=3, cache_clients=16)
+        rds = FederatedDataset.from_client_arrays(
+            {c: vds.gen(c) for c in range(50)},
+            {c: None for c in range(50)}, vds.class_num)
+        assert vds.client_num == rds.client_num
+        assert vds.max_client_samples == rds.max_client_samples
+        assert vds.padded_len(10) == rds.padded_len(10)
+        cohort = [4, 17, 33, 4]
+        assert (vds.cohort_padded_len(cohort, 10)
+                == rds.cohort_padded_len(cohort, 10))
+        xv, yv, mv = vds.pack_clients(cohort, 10)
+        xr, yr, mr = rds.pack_clients(cohort, 10)
+        np.testing.assert_array_equal(xv, xr)
+        np.testing.assert_array_equal(yv, yr)
+        np.testing.assert_array_equal(mv, mr)
+        np.testing.assert_array_equal(vds.client_weights(cohort),
+                                      rds.client_weights(cohort))
+
+    def test_sizes_pure_and_heavy_tailed(self):
+        s1 = pareto_sizes(np.arange(1000), seed=0)
+        s2 = pareto_sizes(np.arange(1000), seed=0)
+        np.testing.assert_array_equal(s1, s2)
+        assert s1.min() >= 10 and s1.max() <= 400
+        assert np.percentile(s1, 50) < np.mean(s1)  # heavy tail
+        # chunked == whole-range (the scan helpers rely on this)
+        np.testing.assert_array_equal(
+            np.concatenate([pareto_sizes(np.arange(0, 500), 0),
+                            pareto_sizes(np.arange(500, 1000), 0)]), s1)
+
+    def test_lru_bounds_residency(self):
+        vds = make_virtual_powerlaw_population(client_num=10_000, dim=4,
+                                               seed=1, cache_clients=8)
+        for r in range(6):
+            cohort = sample_clients_virtual(r, 10_000, 4, threshold=10)
+            vds.pack_clients(cohort, 10,
+                             n_pad=vds.cohort_padded_len(cohort, 10))
+        # residency never exceeds the budget (x and y fields share it)
+        assert vds.store.resident_clients() <= 2 * 8
+        stats = vds.store.stats()
+        assert stats["state_evictions"] > 0
+        assert stats["state_bytes_written"] == 0  # RAM-only tier
+
+    def test_state_dir_persists_generated_shards(self, tmp_path):
+        """--state_dir on a generative population is a cross-run cache:
+        touched clients' shards write back, a second open reads them
+        from disk (bit-identical to regeneration)."""
+        vds = make_virtual_powerlaw_population(
+            client_num=100, dim=4, seed=7, state_dir=str(tmp_path),
+            cache_clients=64)
+        x1, y1, m1 = vds.pack_clients([3, 9], 10)
+        vds.store.flush()
+        import os
+        assert os.path.isdir(os.path.join(str(tmp_path), "train_x"))
+        again = make_virtual_powerlaw_population(
+            client_num=100, dim=4, seed=7, state_dir=str(tmp_path),
+            cache_clients=64)
+        x2, y2, m2 = again.pack_clients([3, 9], 10)
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+        assert again.store.stats()["state_bytes_read"] > 0
+
+    def test_partition_to_store_refuses_ram_only_store(self):
+        with pytest.raises(ValueError, match="disk-backed"):
+            partition_to_store(np.zeros(100, np.int64), "homo", 4,
+                               ClientStateStore(None))
+
+    def test_lazy_size_dict_views(self):
+        vds = make_virtual_powerlaw_population(client_num=300, dim=4,
+                                               seed=0)
+        d = vds.train_data_local_num_dict
+        assert len(d) == 300 and 299 in d and 300 not in d
+        assert d[7] == int(vds.sizes_for(np.asarray([7]))[0])
+        assert sum(d.values()) == vds.train_data_num
+        with pytest.raises(KeyError):
+            d[300]
+
+    def test_eval_union_fixed_and_capped(self):
+        vds = make_virtual_powerlaw_population(client_num=500, dim=4,
+                                               seed=2, eval_clients=8)
+        x1, y1 = vds.train_data_global
+        x2, y2 = vds.train_data_global
+        assert x1 is x2  # built once
+        assert len(x1) <= vds._eval_cap and len(x1) == len(y1)
+        xt, yt = vds.test_data_global
+        assert len(xt) and len(xt) == len(yt)
+
+
+class TestStreamingPartition:
+    def test_homo_stream_bit_identical(self):
+        labels = np.random.RandomState(0).randint(0, 5, 503)
+        with locked_global_numpy_rng(42):
+            ref = homo_partition(len(labels), 7)
+        with locked_global_numpy_rng(42):
+            stream = dict(stream_partition(labels, "homo", 7))
+        assert sorted(stream) == sorted(ref)
+        for c in ref:
+            np.testing.assert_array_equal(ref[c], stream[c])
+
+    def test_hetero_stream_matches_partition_data(self):
+        labels = np.random.RandomState(1).randint(0, 4, 400)
+        with locked_global_numpy_rng(9):
+            ref = partition_data(labels, "hetero", 4, alpha=0.5,
+                                 class_num=4)
+        with locked_global_numpy_rng(9):
+            stream = dict(stream_partition(labels, "hetero", 4, alpha=0.5,
+                                           class_num=4))
+        for c in ref:
+            np.testing.assert_array_equal(ref[c], stream[c])
+
+    def test_partition_to_store_shards(self, tmp_path):
+        labels = np.random.RandomState(2).randint(0, 5, 300)
+        store = ClientStateStore(str(tmp_path), shard_clients=2,
+                                 cache_clients=2)
+        with locked_global_numpy_rng(5):
+            n = partition_to_store(labels, "homo", 9, store)
+        assert n == 9
+        with locked_global_numpy_rng(5):
+            ref = homo_partition(len(labels), 9)
+        reopened = ClientStateStore(str(tmp_path))
+        union = []
+        for c in range(9):
+            idxs = reopened.get("data_idx", c)
+            np.testing.assert_array_equal(ref[c], idxs)
+            union.extend(idxs.tolist())
+        assert sorted(union) == list(range(300))  # exact cover
+
+    def test_unknown_method_raises(self):
+        with pytest.raises(ValueError):
+            list(stream_partition(np.zeros(10), "nope", 2))
+
+
+class TestStatsSummary:
+    def test_small_map_unchanged(self):
+        labels = np.asarray([0, 0, 1, 1, 2, 2])
+        stats = record_data_stats(labels, {0: [0, 1, 2], 1: [3, 4, 5]})
+        assert stats[0] == {0: 2, 1: 1}
+
+    def test_quantile_summary_over_threshold(self):
+        labels = np.zeros(40, np.int64)
+        mapping = {c: list(range(c % 4 + 1)) for c in range(12)}
+        out = record_data_stats(labels, mapping, summary_threshold=10)
+        assert out["summary"] is True
+        assert out["clients"] == 12
+        assert out["samples_per_client"]["min"] == 1
+        assert out["samples_per_client"]["max"] == 4
+        assert out["samples_total"] == sum(len(v)
+                                           for v in mapping.values())
+        assert STATS_SUMMARY_THRESHOLD > 1000  # default stays permissive
+
+    def test_federation_stats_on_virtual_population(self):
+        from fedml_tpu.data.stats import federation_stats
+
+        vds = make_virtual_powerlaw_population(client_num=12_000, dim=4,
+                                               seed=0)
+        out = federation_stats(vds)
+        assert out["num_users"] == 12_000
+        assert out["num_samples_total"] == vds.train_data_num
+        assert out["num_samples_quantiles"]["min"] >= 10
+        assert out["num_samples_quantiles"]["max"] <= 400
+
+
+class TestStoreBackedFederation:
+    def test_write_load_pack_parity(self, tmp_path):
+        import os
+
+        from fedml_tpu.data import flagship_gen as fg
+
+        os.environ["FEDML_GEN_CACHE"] = ""
+        sizes = np.array([12, 25, 15, 30])
+        resident = fg._build(4, 5, 8, 1, sizes, 3, 0.3, 0.1, 0.2)
+        write_federation_store(
+            str(tmp_path),
+            fg.stream_client_shards(4, 5, 8, 1, sizes, 3, 0.3, 0.1, 0.2),
+            5, shard_clients=2, cache_clients=2)
+        vds = load_federation_store(str(tmp_path), cache_clients=8)
+        assert vds.client_num == 4 and vds.class_num == 5
+        n_pad = resident.padded_len(4)
+        xr, yr, mr = resident.pack_clients([0, 3], 4, n_pad=n_pad)
+        xv, yv, mv = vds.pack_clients([0, 3], 4, n_pad=n_pad)
+        np.testing.assert_array_equal(xr, xv)
+        np.testing.assert_array_equal(yr, yv)
+        np.testing.assert_array_equal(mr, mv)
+        # disk-tier counters moved: reopen read shard files
+        assert vds.store.stats()["state_bytes_read"] > 0
+
+    def test_store_backed_missing_client_is_loud(self, tmp_path):
+        store = ClientStateStore(str(tmp_path / "s"))
+        ds = VirtualFederatedDataset(4, 2, lambda cids: np.full(
+            len(cids), 5, np.int64), gen=None, store=store)
+        with pytest.raises(KeyError, match="store-backed"):
+            ds.pack_clients([1], 5)
+
+    def test_femnist_streaming_builder_parity(self, tmp_path):
+        import os
+
+        from fedml_tpu.data import flagship_gen as fg
+
+        os.environ["FEDML_GEN_CACHE"] = ""
+        sds = fg.build_femnist_store_federation(str(tmp_path),
+                                                client_num=4, seed=0)
+        rds = fg.build_femnist_federation(client_num=4, seed=0)
+        n_pad = rds.padded_len(20)
+        x1, y1, m1 = rds.pack_clients([1, 3], 20, n_pad=n_pad)
+        x2, y2, m2 = sds.pack_clients([1, 3], 20, n_pad=n_pad)
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+        np.testing.assert_array_equal(m1, m2)
+        # second open hits the already-written corpus
+        again = fg.build_femnist_store_federation(str(tmp_path),
+                                                  client_num=4, seed=0)
+        np.testing.assert_array_equal(
+            again.pack_clients([2], 20, n_pad=n_pad)[0],
+            rds.pack_clients([2], 20, n_pad=n_pad)[0])
+
+
+class TestFedAvgOverVirtualPopulation:
+    def _api(self, vds, rounds=3, prefetch_depth=2):
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        return FedAvgAPI(
+            vds, LogisticRegression(num_classes=vds.class_num),
+            config=FedAvgConfig(
+                comm_round=rounds, client_num_per_round=4,
+                frequency_of_the_test=10 ** 9,
+                prefetch_depth=prefetch_depth,
+                train=TrainConfig(epochs=1, batch_size=10, lr=0.1)))
+
+    def test_rounds_run_and_counters_land_in_timer(self):
+        import jax
+
+        vds = make_virtual_powerlaw_population(client_num=2000, dim=8,
+                                               seed=0, cache_clients=64)
+        api = self._api(vds)
+        for r in range(3):
+            api.run_round(r)
+        jax.block_until_ready(api.variables)
+        # store counters mirrored into the driver's RoundTimer
+        assert api.timer.counters["state_cache_misses"] > 0
+        assert api.timer.gauges["host_rss_peak_mb"] > 0
+
+    def test_trajectory_identical_to_resident_dataset(self):
+        """ACCEPTANCE companion: same population resident vs virtual
+        produces the bit-identical model after the same rounds (same
+        sampling stream, same packed bytes, same programs)."""
+        import jax
+
+        from fedml_tpu.data.base import FederatedDataset
+
+        vds = make_virtual_powerlaw_population(client_num=200, dim=8,
+                                               seed=5, cache_clients=512)
+        rds = FederatedDataset.from_client_arrays(
+            {c: vds.gen(c) for c in range(200)},
+            {c: None for c in range(200)}, vds.class_num)
+        api_v = self._api(vds, rounds=3)
+        api_r = self._api(rds, rounds=3)
+        for r in range(3):
+            idx_v, _ = api_v.run_round(r)
+            idx_r, _ = api_r.run_round(r)
+            np.testing.assert_array_equal(idx_v, idx_r)
+        jax.block_until_ready(api_v.variables)
+        jax.block_until_ready(api_r.variables)
+        for a, b in zip(jax.tree.leaves(api_v.variables),
+                        jax.tree.leaves(api_r.variables)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+class TestMillionClientSlow:
+    def test_million_client_leg_completes_flat(self):
+        """The full 1M bench leg (slow lane): rounds complete and the
+        store's residency stays bounded by the cache budget."""
+        from fedml_tpu.state.population import _run_population_leg
+
+        out = _run_population_leg(1_000_000, rounds=2, cohort=10,
+                                  mode="virtual", batch_size=10, dim=16,
+                                  cache_clients=1024, state_dir=None,
+                                  seed=0)
+        assert out["population"] == 1_000_000
+        assert out["rounds_per_sec"] > 0
+        assert out["host_rss_peak_mb"] > 0
+        assert out["state_cache_misses"] > 0
