@@ -858,6 +858,83 @@ def bench_cross_silo_faults() -> dict:
     }
 
 
+def bench_server_failover() -> dict:
+    """The control-plane RESILIENCE axis: the same federation run clean
+    (control plane on, no kill) vs with the server process SIGKILLed
+    mid-schedule and restarted (fedml_tpu/control/failover_harness.py —
+    real subprocess over TCP, silo fleet flapping ~30% throughout). The
+    kill leg must complete the FULL schedule with ``cp_restores >= 1``
+    and its round/cohort ledger must match the clean leg's — a
+    regression in snapshot coverage, restore, or the rejoin path shows
+    up as ``recovered_full_schedule: false`` here, not as a dead
+    production coordinator. Artifact: runs/server_failover.json."""
+    import shutil
+    import tempfile
+
+    from fedml_tpu.control.failover_harness import (ledger_schedule,
+                                                    run_failover_scenario,
+                                                    run_simulated_failover)
+
+    rounds = 8
+    root = tempfile.mkdtemp(prefix="fedml_server_failover_")
+    try:
+        # clean leg: identical TCP topology + deadline config, no kill
+        t0 = time.perf_counter()
+        _, clean_ledger, clean_server = run_simulated_failover(
+            os.path.join(root, "clean"), rounds=rounds,
+            crash_at_round=10**9, backend="TCP", port_base=41110,
+            deadline_s=2.0)
+        clean_wall = time.perf_counter() - t0
+        # kill leg: SIGKILL after round 2 closes, restart, 30% silo flap
+        t0 = time.perf_counter()
+        res = run_failover_scenario(
+            os.path.join(root, "killed"), rounds=rounds,
+            kill_after_round=2, port_base=41130, deadline_s=2.0,
+            silo_fault_plan="seed=13;disconnect:direction=recv,"
+                            "receiver=3,msg_type=2,p=0.3,duration_ms=800")
+        kill_wall = time.perf_counter() - t0
+        summary = res["summary"]
+        cp = summary.get("cp_counters", {})
+        ledger_ok = (ledger_schedule(res["ledger"])
+                     == ledger_schedule(clean_ledger))
+        ok = (summary.get("done") is True
+              and summary.get("rounds_completed") == rounds
+              and cp.get("restores", 0) >= 1 and ledger_ok)
+        out = {
+            "rounds": rounds,
+            "clean": {
+                "rounds_per_sec": round(rounds / clean_wall, 3),
+                "cp_checkpoints": int(
+                    clean_server.cp_counters.get("checkpoints", 0)),
+                "ledger_rounds": len(clean_ledger),
+            },
+            "server_kill": {
+                "rounds_per_sec": round(rounds / kill_wall, 3),
+                "killed_at_round": res["killed_at_round"],
+                "rounds_completed": summary.get("rounds_completed"),
+                "cp_restores": cp.get("restores", 0),
+                "cp_checkpoints": cp.get("checkpoints", 0),
+                "evictions": summary.get("evictions", 0),
+                "rejoins": summary.get("rejoins", 0),
+                "partial_rounds": summary.get("ft_counters", {}).get(
+                    "partial_rounds", 0),
+            },
+            "ledger_matches_clean": bool(ledger_ok),
+            "recovered_full_schedule": bool(ok),
+            "note": "TCP subprocess server, SIGKILL after round 2 + "
+                    "restart (auto-restore from the control snapshot); "
+                    "1 of 3 silos flaps on ~30% of broadcasts. Kill-leg "
+                    "wall-clock includes the restart + JAX re-init, so "
+                    "judge counters and ledger parity, not rounds/sec.",
+        }
+        os.makedirs("runs", exist_ok=True)
+        with open(os.path.join("runs", "server_failover.json"), "w") as f:
+            json.dump(_no_nan(out), f, indent=2)
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 #: shared shape for the fused-round stages (VERDICT r3 #1 contract point:
 #: R=20 blocks on the 1000-client power-law flagship). R=20 is also the
 #: sweet spot: the block packs at the max cohort bucket over its R
@@ -1535,6 +1612,9 @@ _STAGES = (
     ("cross_silo_faults", "cross_silo_faults",
      lambda: bench_cross_silo_faults(),
      ("faults", "chaos", "fault_tolerance")),
+    ("server_failover", "server_failover",
+     lambda: bench_server_failover(),
+     ("failover", "control_plane")),
     ("fedavg_fused_rounds", "fedavg_fused_rounds",
      lambda: bench_fused_rounds(), ("fused", "fused_rounds")),
     ("fedavg_fused_device_sampling", "fedavg_fused_device_sampling",

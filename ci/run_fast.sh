@@ -3,11 +3,15 @@
 # static analysis (analysis CLI: AST lint + jaxpr audit, ~25 s), then a
 # 100k-client population-virtualization smoke (seconds — FedAvg rounds
 # through the tiered client-state store; the 1M leg lives in the slow
-# lane + the population_scale bench stage), then unit + integration
+# lane + the population_scale bench stage), then the server-failover
+# smoke (~25 s — a real TCP server subprocess SIGKILLed mid-schedule,
+# restarted, and required to finish with cp_restores >= 1 and a
+# ledger matching the unkilled reference), then unit + integration
 # tests on 8 virtual CPU devices, ~7 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./ci/run_static.sh
 JAX_PLATFORMS=cpu python -m fedml_tpu.state.population \
     --population 100000 --rounds 2 --cohort 10
+JAX_PLATFORMS=cpu python -m fedml_tpu.control.failover_harness --smoke
 exec python -m pytest tests/ -q -m "not slow" "$@"

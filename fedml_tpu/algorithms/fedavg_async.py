@@ -60,6 +60,22 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
         self.round_deadline_s = round_deadline_s
         self.partial_rounds: List[int] = []  # rounds closed below strength
 
+    def _capture_extra(self, state) -> None:
+        state["partial_rounds"] = [int(r) for r in self.partial_rounds]
+        state["quorum"] = int(self.quorum)
+
+    def _restore_extra(self, state) -> None:
+        self.partial_rounds = [int(r)
+                               for r in state.get("partial_rounds") or []]
+        # the deadline may have been pace-steered; the absolute quorum
+        # count is static config and only sanity-checked
+        if int(state.get("quorum", self.quorum)) != self.quorum:
+            import logging
+            logging.warning(
+                "restored snapshot was taken at quorum=%s, this launch "
+                "uses %d — continuing with the launch flag",
+                state.get("quorum"), self.quorum)
+
     # -- protocol ----------------------------------------------------------
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         # note the base BEFORE the staleness discard: a straggler's stale
@@ -71,6 +87,10 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             self.ft_counters["stale_replies"] += 1
             return  # stale straggler reply from a closed round: discard
         worker = msg.get_sender_id() - 1
+        if self._bcast_at is not None:
+            import time as _time
+            self.liveness.observe_report_latency(
+                worker, _time.monotonic() - self._bcast_at)
         with _DEVICE_LOCK:  # delta decompression is device compute
             payload = self._decode_model_payload(
                 msg.get(MSG_ARG_KEY_MODEL_PARAMS))
@@ -93,7 +113,19 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             # replies are discarded
             self._close_round(partial=True)
         else:
-            self._arm_deadline()  # below quorum: keep waiting
+            # below quorum: keep waiting — but not forever (the capped
+            # extension budget shared with the deadline-eviction server)
+            if self._note_deadline_extension():
+                self._fail_schedule(
+                    f"round {self.round_idx} is still below quorum "
+                    f"({received}/{self.quorum} updates) after "
+                    f"{self._extensions_this_round - 1} deadline "
+                    f"extensions (--max_deadline_extensions="
+                    f"{self._max_extensions}) — the federation cannot "
+                    "make progress; final state checkpointed")
+                return
+            self._save_control_snapshot()
+            self._arm_deadline()
 
 
 class AsyncFedAvgServerManager(FedAvgServerManager):
@@ -189,7 +221,11 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                      backend: str = "INPROC", addresses=None,
                      wire_codec: bool = False, compression=None,
                      timer=None, heartbeat_s: float = 0.0,
-                     fault_plan=None):
+                     fault_plan=None,
+                     server_checkpoint_dir=None,
+                     pace_steering: bool = False,
+                     join_rate_limit: float = 0.0,
+                     max_deadline_extensions=25):
     """Launch a straggler-tolerant federation (server + worker silos as
     actor threads over any comm backend) and block until it completes.
     ``mode="quorum"`` closes rounds at (all | deadline & quorum);
@@ -225,6 +261,21 @@ def run_fedavg_async(dataset, module, task: str = "classification",
             policy.name)
         policy = CompressionPolicy("none")
 
+    from fedml_tpu.control import build_control_plane
+    if mode == "fedasync" and (server_checkpoint_dir or pace_steering):
+        import logging
+        logging.warning(
+            "server checkpoint/pace steering requested with "
+            "mode='fedasync' — FedAsync has no round schedule to "
+            "checkpoint or steer; ignoring (use mode='quorum' or the "
+            "round-based servers)")
+    control = (build_control_plane(
+        server_checkpoint_dir=server_checkpoint_dir,
+        pace_steering=pace_steering, join_rate_limit=join_rate_limit,
+        round_deadline_s=round_deadline_s,
+        max_deadline_extensions=max_deadline_extensions)
+        if mode == "quorum" else {})
+
     def server_factory(size, server_com, aggregator, global_model,
                        on_round_done):
         if mode == "quorum":
@@ -232,7 +283,8 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                 0, size, server_com, aggregator, comm_round,
                 dataset.client_num, global_model, quorum=quorum,
                 round_deadline_s=round_deadline_s,
-                on_round_done=on_round_done, compression=policy)
+                on_round_done=on_round_done, compression=policy,
+                **control)
         return AsyncFedAvgServerManager(
             0, size, server_com, aggregator,
             client_num_in_total=dataset.client_num,

@@ -72,6 +72,11 @@ MSG_TYPE_C2S_HEARTBEAT = 10
 #: a restarted or evicted silo asking back in; the server re-admits it
 #: with a full-precision resync of the silo mirror
 MSG_TYPE_C2S_JOIN = 11
+#: admission control (control/admission.py): the JOIN was rate-limited —
+#: no resync now; carries ``retry_after_s`` and the silo defers its next
+#: JOIN attempt by that long (heartbeats keep beating: backpressure
+#: rejects the resync, not the proof of life)
+MSG_TYPE_S2C_JOIN_BACKPRESSURE = 12
 
 MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
 MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
@@ -87,6 +92,8 @@ MSG_ARG_KEY_BASE_FP = "base_fp"
 #: JOIN payload: how many rounds the (re)joining silo completed before it
 #: went away — logged, and available for smarter re-admission policies
 MSG_ARG_KEY_ROUNDS_COMPLETED = "rounds_completed"
+#: BACKPRESSURE payload: seconds until the admission token bucket refills
+MSG_ARG_KEY_RETRY_AFTER = "retry_after_s"
 
 #: All silo actors in one process share one physical device, which has ONE
 #: dispatch queue anyway — serializing jax compute across actor threads
@@ -206,7 +213,9 @@ class FedAvgServerManager(ServerManager):
                  on_round_done=None, checkpoint_mgr=None,
                  resume: bool = False, compression=None,
                  round_deadline_s: Optional[float] = None,
-                 min_quorum_frac: float = 0.5):
+                 min_quorum_frac: float = 0.5,
+                 server_ckpt=None, pace=None, join_admission=None,
+                 max_deadline_extensions: Optional[int] = 25):
         super().__init__(rank, size, com_manager)
         self.aggregator = aggregator
         self.comm_round = comm_round
@@ -236,6 +245,32 @@ class FedAvgServerManager(ServerManager):
         #: its heartbeat cadence gets ONE full-model resync per round, not
         #: one per tick (full-precision frames are the expensive ones)
         self._resynced_round: Dict[int, int] = {}
+        # -- elastic control plane (fedml_tpu/control/) ---------------------
+        #: durable round-schedule snapshots + the round/cohort ledger; a
+        #: restarted server restores the newest snapshot in send_init_msg
+        self._server_ckpt = server_ckpt
+        #: adaptive deadline/quorum steering (None = the static flags,
+        #: byte-identical legacy behavior)
+        self._pace = pace
+        #: JOIN token bucket (None = admit every JOIN, legacy behavior)
+        self._join_admission = join_admission
+        #: below-quorum deadline-extension budget per round (None =
+        #: the pre-control-plane forever-extend behavior)
+        self._max_extensions = max_deadline_extensions
+        self._extensions_this_round = 0
+        #: control-plane counters (checkpoints/restores/adjustments/
+        #: throttles) — rolled into RoundTimer as ``cp_*``
+        self.cp_counters: Dict[str, int] = defaultdict(int)
+        #: the cohort broadcast for the OPEN round (ledger payload)
+        self._round_cohort: Optional[List[int]] = None
+        #: monotonic timestamp of the open round's broadcast — the origin
+        #: every reply's report latency is measured from (ephemeral)
+        self._bcast_at: Optional[float] = None
+        #: terminal latch: set (with a FINISH sweep) when the schedule
+        #: cannot make progress; launch_federation re-raises it
+        self.scheduling_error: Optional[Exception] = None
+        self._control_restored = False
+        self._restore_lock = threading.Lock()
         # -- downlink compression state (comm/policy.py) --------------------
         self._policy = resolve_compression(compression)
         self._bcast_seq = -1
@@ -265,6 +300,146 @@ class FedAvgServerManager(ServerManager):
     def _load_state(self, state) -> None:
         self.global_model = state["variables"]
 
+    # -- elastic control plane: full round-schedule snapshot/restore --------
+    # (fedml_tpu/control/checkpoint.py; field manifest in
+    # control/manifest.py, enforced by lint rule FT009)
+    def _capture_extra(self, state: Dict) -> None:
+        """Subclass hook: add flavor-specific round state (FedOpt's
+        server optimizer, quorum's partial-round log) to the snapshot."""
+
+    def _restore_extra(self, state: Dict) -> None:
+        """Subclass hook: restore what :meth:`_capture_extra` added."""
+
+    def _capture_control_state(self) -> Dict:
+        """The FULL round-schedule state as an msgpack-serializable dict:
+        everything a restarted server needs to resume mid-schedule.
+        ``round_idx`` doubles as the sampling cursor — cohorts and client
+        RNG keys are pure functions of (seed, round), so no separate RNG
+        state exists to save."""
+        from flax import serialization as fser
+        agg = self.aggregator
+        with _DEVICE_LOCK:  # D2H transfers are device dispatches
+            gm = fser.to_state_dict(_to_numpy(self.global_model))
+            pending = {str(w): fser.to_state_dict(_to_numpy(m))
+                       for w, m in agg.model_dict.items()}
+        state = {
+            "round_idx": int(self.round_idx),
+            "comm_round": int(self.comm_round),
+            "worker_num": int(self.worker_num),
+            "bcast_seq": int(self._bcast_seq),
+            "evict_on_deadline": bool(self._evict_on_deadline),
+            "global_model": gm,
+            "mirror": (fser.to_state_dict(self._mirror)
+                       if self._mirror is not None else None),
+            "mirror_fp": self._mirror_fp,
+            "worker_base": {str(w): [int(s), str(fp)]
+                            for w, (s, fp) in self._worker_base.items()},
+            "live": sorted(int(w) for w in self.liveness.live_workers()),
+            "evictions": int(self.liveness.evictions),
+            "rejoins": int(self.liveness.rejoins),
+            "latency_window": self.liveness.report_latencies.values(),
+            "pending_models": pending,
+            "pending_weights": {str(w): float(v)
+                                for w, v in agg.sample_num_dict.items()},
+            "uploaded_flags": [bool(f)
+                               for f in agg.flag_client_model_uploaded],
+            "live_history": self.live_history,
+            "ft_counters": {k: int(v) for k, v in self.ft_counters.items()},
+            "cp_counters": {k: int(v) for k, v in self.cp_counters.items()},
+            "resynced_round": {str(k): int(v)
+                               for k, v in self._resynced_round.items()},
+            "round_deadline_s": (float(self.round_deadline_s)
+                                 if self.round_deadline_s else None),
+            "min_quorum_frac": float(self.min_quorum_frac),
+            "extensions_this_round": int(self._extensions_this_round),
+            "round_cohort": ([int(i) for i in self._round_cohort]
+                             if self._round_cohort is not None else None),
+            "pace": (self._pace.state() if self._pace is not None
+                     else None),
+        }
+        self._capture_extra(state)
+        return state
+
+    def _restore_control_state(self, state: Dict) -> None:
+        if int(state["worker_num"]) != self.worker_num \
+                or int(state["comm_round"]) != self.comm_round:
+            raise ValueError(
+                f"server snapshot is for a {state['worker_num']}-silo/"
+                f"{state['comm_round']}-round schedule; this launch is "
+                f"{self.worker_num}-silo/{self.comm_round}-round — "
+                "refusing a silently wrong resume (point "
+                "--server_checkpoint_dir at a fresh directory)")
+        self.round_idx = int(state["round_idx"])
+        self._bcast_seq = int(state["bcast_seq"])
+        self._evict_on_deadline = bool(state["evict_on_deadline"])
+        self.global_model = state["global_model"]
+        self._mirror = state["mirror"]
+        self._mirror_fp = state["mirror_fp"]
+        # worker_base is snapshotted for forensics but NOT restored:
+        # whether each silo still holds the base it reported pre-kill is
+        # value-level staleness the structural fingerprint cannot see, so
+        # the first post-restore broadcast rebases FULL precision (one
+        # full frame per failover) — the same coherence rule the JOIN
+        # resync uses
+        self._worker_base = {}
+        live = {int(w) for w in state["live"]}
+        for w in range(self.worker_num):
+            if w not in live:
+                self.liveness.evict(w)
+        self.liveness.evictions = int(state["evictions"])
+        self.liveness.rejoins = int(state["rejoins"])
+        self.liveness.report_latencies.load(
+            state.get("latency_window") or ())
+        agg = self.aggregator
+        agg.model_dict = {int(w): m
+                          for w, m in state["pending_models"].items()}
+        agg.sample_num_dict = {int(w): float(v)
+                               for w, v in state["pending_weights"].items()}
+        agg.flag_client_model_uploaded = [
+            bool(f) for f in state["uploaded_flags"]]
+        self.live_history = list(state["live_history"] or [])
+        self.ft_counters.update(
+            {k: int(v) for k, v in (state["ft_counters"] or {}).items()})
+        self.cp_counters.update(
+            {k: int(v) for k, v in (state["cp_counters"] or {}).items()})
+        self._resynced_round = {
+            int(k): int(v)
+            for k, v in (state["resynced_round"] or {}).items()}
+        rd = state["round_deadline_s"]
+        self.round_deadline_s = float(rd) if rd is not None else None
+        self.min_quorum_frac = float(state["min_quorum_frac"])
+        self._extensions_this_round = int(state["extensions_this_round"])
+        rc = state["round_cohort"]
+        self._round_cohort = ([int(i) for i in rc]
+                              if rc is not None else None)
+        if self._pace is not None:
+            self._pace.load_state(state.get("pace"))
+        self._restore_extra(state)
+
+    def _save_control_snapshot(self) -> None:
+        """Durably snapshot the control state (no-op without a
+        checkpointer). A failed save warns loudly but never kills the
+        round loop — the federation keeps training, unprotected."""
+        if self._server_ckpt is None:
+            return
+        try:
+            self._server_ckpt.save(self._capture_control_state())
+            self.cp_counters["checkpoints"] += 1
+        except Exception:
+            logging.warning(
+                "server control snapshot failed at round %d — the "
+                "schedule continues WITHOUT failover protection",
+                self.round_idx, exc_info=True)
+
+    def _fail_schedule(self, reason: str) -> None:
+        """Terminal scheduling failure: checkpoint the final state,
+        FINISH every silo, latch the error for the launcher to raise."""
+        from fedml_tpu.control import SchedulingStallError
+        self.scheduling_error = SchedulingStallError(reason)
+        logging.error("%s", self.scheduling_error)
+        self._save_control_snapshot()
+        self._finish_federation()
+
     def _aggregate_round(self, partial: bool = False):
         """Close the round: default is the plain sample-weighted average
         (over every reporter when ``partial`` — the weighted
@@ -273,7 +448,42 @@ class FedAvgServerManager(ServerManager):
         return (self.aggregator.aggregate_available() if partial
                 else self.aggregator.aggregate())
 
+    def _maybe_restore_control_state(self) -> None:
+        """One-shot failover restore. Deliberately NOT in ``__init__``:
+        subclass constructors (quorum, FedOpt) finish installing their
+        own round-state fields after ``super().__init__`` and the restore
+        must win over every construction-time default. Called from the
+        top of both :meth:`run` (before the receive loop drains queued
+        JOINs/heartbeats from an already-waiting fleet) and
+        :meth:`send_init_msg` — whichever the launcher reaches first."""
+        if self._server_ckpt is None:
+            return
+        with self._restore_lock:
+            if self._control_restored:
+                return
+            snap = self._server_ckpt.load_latest()
+            if snap is not None:
+                self._restore_control_state(snap)
+                self.cp_counters["restores"] += 1
+                logging.warning(
+                    "server control plane RESTORED from %s at round %d "
+                    "(live=%s, %d pending replies) — resuming the "
+                    "schedule mid-flight",
+                    self._server_ckpt.directory, self.round_idx,
+                    sorted(self.liveness.live_workers()),
+                    len(self.aggregator.model_dict))
+            # latch AFTER success: if the restore refused (format or
+            # schedule mismatch), the racing other entry point (run vs
+            # send_init_msg) must retry and re-raise the refusal loudly
+            # on ITS thread instead of silently proceeding from round 0
+            self._control_restored = True
+
+    def run(self) -> None:
+        self._maybe_restore_control_state()
+        super().run()
+
     def send_init_msg(self) -> None:
+        self._maybe_restore_control_state()
         if self.round_idx >= self.comm_round:
             # resumed from a checkpoint of an already-finished run
             self._finish_federation()
@@ -422,6 +632,9 @@ class FedAvgServerManager(ServerManager):
         evicts the peer instead of killing the server loop."""
         payload = self._encode_broadcast()
         live = self.liveness.live_workers()
+        # ledger payload + the latency origin every reply is measured from
+        self._round_cohort = [int(idxs[w - 1]) for w in range(1, self.size)]
+        self._bcast_at = time.monotonic()
         for worker in range(1, self.size):
             if self._evict_on_deadline and (worker - 1) not in live:
                 continue
@@ -478,6 +691,10 @@ class FedAvgServerManager(ServerManager):
                 # life and a usable contribution — re-admit
                 logging.info("silo %d re-admitted on a live round-%d "
                              "reply", worker + 1, r)
+        if self._bcast_at is not None:
+            # the report-latency distribution pace steering feeds on
+            self.liveness.observe_report_latency(
+                worker, time.monotonic() - self._bcast_at)
         try:
             with _DEVICE_LOCK:  # delta decompression is device compute
                 payload = self._decode_model_payload(
@@ -519,10 +736,13 @@ class FedAvgServerManager(ServerManager):
         # a protocol property; multi-process deployments (one device per
         # silo) close at the deadline proper.
         self._cancel_deadline()
+        reported = sorted(self.aggregator.model_dict)
+        live_n = (len(self.liveness.live_workers())
+                  if self._evict_on_deadline else self.worker_num)
         if self._evict_on_deadline:
             self.live_history.append({
                 "round": self.round_idx,
-                "reported": sorted(self.aggregator.model_dict),
+                "reported": reported,
                 "live": sorted(self.liveness.live_workers()),
                 "partial": bool(partial)})
             if partial:
@@ -532,10 +752,50 @@ class FedAvgServerManager(ServerManager):
         if self.on_round_done is not None:
             # outside the lock: eval re-locks internally, sink I/O doesn't
             self.on_round_done(self.round_idx, self.global_model)
+        deadline_used = self.round_deadline_s
         self.round_idx += 1
         if self.checkpoint_mgr is not None:
             self.checkpoint_mgr.save(self.round_idx,
                                      self._checkpoint_state())
+        # -- pace steering: derive the NEXT round's deadline + quorum
+        #    target from the observed report-latency distribution and
+        #    recent participation (control/pace.py; off = static flags)
+        if self._pace is not None and self.round_deadline_s:
+            self._pace.observe_round(len(reported), max(1, live_n))
+            new_d = self._pace.next_deadline(
+                self.liveness.report_latencies)
+            new_q = self._pace.next_quorum_frac()
+            if (new_d != self.round_deadline_s
+                    or new_q != self.min_quorum_frac):
+                self.cp_counters["deadline_adjustments"] += 1
+                tm = getattr(self, "round_timer", None)
+                if tm is not None:
+                    tm.gauge("cp_steered_deadline_s", new_d)
+                logging.info(
+                    "pace steering: round %d deadline %.3fs -> %.3fs, "
+                    "quorum frac %.3f -> %.3f (p90 report latency %s)",
+                    self.round_idx, deadline_used or 0.0, new_d,
+                    self.min_quorum_frac, new_q,
+                    self.liveness.report_latencies.quantile(0.9))
+            self.round_deadline_s = new_d
+            self.min_quorum_frac = new_q
+        # the NEW round enters with a full extension budget — reset
+        # BEFORE the boundary snapshot, or a restored server would start
+        # the next round already charged for the closed round's
+        # extensions and could hit the cap spuriously under exactly the
+        # degraded-fleet conditions failover exists for
+        self._extensions_this_round = 0
+        # -- durable round boundary: ledger line first, snapshot second
+        #    (a crash between the two re-closes this round after restore
+        #    and re-appends — readers dedup by round keeping the last)
+        if self._server_ckpt is not None:
+            self._server_ckpt.append_ledger({
+                "round": self.round_idx - 1,
+                "cohort": self._round_cohort,
+                "reported": reported,
+                "partial": bool(partial),
+                "deadline_s": deadline_used})
+            self._save_control_snapshot()
         if self.round_idx == self.comm_round:
             self._finish_federation()
             return
@@ -559,12 +819,38 @@ class FedAvgServerManager(ServerManager):
         live = self.liveness.live_workers()
         reported = set(self.aggregator.model_dict)
         need = max(1, math.ceil(self.min_quorum_frac * max(1, len(live))))
+        if self._pace is not None and len(live) > 1:
+            # steering's no-deadlock invariant lives HERE, not in the
+            # fraction: ceil(0.9 * n) is n for every n <= 10, so a
+            # steered fraction alone would still demand EVERY live silo
+            # on small (i.e. typical cross-silo) fleets and one silently
+            # hung silo — which never triggers a send error, so it is
+            # only evicted at a quorum-met close — would stall the
+            # schedule into the extension cap. With steering active the
+            # effective requirement is capped at live-1; the static-flag
+            # path keeps exact legacy semantics (an explicit
+            # --min_quorum_frac 1.0 means what it says).
+            need = min(need, len(live) - 1)
         if len(reported) < need:
-            self.ft_counters["deadline_extensions"] += 1
+            if self._note_deadline_extension():
+                self._fail_schedule(
+                    f"round {self.round_idx} is still below quorum "
+                    f"({len(reported)}/{len(live)} reports, need {need}) "
+                    f"after {self._extensions_this_round - 1} deadline "
+                    f"extensions (--max_deadline_extensions="
+                    f"{self._max_extensions}) — the federation cannot "
+                    "make progress; final state checkpointed")
+                return
             logging.warning(
                 "round %d deadline passed with %d/%d reports (quorum %d) "
-                "— extending the deadline", self.round_idx, len(reported),
-                len(live), need)
+                "— extending the deadline (%d/%s extensions used)",
+                self.round_idx, len(reported), len(live), need,
+                self._extensions_this_round,
+                self._max_extensions
+                if self._max_extensions is not None else "inf")
+            # mid-round durability: the partials in hand survive a kill
+            # during a long extension stretch
+            self._save_control_snapshot()
             self._arm_deadline()
             return
         for w in sorted(live - reported):
@@ -578,6 +864,16 @@ class FedAvgServerManager(ServerManager):
                     "discard; it re-admits via JOIN with a full resync)",
                     w + 1, self.round_deadline_s, self.round_idx)
         self._close_round(partial=True)
+
+    def _note_deadline_extension(self) -> bool:
+        """Count one below-quorum deadline extension; True when the
+        per-round budget (``--max_deadline_extensions``) is exhausted —
+        the caller must fail the schedule loudly instead of extending
+        forever (the pre-control-plane behavior, kept via ``None``)."""
+        self._extensions_this_round += 1
+        self.ft_counters["deadline_extensions"] += 1
+        return (self._max_extensions is not None
+                and self._extensions_this_round > self._max_extensions)
 
     def handle_message_heartbeat(self, msg: Message) -> None:
         # the beat itself landed in receive_message; the handler only
@@ -597,6 +893,25 @@ class FedAvgServerManager(ServerManager):
             # a live silo that already reported this round is just waiting
             # out the deadline with us — it is not lost, so no resync
             # (which would only trigger a redundant retrain)
+            return
+        # admission control: a mass rejoin after a partition heals must
+        # not stampede the full-precision resync path — throttled JOINs
+        # get a BACKPRESSURE reply and the silo defers its next attempt
+        # (its heartbeats keep beating the liveness table meanwhile)
+        if self._join_admission is not None \
+                and not self._join_admission.try_acquire():
+            self.cp_counters["joins_throttled"] += 1
+            out = Message(MSG_TYPE_S2C_JOIN_BACKPRESSURE, self.rank,
+                          worker + 1)
+            out.add(MSG_ARG_KEY_RETRY_AFTER,
+                    float(self._join_admission.retry_after_s()))
+            try:
+                self.send_message(out)
+            except OSError as exc:
+                logging.debug("backpressure reply to silo %d failed: %r",
+                              worker + 1, exc)
+            logging.info("silo %d JOIN throttled (admission token bucket "
+                         "empty) — backpressure sent", worker + 1)
             return
         self.liveness.admit(worker)
         self._worker_base.pop(worker, None)
@@ -676,6 +991,18 @@ class FedOptServerManager(FedAvgServerManager):
         self.global_model = state["variables"]
         self.server_opt_state = state["server_opt"]
 
+    def _capture_extra(self, state) -> None:
+        from flax import serialization as fser
+        state["server_opt"] = fser.to_state_dict(
+            jax.tree.map(np.asarray, self.server_opt_state))
+
+    def _restore_extra(self, state) -> None:
+        from flax import serialization as fser
+        # the freshly-initialized opt state is the structure template, so
+        # optax's NamedTuple pytree round-trips through the msgpack dict
+        self.server_opt_state = fser.from_state_dict(
+            self.server_opt_state, state["server_opt"])
+
     def _aggregate_round(self, partial: bool = False):
         avg = (self.aggregator.aggregate_available() if partial
                else self.aggregator.aggregate())
@@ -716,6 +1043,9 @@ class FedAvgClientManager(ClientManager):
         self.join_on_start = bool(join_on_start)
         self.rounds_completed = 0
         self._last_s2c = time.monotonic()
+        #: JOIN deferral set by a server BACKPRESSURE reply (admission
+        #: control) — heartbeats continue, JOIN escalation waits this out
+        self._join_backoff_until = 0.0
         #: True while a broadcast handler (local training) is running —
         #: the heartbeat thread must not mistake a long local_train for
         #: an eviction and escalate to JOIN mid-round
@@ -793,6 +1123,19 @@ class FedAvgClientManager(ClientManager):
             MSG_TYPE_S2C_SYNC_MODEL, self.handle_message_init)
         self.register_message_receive_handler(
             MSG_TYPE_S2C_FINISH, self._handle_finish)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_JOIN_BACKPRESSURE, self._handle_join_backpressure)
+
+    def _handle_join_backpressure(self, msg: Message) -> None:
+        """The server throttled our JOIN (admission control): defer the
+        next JOIN attempt by the advertised retry window. Deliberately
+        does NOT refresh ``_last_s2c`` — we are still evicted, the idle
+        clock must keep running so the JOIN retries after the backoff."""
+        retry = float(msg.get_params().get(
+            MSG_ARG_KEY_RETRY_AFTER, max(1.0, self.heartbeat_s)))
+        self._join_backoff_until = time.monotonic() + retry
+        logging.info("silo %d: JOIN backpressured — retrying in %.2fs",
+                     self.rank, retry)
 
     def run(self) -> None:
         self.register_message_receive_handlers()
@@ -827,7 +1170,8 @@ class FedAvgClientManager(ClientManager):
         while not self._hb_stop.wait(self.heartbeat_s):
             idle = time.monotonic() - self._last_s2c
             if not self._busy \
-                    and idle > max(self.rejoin_idle_s, self.heartbeat_s):
+                    and idle > max(self.rejoin_idle_s, self.heartbeat_s) \
+                    and time.monotonic() >= self._join_backoff_until:
                 self._send_join()
                 continue
             try:
@@ -971,7 +1315,18 @@ class FedAvgClientManager(ClientManager):
         from fedml_tpu.comm.compression import tree_fingerprint
         reply.add(MSG_ARG_KEY_BASE_SEQ, self._held_seq)
         reply.add(MSG_ARG_KEY_BASE_FP, tree_fingerprint(variables))
-        self.send_message(reply)
+        try:
+            self.send_message(reply)
+        except OSError as exc:
+            # the server may be mid-failover: dropping the reply is safe
+            # (the restarted server re-broadcasts the round and this silo
+            # retrains it), dying here is not — the receive loop must
+            # survive to hear the restarted server
+            logging.warning(
+                "silo %d: round-%d reply not delivered (%r) — server "
+                "down? a restarted server re-drives the round", self.rank,
+                round_idx, exc)
+            return
         self.rounds_completed += 1
 
 
@@ -996,7 +1351,11 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           round_deadline_s: Optional[float] = None,
                           min_quorum_frac: float = 0.5,
                           heartbeat_s: float = 0.0,
-                          fault_plan=None):
+                          fault_plan=None,
+                          server_checkpoint_dir: Optional[str] = None,
+                          pace_steering: bool = False,
+                          join_rate_limit: float = 0.0,
+                          max_deadline_extensions: Optional[int] = 25):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -1015,6 +1374,19 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     (and auto-JOIN after ~3 silent beats). ``fault_plan`` (DSL/JSON, see
     comm/faults.py) wraps every endpoint in the seeded chaos harness.
 
+    Elastic control plane (fedml_tpu/control/):
+    ``server_checkpoint_dir`` snapshots the server's full round-schedule
+    state at round boundaries and deadline closes (a killed-and-restarted
+    server resumes mid-schedule and appends to the round/cohort ledger);
+    ``pace_steering`` derives each round's deadline (p90·margin, clamped)
+    and quorum target from the observed report-latency distribution,
+    using the static flags as base/floor; ``join_rate_limit`` (joins/sec)
+    token-buckets JOIN floods with BACKPRESSURE replies;
+    ``max_deadline_extensions`` caps the below-quorum extension loop —
+    exhausting it raises a loud SchedulingStallError after checkpointing
+    the final state. All defaults off/inert -> byte-identical legacy
+    behavior.
+
     The reference's equivalent is `mpirun -np worker_num+1 main_fedavg.py`
     (FedAvgAPI.py:20-67 rank dispatch); here ranks are threads over the
     selected backend, so the same protocol code also drives TCP/GRPC
@@ -1027,6 +1399,13 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     # resolve ONCE and hand the instance to both sides, so the server's
     # downlink and the silos' uplink can never disagree about the policy
     policy = resolve_compression(compression, compress=compress)
+    from fedml_tpu.control import build_control_plane
+    control = build_control_plane(
+        server_checkpoint_dir=server_checkpoint_dir,
+        pace_steering=pace_steering, join_rate_limit=join_rate_limit,
+        round_deadline_s=round_deadline_s,
+        min_quorum_frac=min_quorum_frac,
+        max_deadline_extensions=max_deadline_extensions)
 
     def server_factory(size, server_com, aggregator, global_model,
                        on_round_done):
@@ -1034,7 +1413,7 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                       checkpoint_mgr=checkpoint_mgr, resume=resume,
                       compression=policy,
                       round_deadline_s=round_deadline_s,
-                      min_quorum_frac=min_quorum_frac)
+                      min_quorum_frac=min_quorum_frac, **control)
         if server_optimizer:
             return FedOptServerManager(
                 0, size, server_com, aggregator, comm_round,
@@ -1251,4 +1630,19 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     for key in ("partial_rounds", "stale_replies", "corrupt_frames",
                 "join_resyncs", "heartbeats", "deadline_extensions"):
         tmr.count(f"ft_{key}", int(ftc.get(key, 0)))
+    # control-plane roll-up (checkpoint/restore/steering/admission) —
+    # counted even when zero so the cp_* keys are always present, like
+    # the ft_* family
+    cpc = getattr(server, "cp_counters", {})
+    for key in ("checkpoints", "restores", "deadline_adjustments",
+                "joins_throttled"):
+        tmr.count(f"cp_{key}", int(cpc.get(key, 0)))
+    if getattr(server, "_pace", None) is not None \
+            and getattr(server, "round_deadline_s", None):
+        tmr.gauge("cp_steered_deadline_s", float(server.round_deadline_s))
+    err = getattr(server, "scheduling_error", None)
+    if err is not None:
+        # the server already checkpointed final state and FINISHed the
+        # silos; surface the stall as the loud failure it is
+        raise err
     return server.global_model, history, server

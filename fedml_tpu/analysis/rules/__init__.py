@@ -1,6 +1,6 @@
 """Rule registry: one module per project-specific rule.
 
-Each rule carries an id (FT001..FT008), a docstring explaining the
+Each rule carries an id (FT001..FT009), a docstring explaining the
 hazard in THIS codebase's terms, and a fix hint. ``all_rules()`` is the
 canonical ordered instantiation the engine and the CLI share.
 """
@@ -18,10 +18,11 @@ from fedml_tpu.analysis.rules.host_sync import HostSyncRule
 from fedml_tpu.analysis.rules.jit_static import JitScalarArgRule
 from fedml_tpu.analysis.rules.population_growth import PopulationGrowthRule
 from fedml_tpu.analysis.rules.rng import GlobalRngRule
+from fedml_tpu.analysis.rules.server_state import ServerStateRule
 
 _RULES = (GlobalRngRule, DonatedReuseRule, HostSyncRule,
           JitScalarArgRule, BroadExceptRule, Float64Rule,
-          CommTimeoutRule, PopulationGrowthRule)
+          CommTimeoutRule, PopulationGrowthRule, ServerStateRule)
 
 
 def all_rules() -> List[Rule]:

@@ -1,0 +1,128 @@
+"""FT009 — server round-state mutated in the message loop but missing
+from the checkpoint field manifest.
+
+The elastic control plane (``fedml_tpu/control/``) checkpoints the
+cross-silo server's FULL round-schedule state so a killed-and-restarted
+server resumes mid-schedule. The failure mode this rule freezes out is
+the quiet one: a later PR adds ``self.some_new_counter`` to a server
+handler, forgets to add it to ``_capture_control_state``, and every
+failover silently resets that field — the resumed schedule diverges from
+the unkilled run in a way no unit test of the new feature notices.
+
+The contract lives in ``fedml_tpu/control/manifest.py``: every
+``self.<attr>`` a server manager *mutates outside __init__* must be in
+``SERVER_CHECKPOINT_FIELDS`` (captured + restored),
+``SERVER_EPHEMERAL_FIELDS`` (documented restart-fresh), or carry a
+``# ft: allow[FT009] why`` pragma. Detected mutations:
+
+- ``self.X = ...`` / ``self.X += ...`` (plain + augmented assigns),
+- ``self.X[...] = ...`` / ``self.X[...] += ...`` (subscript stores),
+- ``self.X.append/add/update/extend/pop/...(...)`` (container mutators).
+
+Scope: the cross-silo round-based server modules only
+(``algorithms/fedavg_cross_silo.py`` + ``algorithms/fedavg_async.py``,
+plus the analysis corpus), and within them only classes whose base list
+names a ``*ServerManager``. Classes in
+``UNCHECKPOINTED_SERVER_CLASSES`` (FedAsync — no round schedule exists
+to resume) are exempt wholesale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_corpus_path)
+from fedml_tpu.control.manifest import (SERVER_CHECKPOINT_FIELDS,
+                                        SERVER_EPHEMERAL_FIELDS,
+                                        UNCHECKPOINTED_SERVER_CLASSES)
+
+#: the cross-silo round-based server modules (path suffixes)
+_SERVER_MODULES = ("algorithms/fedavg_cross_silo.py",
+                   "algorithms/fedavg_async.py")
+
+#: method calls that mutate their receiver in place
+_MUTATORS = frozenset({"append", "appendleft", "add", "update", "extend",
+                       "insert", "setdefault", "pop", "popitem", "clear",
+                       "discard", "remove"})
+
+_ALLOWED = SERVER_CHECKPOINT_FIELDS | SERVER_EPHEMERAL_FIELDS
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """``self.X`` -> ``X`` (through one subscript level for
+    ``self.X[...]`` targets); None otherwise."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_server_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = dotted_name(base) or ""
+        if "ServerManager" in name.split(".")[-1]:
+            return True
+    return False
+
+
+class ServerStateRule(Rule):
+    id = "FT009"
+    title = ("server round-state mutated in the message loop but absent "
+             "from the checkpoint field manifest")
+    hint = ("add the field to SERVER_CHECKPOINT_FIELDS (and capture + "
+            "restore it in _capture_control_state/_restore_control_state) "
+            "or to SERVER_EPHEMERAL_FIELDS with a restart-fresh "
+            "rationale (fedml_tpu/control/manifest.py); pragma "
+            "deliberate exceptions: # ft: allow[FT009] why")
+
+    def applies(self, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        return (any(rel.endswith(m) for m in _SERVER_MODULES)
+                or is_corpus_path(relpath))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef) \
+                    or not _is_server_class(cls):
+                continue
+            if cls.name in UNCHECKPOINTED_SERVER_CLASSES:
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__":
+                    # construction-time defaults are not "forgotten":
+                    # a field only matters once the round loop mutates it
+                    continue
+                yield from self._check_method(ctx, cls, method)
+
+    def _check_method(self, ctx: FileContext, cls: ast.ClassDef,
+                      method: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(method):
+            attr = None
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr:
+                        break
+            elif isinstance(node, ast.AugAssign):
+                attr = _self_attr(node.target)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                attr = _self_attr(node.func.value)
+            if attr and attr not in _ALLOWED:
+                yield ctx.finding(
+                    self, node,
+                    f"{cls.name}.{attr} is mutated in the server's "
+                    f"message/round loop but is in neither "
+                    f"SERVER_CHECKPOINT_FIELDS nor "
+                    f"SERVER_EPHEMERAL_FIELDS — a restarted server "
+                    f"silently resets it and the resumed schedule "
+                    f"diverges from the unkilled run")

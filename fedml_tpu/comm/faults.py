@@ -176,6 +176,24 @@ def _plan_from_obj(obj, seed: int) -> FaultPlan:
     return FaultPlan(seed=int(obj.get("seed", seed)), rules=rules)
 
 
+def server_kill_plan(seed: int = 0, after_broadcasts: int = 2,
+                     down_ms: float = 2000.0,
+                     extra_rules: Sequence[FaultRule] = ()) -> FaultPlan:
+    """The canonical SERVER-KILL chaos scenario (in-process leg): after
+    ``after_broadcasts`` SYNC broadcasts leave the server, its endpoint
+    goes completely dark for ``down_ms`` — nothing in, nothing out — the
+    fleet's view of a server crash. Self-addressed deadline ticks stay
+    exempt (the server's own clock survives a network death; a REAL
+    process death is the failover harness's SIGKILL leg,
+    ``fedml_tpu/control/failover_harness.py``, which also exercises
+    checkpoint restore). ``extra_rules`` compose silo flap/duplicate
+    noise into the same seeded plan."""
+    kill = FaultRule(op="disconnect", direction="send", sender=0,
+                     msg_type=2, after=after_broadcasts, max_count=1,
+                     duration_ms=down_ms)
+    return FaultPlan(seed=seed, rules=(kill, *extra_rules))
+
+
 def _corrupt_frame(msg: Message, rng: random.Random) -> Optional[Message]:
     """Bit-flip array bytes of the encoded frame; header + scalars stay
     intact so the frame still DECODES — into garbage the payload-level

@@ -1,0 +1,70 @@
+"""Elastic federation control plane (Bonawitz et al., MLSys 2019).
+
+PR 5 made the *silos* survivable; this package makes the *coordinator*
+survivable and its schedule adaptive:
+
+- :class:`~fedml_tpu.control.checkpoint.ServerControlCheckpointer` —
+  durable snapshots of the server's full round-schedule state (round
+  index, live set, compression mirror / base seqs, pending replies,
+  aggregation partials, steering windows) plus the round/cohort ledger;
+  a killed-and-restarted server resumes mid-schedule.
+- :class:`~fedml_tpu.control.pace.PaceSteerer` — adaptive round
+  deadlines (p90 · margin, clamped) and quorum targets from the observed
+  per-silo report-latency distribution, replacing the static
+  ``--round_deadline_s`` / ``--min_quorum_frac`` when ``--pace_steering``
+  is on.
+- :class:`~fedml_tpu.control.admission.JoinAdmissionController` — a
+  token bucket between mass-rejoin floods and the server's
+  full-precision resync path, with BACKPRESSURE replies.
+- ``control/manifest.py`` — the checkpoint field manifest lint rule
+  FT009 enforces against the server classes.
+- ``control/failover_harness.py`` — the SIGKILL-the-server acceptance
+  harness (also the ``server_failover`` bench stage's kill leg).
+"""
+
+from fedml_tpu.control.admission import JoinAdmissionController
+from fedml_tpu.control.checkpoint import ServerControlCheckpointer
+from fedml_tpu.control.pace import QUORUM_CEIL, PaceSteerer
+
+
+class SchedulingStallError(RuntimeError):
+    """A round exhausted its deadline-extension budget
+    (``--max_deadline_extensions``) while below quorum: the federation
+    cannot make progress (too many silos permanently dark for the quorum
+    target). The server checkpoints its final state, FINISHes the
+    surviving silos, and the launcher raises this — a loud scheduling
+    failure instead of the pre-control-plane forever-extend hang."""
+
+
+def build_control_plane(server_checkpoint_dir=None, pace_steering=False,
+                        join_rate_limit=0.0, round_deadline_s=None,
+                        min_quorum_frac=0.5, max_deadline_extensions=25):
+    """Resolve the control-plane flags into the kwargs the round-based
+    server managers take (``server_ckpt`` / ``pace`` / ``join_admission``
+    / ``max_deadline_extensions``). All-defaults resolves to the inert
+    configuration — byte-identical to the pre-control-plane servers."""
+    if pace_steering and not round_deadline_s:
+        raise ValueError(
+            "--pace_steering needs --round_deadline_s as the base "
+            "deadline steering starts from (and falls back to until "
+            "enough report latencies are observed)")
+    return {
+        "server_ckpt": (ServerControlCheckpointer(server_checkpoint_dir)
+                        if server_checkpoint_dir else None),
+        # the floor is the caller's static quorum, capped at the steering
+        # ceiling (a 1.0 floor would pin steering at the full barrier —
+        # the deadlock the deadline exists to break)
+        "pace": (PaceSteerer(base_deadline_s=round_deadline_s,
+                             quorum_floor=min(min_quorum_frac,
+                                              QUORUM_CEIL))
+                 if pace_steering else None),
+        "join_admission": (JoinAdmissionController(join_rate_limit)
+                           if join_rate_limit and join_rate_limit > 0
+                           else None),
+        "max_deadline_extensions": max_deadline_extensions,
+    }
+
+
+__all__ = ["JoinAdmissionController", "PaceSteerer",
+           "ServerControlCheckpointer", "SchedulingStallError",
+           "build_control_plane"]

@@ -1,0 +1,72 @@
+"""JOIN admission control — a token bucket between the rejoin protocol
+and the server's expensive resync path.
+
+A network partition healing is the worst case for the PR-5 rejoin
+protocol: every silo on the wrong side of the cut escalates to JOIN on
+its heartbeat cadence at once, and each admitted JOIN costs the server a
+FULL-precision mirror resync (the expensive frames the downlink
+compression ladder exists to avoid) plus a broadcast-path device
+dispatch. A mass rejoin therefore stampedes exactly the component that
+just recovered. :class:`JoinAdmissionController` is the standard fix: a
+token bucket (``rate_per_s`` sustained, ``burst`` instantaneous) gates
+the resync path; a JOIN that finds the bucket empty gets a BACKPRESSURE
+reply carrying ``retry_after_s`` instead of a resync, and the silo
+defers its next JOIN attempt by that long (its heartbeat keeps beating —
+backpressure rejects the *resync*, not the proof of life).
+
+The clock is injectable for deterministic tests; the controller is
+thread-safe (JOINs arrive on the server's receive thread, but tests
+drive it from anywhere).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class JoinAdmissionController:
+    def __init__(self, rate_per_s: float, burst: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if rate_per_s <= 0:
+            raise ValueError(f"rate_per_s must be > 0 (got {rate_per_s}); "
+                             "leave admission control off instead")
+        self.rate_per_s = float(rate_per_s)
+        #: bucket capacity: how many JOINs may land back-to-back before
+        #: throttling starts (default: one second's worth, at least 1)
+        self.burst = float(burst) if burst is not None \
+            else max(1.0, self.rate_per_s)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = self._clock()
+        self._lock = threading.Lock()
+        self.admitted = 0
+        self.throttled = 0
+
+    def _refill(self, now: float) -> None:
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last)
+                           * self.rate_per_s)
+        self._last = now
+
+    def try_acquire(self) -> bool:
+        """Consume one token if available. False = throttle this JOIN."""
+        with self._lock:
+            self._refill(self._clock())
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.admitted += 1
+                return True
+            self.throttled += 1
+            return False
+
+    def retry_after_s(self) -> float:
+        """How long until a token exists — the backpressure reply's
+        deferral hint (>= 0; small positive jitterless value the silo
+        adds to its own heartbeat cadence)."""
+        with self._lock:
+            self._refill(self._clock())
+            if self._tokens >= 1.0:
+                return 0.0
+            return (1.0 - self._tokens) / self.rate_per_s

@@ -1,0 +1,174 @@
+"""Durable server control-plane snapshots + the round/cohort ledger.
+
+The PR-5 fault-tolerance layer made the *silos* survivable; the server
+was still a single point of failure: kill it mid-schedule and the whole
+federation dies, because the round-schedule state (round index, live
+set, compression mirror, pending replies, aggregation partials) lived
+only in process memory. This module is the durable half of the elastic
+control plane: :class:`ServerControlCheckpointer` snapshots the FULL
+control state dict the server captures (``_capture_control_state`` in
+``algorithms/fedavg_cross_silo.py`` — field manifest in
+``control/manifest.py``, enforced by lint rule FT009) and restores it
+on restart, so a killed-and-restarted server resumes mid-schedule and
+the existing silo-side rejoin protocol reconnects the fleet.
+
+Format: one ``state_<seq>.msgpack`` blob per snapshot
+(``flax.serialization.msgpack_serialize`` — template-free restore, so
+variable-structure state like the pending-reply dict round-trips) plus a
+``state_<seq>.json`` sidecar with the round index. Writes follow the
+repo's atomic idiom (tmp + ``os.replace``, blob first, sidecar last):
+a crash at ANY point leaves either a complete older snapshot or a
+complete newer one — a snapshot without its sidecar is invisible to
+``load_latest`` and swept by GC (crash-consistency tested, mirroring
+``test_state_store.py``).
+
+The **ledger** (``ledger.jsonl``) is the schedule's durable trace: one
+JSON line per closed round with the round index, the broadcast cohort,
+the reporting silos, and whether the close was partial. It is the
+acceptance oracle for failover — a resumed run's ledger must match the
+unkilled reference's — and the progress feed the failover harness polls.
+Lines are appended *before* the snapshot, so a crash between the two
+re-closes the round after restore and re-appends it: readers dedup by
+round keeping the LAST occurrence.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+_STATE_RE = re.compile(r"state_(\d+)\.msgpack$")
+
+#: bumped when the snapshot layout changes incompatibly
+STATE_FORMAT = 1
+
+
+class ServerControlCheckpointer:
+    def __init__(self, directory: str, keep_last_n: int = 3):
+        self.directory = directory
+        self.keep_last_n = max(1, int(keep_last_n))
+        os.makedirs(directory, exist_ok=True)
+
+    # -- snapshot naming ----------------------------------------------------
+    def _path(self, seq: int) -> str:
+        return os.path.join(self.directory, f"state_{seq:012d}.msgpack")
+
+    def _seqs(self) -> List[int]:
+        """Snapshot sequence numbers with BOTH files present (a blob
+        whose sidecar never landed is a torn write — invisible)."""
+        names = set(os.listdir(self.directory))
+        out = []
+        for fn in names:
+            m = _STATE_RE.fullmatch(fn)
+            if m and fn[:-len(".msgpack")] + ".json" in names:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # -- save / load --------------------------------------------------------
+    def save(self, state: Dict[str, Any]) -> str:
+        """Atomically persist one control-state snapshot; returns its
+        path. ``state`` must be msgpack-serializable (numpy arrays,
+        dicts with str keys, lists, scalars, None) — the server's
+        capture method guarantees that shape."""
+        from flax import serialization as fser
+        seqs = self._seqs()
+        seq = (seqs[-1] + 1) if seqs else 0
+        path = self._path(seq)
+        blob = fser.msgpack_serialize(dict(state, format=STATE_FORMAT))
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        # sidecar LAST: _seqs() requires both files, so a crash anywhere
+        # in this method leaves the previous snapshot authoritative
+        side = path[:-len(".msgpack")] + ".json"
+        stmp = f"{side}.{os.getpid()}.tmp"
+        with open(stmp, "w") as f:
+            json.dump({"seq": seq, "round_idx": int(state["round_idx"]),
+                       "format": STATE_FORMAT}, f)
+        os.replace(stmp, side)
+        self._gc()
+        return path
+
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        """The newest complete snapshot as a plain dict (numpy leaves),
+        or None when the directory holds none."""
+        from flax import serialization as fser
+        seqs = self._seqs()
+        if not seqs:
+            return None
+        with open(self._path(seqs[-1]), "rb") as f:
+            state = fser.msgpack_restore(f.read())
+        fmt = int(state.get("format", 0))
+        if fmt != STATE_FORMAT:
+            raise ValueError(
+                f"server snapshot {self._path(seqs[-1])} has format {fmt}, "
+                f"this build reads {STATE_FORMAT} — refusing a silently "
+                "wrong resume")
+        return state
+
+    def latest_round(self) -> Optional[int]:
+        seqs = self._seqs()
+        if not seqs:
+            return None
+        with open(self._path(seqs[-1])[:-len(".msgpack")] + ".json") as f:
+            return int(json.load(f)["round_idx"])
+
+    def _gc(self) -> None:
+        keep = set(self._seqs()[-self.keep_last_n:])
+        for fn in os.listdir(self.directory):
+            if not fn.startswith("state_"):
+                continue
+            stem = fn.split(".")[0]
+            try:
+                seq = int(stem.split("_")[1])
+            except (IndexError, ValueError):
+                continue
+            # stray .tmp files and sidecar-less blobs from a crash are
+            # orphans _seqs() never reports — sweep them too
+            complete = not fn.endswith(".tmp") and seq in keep
+            if not complete:
+                try:
+                    os.remove(os.path.join(self.directory, fn))
+                except FileNotFoundError:
+                    pass
+
+    # -- the round/cohort ledger --------------------------------------------
+    @property
+    def ledger_path(self) -> str:
+        return os.path.join(self.directory, "ledger.jsonl")
+
+    def append_ledger(self, rec: Dict[str, Any]) -> None:
+        """One closed round -> one JSON line (append + flush: line-level
+        durability; the snapshot that follows is the consistency point)."""
+        with open(self.ledger_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read_ledger(self, dedup: bool = True) -> List[Dict[str, Any]]:
+        """Ledger rows in round order. ``dedup`` keeps the LAST
+        occurrence per round (a crash between ledger append and snapshot
+        makes the restored server re-close that round — the re-append is
+        the authoritative row). A torn final line (kill mid-write) is
+        skipped."""
+        if not os.path.exists(self.ledger_path):
+            return []
+        rows: List[Dict[str, Any]] = []
+        with open(self.ledger_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    logging.warning("ledger %s: skipping torn line %r",
+                                    self.ledger_path, line[:80])
+        if dedup:
+            by_round = {int(r["round"]): r for r in rows}
+            rows = [by_round[r] for r in sorted(by_round)]
+        return rows

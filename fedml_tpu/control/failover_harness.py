@@ -1,0 +1,433 @@
+"""Server failover harness — the control plane's acceptance oracle.
+
+Two kill modes over one deterministic fixture (seeded blob federation +
+logistic regression, identical in every process that builds it):
+
+- **Simulated crash** (:func:`run_simulated_failover`, fast lane):
+  phase 1 runs a server whose receive loop stops COLD — no FINISH, no
+  cleanup — right before broadcasting round ``crash_at_round`` (exactly
+  what SIGKILL looks like to the fleet); phase 2 constructs a FRESH
+  server manager over the same comm fabric (same inproc router / same
+  TCP port), which restores the newest control snapshot and completes
+  the schedule against the SAME still-running silo actors. Memory loss
+  is real (a new manager object); only the OS process survives.
+- **Real SIGKILL** (:func:`run_failover_scenario`, slow lane + the
+  ``server_failover`` bench kill leg): the server runs as a SUBPROCESS
+  over TCP (``python -m fedml_tpu.control.failover_harness --role
+  server``), the silos as threads in the caller's process. The driver
+  polls the durable round/cohort ledger, SIGKILLs the server once
+  ``kill_after_round`` closes, respawns it with the same flags (it
+  auto-restores), and waits for the schedule to finish. Optionally a
+  seeded :class:`~fedml_tpu.comm.faults.FaultPlan` flaps a fraction of
+  the silos throughout — the ISSUE's chaos acceptance.
+
+The parity oracle either way is the ledger: the resumed run's
+round/cohort sequence must equal an unkilled reference's
+(:func:`ledger_schedule`).
+
+``--smoke`` runs a small SIGKILL scenario end-to-end and exits non-zero
+unless the schedule completed with ``cp_restores >= 1`` — the cpu-smoke
+fronting ``ci/run_fast.sh``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+#: fixture constants — every process that builds the fixture must agree
+FIXTURE_SEED = 3
+MODEL_SEED = 0
+DEFAULT_WORKERS = 3
+DEFAULT_ROUNDS = 8
+
+
+def build_fixture(workers: int = DEFAULT_WORKERS):
+    """The shared deterministic federation: (dataset, module, train_cfg).
+    Pure function of its arguments — the server subprocess and the silo
+    process build bit-identical copies."""
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+    ds = make_blob_federated(client_num=workers, dim=8, class_num=3,
+                             n_samples=120, seed=FIXTURE_SEED)
+    return ds, LogisticRegression(num_classes=3), TrainConfig(
+        epochs=1, batch_size=8, lr=0.3)
+
+
+def make_addresses(port_base: int, size: int) -> Dict[int, Tuple[str, int]]:
+    return {r: ("127.0.0.1", port_base + r) for r in range(size)}
+
+
+def _make_com(backend: str, rank: int, size: int, *, router=None,
+              addresses=None, fault_plan=None, bind_retry_s: float = 10.0):
+    """create_comm_manager with a bind-retry loop: a restarted server
+    re-binds the port its previous incarnation held — the old listener
+    closes within its 0.5 s accept timeout (simulated crash) or at
+    process death (SIGKILL), so EADDRINUSE here is transient."""
+    from fedml_tpu.comm import create_comm_manager
+    deadline = time.monotonic() + bind_retry_s
+    while True:
+        try:
+            return create_comm_manager(backend, rank, size, router=router,
+                                       addresses=addresses, wire_codec=True,
+                                       fault_plan=fault_plan)
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.2)
+
+
+def start_silos(backend: str, workers: int, *, router=None, addresses=None,
+                fault_plan=None, heartbeat_s: float = 0.3):
+    """The silo half of launch_federation, standalone: client managers +
+    receive threads that outlive any number of server incarnations
+    (heartbeat + JOIN escalation is their reconnect path)."""
+    from fedml_tpu.algorithms.fedavg_cross_silo import FedAvgClientManager
+    ds, module, tcfg = build_fixture(workers)
+    size = workers + 1
+    clients, threads = [], []
+    for rank in range(1, size):
+        com = _make_com(backend, rank, size, router=router,
+                        addresses=addresses, fault_plan=fault_plan)
+        clients.append(FedAvgClientManager(
+            rank, size, com, ds, module, "classification", tcfg,
+            seed=MODEL_SEED, heartbeat_s=heartbeat_s))
+    for c in clients:
+        t = threading.Thread(target=c.run, daemon=True)
+        t.start()
+        threads.append(t)
+    return clients, threads
+
+
+def _build_server(com, workers: int, rounds: int, ckpt_dir: str, *,
+                  deadline_s: Optional[float], min_quorum_frac: float,
+                  pace: bool, join_rate_limit: float,
+                  max_deadline_extensions: int, server_cls=None):
+    from fedml_tpu.algorithms.fedavg_cross_silo import (FedAvgAggregator,
+                                                        FedAvgServerManager)
+    from fedml_tpu.control import build_control_plane
+    from fedml_tpu.utils.tracing import RoundTimer
+    import jax
+    import jax.numpy as jnp
+    ds, module, _ = build_fixture(workers)
+    global_model = module.init(jax.random.key(MODEL_SEED),
+                               jnp.asarray(ds.train_data_global[0][:1]),
+                               train=False)
+    control = build_control_plane(
+        server_checkpoint_dir=ckpt_dir, pace_steering=pace,
+        join_rate_limit=join_rate_limit, round_deadline_s=deadline_s,
+        min_quorum_frac=min_quorum_frac,
+        max_deadline_extensions=max_deadline_extensions)
+    cls = server_cls or FedAvgServerManager
+    server = cls(0, workers + 1, com, FedAvgAggregator(workers), rounds,
+                 ds.client_num, global_model,
+                 round_deadline_s=deadline_s,
+                 min_quorum_frac=min_quorum_frac, **control)
+    server.round_timer = RoundTimer()
+    return server
+
+
+def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
+          deadline_s: float, min_quorum_frac: float = 0.5,
+          pace: bool = False, join_rate_limit: float = 0.0,
+          max_deadline_extensions: int = 25,
+          join_timeout_s: float = 600.0) -> int:
+    """Subprocess entry: run ONE server incarnation over TCP until the
+    schedule completes (or this process is killed mid-flight — the point
+    of the exercise). Writes ``server_summary.json`` next to the
+    checkpoints and returns a process exit code."""
+    size = workers + 1
+    com = _make_com("TCP", 0, size,
+                    addresses=make_addresses(port_base, size))
+    server = _build_server(com, workers, rounds, ckpt_dir,
+                           deadline_s=deadline_s,
+                           min_quorum_frac=min_quorum_frac, pace=pace,
+                           join_rate_limit=join_rate_limit,
+                           max_deadline_extensions=max_deadline_extensions)
+    thread = threading.Thread(target=server.run, daemon=True)
+    thread.start()
+    server.send_init_msg()
+    thread.join(timeout=join_timeout_s)
+    done = server.round_idx >= rounds and not thread.is_alive()
+    summary = {
+        "rounds_completed": int(server.round_idx),
+        "schedule_rounds": int(rounds),
+        "done": bool(done),
+        "cp_counters": {k: int(v) for k, v in server.cp_counters.items()},
+        "ft_counters": {k: int(v) for k, v in server.ft_counters.items()},
+        "evictions": int(server.liveness.evictions),
+        "rejoins": int(server.liveness.rejoins),
+        "final_deadline_s": server.round_deadline_s,
+        "error": (str(server.scheduling_error)
+                  if server.scheduling_error else None),
+    }
+    tmp = os.path.join(ckpt_dir, f"summary.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(summary, f)
+    os.replace(tmp, os.path.join(ckpt_dir, "server_summary.json"))
+    com.stop_receive_message()
+    return 0 if done else 1
+
+
+# ---------------------------------------------------------------------------
+# simulated crash (in-process; fast lane + INPROC/TCP resume-parity tests)
+# ---------------------------------------------------------------------------
+def make_crashing_server_cls(crash_at_round: int):
+    """A server that 'dies' — stops its receive loop cold, no FINISH, no
+    cleanup — right before broadcasting ``crash_at_round``. The newest
+    control snapshot at that moment is exactly a SIGKILL's."""
+    from fedml_tpu.algorithms.fedavg_cross_silo import (
+        MSG_TYPE_S2C_SYNC_MODEL, FedAvgServerManager)
+
+    class CrashBeforeBroadcast(FedAvgServerManager):
+        crashed = False
+
+        def _broadcast_model(self, msg_type, idxs):
+            if (msg_type == MSG_TYPE_S2C_SYNC_MODEL
+                    and self.round_idx == crash_at_round):
+                type(self).crashed = True
+                self._cancel_deadline()
+                self.com_manager.stop_receive_message()
+                return
+            super()._broadcast_model(msg_type, idxs)
+
+    return CrashBeforeBroadcast
+
+
+def run_simulated_failover(ckpt_dir: str, *, rounds: int = 6,
+                           workers: int = DEFAULT_WORKERS,
+                           crash_at_round: int = 3,
+                           backend: str = "INPROC",
+                           port_base: Optional[int] = None,
+                           deadline_s: Optional[float] = None,
+                           min_quorum_frac: float = 0.5,
+                           pace: bool = False,
+                           join_timeout_s: float = 180.0):
+    """Kill-and-restart without subprocesses. Returns
+    ``(final_model_numpy, ledger, server2)`` — server2 carries the
+    restored counters and the bound RoundTimer."""
+    import jax
+    import numpy as np
+    from fedml_tpu.comm.inproc import InProcRouter
+    from fedml_tpu.control import ServerControlCheckpointer
+
+    router = InProcRouter() if backend.upper() == "INPROC" else None
+    size = workers + 1
+    addresses = (make_addresses(port_base, size)
+                 if backend.upper() == "TCP" else None)
+    clients, client_threads = start_silos(backend, workers, router=router,
+                                          addresses=addresses)
+    common = dict(deadline_s=deadline_s, min_quorum_frac=min_quorum_frac,
+                  pace=pace, join_rate_limit=0.0,
+                  max_deadline_extensions=25)
+
+    # phase 1: runs to crash_at_round, then goes dark mid-schedule
+    # (crash_at_round >= rounds never crashes: the unkilled reference leg)
+    crashing = crash_at_round < rounds
+    com1 = _make_com(backend, 0, size, router=router, addresses=addresses)
+    s1 = _build_server(com1, workers, rounds, ckpt_dir,
+                       server_cls=(make_crashing_server_cls(crash_at_round)
+                                   if crashing else None),
+                       **common)
+    t1 = threading.Thread(target=s1.run, daemon=True)
+    t1.start()
+    s1.send_init_msg()
+    t1.join(timeout=join_timeout_s)
+    assert not t1.is_alive(), "phase-1 server never reached its crash point"
+    s2 = s1
+    if crashing:
+        assert type(s1).crashed, "crash point not hit — schedule too short?"
+        if router is not None:
+            # the crashed server stopped from INSIDE its receive loop, so
+            # its _STOP sentinel (and any stale heartbeats) still sit in
+            # the shared rank-0 mailbox — a real process death frees its
+            # queues; the in-proc simulation must drain them or the
+            # restarted server's loop dies on the stale sentinel
+            import queue as _queue
+            q = router.mailbox(0)
+            while True:
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+        # phase 2: a FRESH server over the same fabric restores + finishes
+        com2 = _make_com(backend, 0, size, router=router,
+                         addresses=addresses)
+        s2 = _build_server(com2, workers, rounds, ckpt_dir, **common)
+        t2 = threading.Thread(target=s2.run, daemon=True)
+        t2.start()
+        s2.send_init_msg()
+        t2.join(timeout=join_timeout_s)
+        assert not t2.is_alive(), \
+            "restored server did not finish the schedule"
+        assert s2.round_idx >= rounds, \
+            (f"restored server stopped early at round {s2.round_idx} "
+             f"of {rounds}")
+    for t in client_threads:
+        t.join(timeout=60)
+    ledger = ServerControlCheckpointer(ckpt_dir).read_ledger()
+    model = jax.tree.map(np.asarray, s2.global_model)
+    return model, ledger, s2
+
+
+# ---------------------------------------------------------------------------
+# real SIGKILL (server subprocess over TCP; slow lane + bench kill leg)
+# ---------------------------------------------------------------------------
+def _spawn_server(port_base: int, rounds: int, workers: int, ckpt_dir: str,
+                  deadline_s: float, pace: bool, join_rate_limit: float,
+                  log_path: str) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "fedml_tpu.control.failover_harness",
+           "--role", "server", "--rounds", str(rounds),
+           "--workers", str(workers), "--port_base", str(port_base),
+           "--ckpt_dir", ckpt_dir, "--deadline_s", str(deadline_s),
+           "--join_rate_limit", str(join_rate_limit)]
+    if pace:
+        cmd.append("--pace")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    logf = open(log_path, "ab")
+    try:
+        return subprocess.Popen(cmd, stdout=logf, stderr=logf, env=env)
+    finally:
+        logf.close()  # the child holds its own fd
+
+
+def _wait_for_round(ckpt_dir: str, round_idx: int, proc: subprocess.Popen,
+                    timeout_s: float) -> None:
+    from fedml_tpu.control import ServerControlCheckpointer
+    ckp = ServerControlCheckpointer(ckpt_dir)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        rows = ckp.read_ledger()
+        if rows and rows[-1]["round"] >= round_idx:
+            return
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server subprocess exited (rc={proc.returncode}) before "
+                f"round {round_idx} closed — see its log")
+        time.sleep(0.05)
+    raise TimeoutError(f"round {round_idx} did not close in {timeout_s}s")
+
+
+def run_failover_scenario(ckpt_dir: str, *, rounds: int = DEFAULT_ROUNDS,
+                          workers: int = DEFAULT_WORKERS,
+                          kill_after_round: int = 2,
+                          port_base: int = 40110,
+                          deadline_s: float = 2.0,
+                          pace: bool = False,
+                          join_rate_limit: float = 0.0,
+                          silo_fault_plan=None,
+                          timeout_s: float = 300.0) -> Dict:
+    """SIGKILL the server subprocess mid-schedule, restart it, and wait
+    for the full schedule. ``silo_fault_plan`` (e.g. a 30% flap) wraps
+    the SILO endpoints only — the chaos rides the fleet while the kill
+    rides the coordinator. Returns the final server summary + ledger +
+    kill bookkeeping."""
+    from fedml_tpu.control import ServerControlCheckpointer
+    os.makedirs(ckpt_dir, exist_ok=True)
+    log_path = os.path.join(ckpt_dir, "server.log")
+    clients, client_threads = start_silos(
+        "TCP", workers, addresses=make_addresses(port_base, workers + 1),
+        fault_plan=silo_fault_plan)
+    proc = _spawn_server(port_base, rounds, workers, ckpt_dir, deadline_s,
+                         pace, join_rate_limit, log_path)
+    killed_at = None
+    try:
+        _wait_for_round(ckpt_dir, kill_after_round, proc, timeout_s / 2)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        killed_at = kill_after_round
+        proc = _spawn_server(port_base, rounds, workers, ckpt_dir,
+                             deadline_s, pace, join_rate_limit, log_path)
+        rc = proc.wait(timeout=timeout_s)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    for t in client_threads:
+        t.join(timeout=60)
+    summary_path = os.path.join(ckpt_dir, "server_summary.json")
+    summary = {}
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            summary = json.load(f)
+    return {
+        "summary": summary,
+        "ledger": ServerControlCheckpointer(ckpt_dir).read_ledger(),
+        "killed_at_round": killed_at,
+        "restart_rc": rc,
+        "server_log": log_path,
+    }
+
+
+def ledger_schedule(ledger: List[Dict]) -> List[Tuple[int, Tuple[int, ...]]]:
+    """The parity projection: (round, cohort) pairs — what the resumed
+    run must replay identically to the unkilled reference."""
+    return [(int(r["round"]), tuple(r["cohort"] or ())) for r in ledger]
+
+
+# ---------------------------------------------------------------------------
+def _smoke(tmp_root: Optional[str]) -> int:
+    import tempfile
+    root = tmp_root or tempfile.mkdtemp(prefix="fedml_failover_smoke_")
+    ref_dir = os.path.join(root, "reference")
+    kill_dir = os.path.join(root, "killed")
+    t0 = time.time()
+    # unkilled reference over the same TCP topology
+    ref_model, ref_ledger, _ = run_simulated_failover(
+        ref_dir, rounds=6, crash_at_round=10**9, backend="TCP",
+        port_base=40210, deadline_s=5.0)
+    res = run_failover_scenario(kill_dir, rounds=6, kill_after_round=2,
+                                port_base=40230, deadline_s=2.0)
+    ok = (res["summary"].get("done") is True
+          and res["summary"].get("cp_counters", {}).get("restores", 0) >= 1
+          and ledger_schedule(res["ledger"]) == ledger_schedule(ref_ledger))
+    print(json.dumps({
+        "server_failover_smoke": "ok" if ok else "FAILED",
+        "elapsed_s": round(time.time() - t0, 1),
+        "killed_at_round": res["killed_at_round"],
+        "rounds_completed": res["summary"].get("rounds_completed"),
+        "cp_restores": res["summary"].get("cp_counters",
+                                          {}).get("restores"),
+        "ledger_matches_reference": ledger_schedule(res["ledger"])
+        == ledger_schedule(ref_ledger),
+    }))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("fedml_tpu server-failover harness")
+    p.add_argument("--role", choices=["server", "smoke"], default="smoke")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the SIGKILL cpu-smoke scenario and exit "
+                        "non-zero unless the schedule recovered")
+    p.add_argument("--rounds", type=int, default=DEFAULT_ROUNDS)
+    p.add_argument("--workers", type=int, default=DEFAULT_WORKERS)
+    p.add_argument("--port_base", type=int, default=40110)
+    p.add_argument("--ckpt_dir", type=str, default=None)
+    p.add_argument("--deadline_s", type=float, default=2.0)
+    p.add_argument("--min_quorum_frac", type=float, default=0.5)
+    p.add_argument("--pace", action="store_true")
+    p.add_argument("--join_rate_limit", type=float, default=0.0)
+    args = p.parse_args(argv)
+    if args.role == "server":
+        if not args.ckpt_dir:
+            p.error("--role server requires --ckpt_dir")
+        return serve(args.rounds, args.workers, args.port_base,
+                     args.ckpt_dir, deadline_s=args.deadline_s,
+                     min_quorum_frac=args.min_quorum_frac, pace=args.pace,
+                     join_rate_limit=args.join_rate_limit)
+    return _smoke(args.ckpt_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

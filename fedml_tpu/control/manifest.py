@@ -1,0 +1,80 @@
+"""The server checkpoint field manifest — the contract FT009 enforces.
+
+Every mutable attribute a cross-silo *server manager* writes from its
+message/round loop must be accounted for here, in exactly one of two
+sets:
+
+- :data:`SERVER_CHECKPOINT_FIELDS` — round-schedule state that the
+  elastic control plane (``fedml_tpu/control/checkpoint.py``) snapshots
+  at round boundaries / deadline closes, and restores on server
+  failover. Forgetting a NEW field here is the bug class FT009 exists
+  for: the field silently resets on restart and the resumed schedule
+  diverges from the unkilled run.
+- :data:`SERVER_EPHEMERAL_FIELDS` — state that is *meaningless across a
+  process restart* (armed timers, wall-clock origins, terminal error
+  latches) and is deliberately NOT checkpointed; each entry documents
+  why.
+
+This module is imported by the FT009 lint rule
+(``fedml_tpu/analysis/rules/server_state.py``) and must stay
+import-light (no jax/flax) so the analyzer never pays a framework
+import to read a frozenset.
+"""
+
+from __future__ import annotations
+
+#: round-schedule state captured by ``_capture_control_state`` /
+#: restored by ``_restore_control_state`` (algorithms/fedavg_cross_silo.py)
+SERVER_CHECKPOINT_FIELDS = frozenset({
+    # -- schedule position --------------------------------------------------
+    "round_idx",            # the sampling cursor: cohorts + client RNG keys
+                            # are pure functions of (seed, round_idx)
+    "global_model",         # the aggregated model entering the round
+    "_round_cohort",        # the cohort broadcast for the OPEN round
+    # -- liveness / fault-tolerance ledger ----------------------------------
+    "liveness",             # live set + evict/rejoin counters + latency
+                            # window (last-seen wall-clocks are NOT restored
+                            # — they restart fresh at re-launch)
+    "live_history",         # per-round {round, reported, live, partial}
+    "ft_counters",          # partial_rounds / stale_replies / ... roll-up
+    "cp_counters",          # checkpoints / restores / adjustments / throttles
+    "_resynced_round",      # one-JOIN-resync-per-round throttle state
+    # -- downlink compression chain -----------------------------------------
+    "_bcast_seq",           # broadcast version counter
+    "_mirror",              # the model every in-sync silo holds
+    "_mirror_fp",
+    "_worker_base",         # per-silo (held seq, structure fp) reports;
+                            # snapshotted for forensics, CLEARED on restore
+                            # (value-level staleness across a failover is
+                            # undetectable, so the first post-restore
+                            # broadcast rebases full precision)
+    # -- pace steering ------------------------------------------------------
+    "round_deadline_s",     # the CURRENT (possibly steered) deadline
+    "min_quorum_frac",      # the CURRENT (possibly steered) quorum target
+    "_evict_on_deadline",   # which close policy the schedule runs under
+    "_extensions_this_round",
+    # -- pending round (mid-round snapshots: deadline extensions, the
+    #    extension-cap error path) ------------------------------------------
+    "aggregator",           # model_dict / sample_num_dict / uploaded flags
+    # -- subclass state ------------------------------------------------------
+    "server_opt_state",     # FedOptServerManager's persistent optimizer
+    "partial_rounds",       # QuorumFedAvgServerManager's below-strength log
+})
+
+#: deliberately NOT checkpointed — each entry says why restart-fresh is
+#: correct
+SERVER_EPHEMERAL_FIELDS = frozenset({
+    "_timer",               # armed threading.Timer: re-armed by the first
+                            # post-restore broadcast
+    "_bcast_at",            # monotonic-clock latency origin of the open
+                            # round: meaningless in a new process
+    "scheduling_error",     # terminal latch: a run that died on it is over,
+                            # not resumable past the error
+    "_control_restored",    # one-shot restore latch inside send_init_msg:
+                            # a fresh process restores at most once
+})
+
+#: server classes exempt from FT009: no round schedule exists to resume.
+#: FedAsync merges every update into a version counter with no round
+#: barrier — a restarted FedAsync server is just a fresh server.
+UNCHECKPOINTED_SERVER_CLASSES = frozenset({"AsyncFedAvgServerManager"})

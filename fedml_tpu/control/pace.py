@@ -1,0 +1,130 @@
+"""Adaptive pace steering — deadlines and quorum targets from observed
+straggler behavior (Bonawitz et al., MLSys 2019 §4.2).
+
+The PR-5 fault-tolerance layer runs a *static* schedule:
+``--round_deadline_s`` and ``--min_quorum_frac`` are fixed numbers that
+ignore the straggler distribution the server actually observes. Set the
+deadline too tight and healthy silos get evicted every round; too loose
+and one straggler stretches every round to the worst case.
+:class:`PaceSteerer` closes the loop: it feeds on the
+``SiloLivenessTable``'s sliding report-latency window
+(``utils/watchdog.SlidingQuantileTracker`` — the time from a round's
+broadcast to each silo's reply) and derives
+
+- **the next round's deadline**: ``quantile(q) * margin`` (default
+  p90 · 1.5), clamped to ``[min_deadline_s, max_deadline_s]`` (default
+  base/4 .. base·4) so a burst of anomalous samples can never collapse
+  the deadline to zero or stretch it unboundedly;
+- **the next round's quorum fraction**: the 25th percentile of recent
+  per-round report fractions minus a slack (default 0.1), clamped to
+  ``[quorum_floor, QUORUM_CEIL]`` — when every silo reliably reports the
+  target tightens toward the full barrier; when 30% of the fleet flaps
+  it relaxes toward the caller's floor. The fraction ceiling alone
+  cannot prevent the single-straggler deadlock (``ceil(0.9·n) == n``
+  for every fleet of 10 or fewer), so the deadline server additionally
+  caps the *effective* requirement at ``live - 1`` silos whenever
+  steering is active (``handle_round_timeout``) — a steered schedule
+  never demands every live silo on a multi-silo fleet.
+
+Until ``min_samples`` observations exist both knobs return the caller's
+static values — with steering off (the default) behavior is
+byte-identical to the static flags. The steerer's windows are part of
+the server control-plane snapshot (``state()`` / ``load_state()``), so a
+restored server steers from the SAME evidence as the unkilled one.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+#: steered quorum never demands more than this fraction — a full-barrier
+#: (1.0) target would deadlock on the first permanently-dead silo
+QUORUM_CEIL = 0.95
+
+
+def interpolated_quantile(values: List[float], q: float) -> float:
+    """Linear-interpolation quantile (numpy's default method), dependency
+    free so the watchdog tracker can share it."""
+    if not values:
+        raise ValueError("quantile of an empty window")
+    s = sorted(values)
+    if len(s) == 1:
+        return float(s[0])
+    idx = q * (len(s) - 1)
+    lo = int(idx)
+    frac = idx - lo
+    hi = min(lo + 1, len(s) - 1)
+    return float(s[lo] * (1.0 - frac) + s[hi] * frac)
+
+
+class PaceSteerer:
+    def __init__(self, base_deadline_s: float,
+                 quantile: float = 0.9, margin: float = 1.5,
+                 min_deadline_s: Optional[float] = None,
+                 max_deadline_s: Optional[float] = None,
+                 min_samples: int = 4,
+                 quorum_floor: float = 0.5,
+                 quorum_slack: float = 0.1,
+                 window: int = 32):
+        if base_deadline_s is None or base_deadline_s <= 0:
+            raise ValueError("pace steering needs a positive base "
+                             "deadline (--round_deadline_s) to fall back "
+                             f"on; got {base_deadline_s!r}")
+        if not 0.0 < quantile <= 1.0:
+            raise ValueError(f"quantile must be in (0, 1], got {quantile}")
+        if not 0.0 < quorum_floor <= QUORUM_CEIL:
+            raise ValueError(f"quorum_floor must be in (0, {QUORUM_CEIL}], "
+                             f"got {quorum_floor}")
+        self.base_deadline_s = float(base_deadline_s)
+        self.quantile = float(quantile)
+        self.margin = float(margin)
+        self.min_deadline_s = (float(min_deadline_s)
+                               if min_deadline_s is not None
+                               else self.base_deadline_s / 4.0)
+        self.max_deadline_s = (float(max_deadline_s)
+                               if max_deadline_s is not None
+                               else self.base_deadline_s * 4.0)
+        if self.min_deadline_s > self.max_deadline_s:
+            raise ValueError(
+                f"min_deadline_s {self.min_deadline_s} > max_deadline_s "
+                f"{self.max_deadline_s}")
+        self.min_samples = max(1, int(min_samples))
+        self.quorum_floor = float(quorum_floor)
+        self.quorum_slack = float(quorum_slack)
+        #: per-round fraction of live silos that reported before the close
+        self._report_fracs: deque = deque(maxlen=int(window))
+
+    # -- evidence -----------------------------------------------------------
+    def observe_round(self, reported: int, live: int) -> None:
+        """Record one closed round's participation (reported / live)."""
+        self._report_fracs.append(min(1.0, reported / max(1, live)))
+
+    # -- the two steered knobs ----------------------------------------------
+    def next_deadline(self, latencies) -> float:
+        """``latencies`` is a SlidingQuantileTracker (or anything with
+        ``count()``/``quantile(q)``). Below ``min_samples`` the static
+        base deadline rules — steering never extrapolates from nothing."""
+        if latencies is None or latencies.count() < self.min_samples:
+            return self.base_deadline_s
+        q = latencies.quantile(self.quantile)
+        return min(self.max_deadline_s,
+                   max(self.min_deadline_s, q * self.margin))
+
+    def next_quorum_frac(self) -> float:
+        if len(self._report_fracs) < self.min_samples:
+            return self.quorum_floor
+        p25 = interpolated_quantile(list(self._report_fracs), 0.25)
+        return min(QUORUM_CEIL,
+                   max(self.quorum_floor, p25 - self.quorum_slack))
+
+    # -- checkpoint plumbing ------------------------------------------------
+    def state(self) -> Dict:
+        return {"report_fracs": [float(f) for f in self._report_fracs]}
+
+    def load_state(self, state: Optional[Dict]) -> None:
+        if not state:
+            return
+        self._report_fracs.clear()
+        self._report_fracs.extend(float(f)
+                                  for f in state.get("report_fracs", ()))

@@ -127,6 +127,39 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "'seed=7;drop:p=0.1;delay:p=0.2,delay_ms=50', "
                              "inline JSON, or a .json path. Wraps every "
                              "comm endpoint; empty/unset = no injection")
+    # -- elastic control plane (fedml_tpu/control/) --------------------------
+    parser.add_argument("--server_checkpoint_dir", type=str, default=None,
+                        help="durable server control-plane snapshots + "
+                             "round/cohort ledger: the full round-schedule "
+                             "state (round index, live set, compression "
+                             "mirror, pending replies, steering windows) "
+                             "is written atomically at round boundaries "
+                             "and deadline closes, so a killed-and-"
+                             "restarted server resumes mid-schedule. "
+                             "Unset = no snapshots (legacy)")
+    parser.add_argument("--pace_steering", action="store_true",
+                        help="adaptive pace steering (Bonawitz et al.): "
+                             "derive each round's deadline (p90 of "
+                             "observed report latencies x1.5, clamped to "
+                             "[base/4, base*4]) and quorum target from "
+                             "the straggler distribution instead of the "
+                             "static flags; --round_deadline_s is the "
+                             "base/fallback and --min_quorum_frac the "
+                             "floor. Off = byte-identical static "
+                             "schedule")
+    parser.add_argument("--join_rate_limit", type=float, default=0.0,
+                        help="JOIN admission control: token-bucket rate "
+                             "(joins/sec) on the server's full-precision "
+                             "rejoin-resync path; throttled silos get a "
+                             "BACKPRESSURE reply with retry_after_s so a "
+                             "mass rejoin after a partition cannot "
+                             "stampede the server. 0 = off")
+    parser.add_argument("--max_deadline_extensions", type=int, default=25,
+                        help="cap on consecutive below-quorum deadline "
+                             "extensions per round; exhausting it raises "
+                             "a loud SchedulingStallError (final state "
+                             "checkpointed) instead of extending forever. "
+                             "Negative = unbounded (the legacy behavior)")
     # -- population virtualization (fedml_tpu/state/) -----------------------
     parser.add_argument("--population", type=int, default=None,
                         help="virtualize the client population at this "
@@ -151,6 +184,14 @@ def add_federated_args(parser: argparse.ArgumentParser):
     parser.add_argument("--ci", type=int, default=0,
                         help="1 = tiny smoke-run truncation (reference --ci)")
     return parser
+
+
+def resolve_max_extensions(args):
+    """Flag convention shared by every launcher: a negative
+    ``--max_deadline_extensions`` means unbounded (the pre-control-plane
+    forever-extend behavior), encoded as None for the server managers."""
+    v = getattr(args, "max_deadline_extensions", 25)
+    return None if v is not None and v < 0 else v
 
 
 def build_dataset_and_model(args):

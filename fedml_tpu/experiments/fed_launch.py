@@ -12,7 +12,8 @@ import argparse
 import logging
 
 from fedml_tpu.experiments.args import (add_federated_args,
-                                        build_dataset_and_model)
+                                        build_dataset_and_model,
+                                        resolve_max_extensions)
 from fedml_tpu.experiments.main_fedavg import make_train_config
 from fedml_tpu.utils.metrics import MetricsSink
 
@@ -227,6 +228,13 @@ def run_algo(args):
             min_quorum_frac=getattr(args, "min_quorum_frac", 0.5),
             heartbeat_s=getattr(args, "heartbeat_s", 0.0),
             fault_plan=getattr(args, "fault_plan", None),
+            # elastic control plane: server failover + pace steering +
+            # JOIN admission (README "Elastic control plane")
+            server_checkpoint_dir=getattr(args, "server_checkpoint_dir",
+                                          None),
+            pace_steering=getattr(args, "pace_steering", False),
+            join_rate_limit=getattr(args, "join_rate_limit", 0.0),
+            max_deadline_extensions=resolve_max_extensions(args),
             # scale the join budget with the local work — on a 1-core
             # host the silo threads SERIALIZE, so the budget grows with
             # epochs x rounds x silos; the 1200 floor absorbs a
@@ -499,7 +507,13 @@ def run_algo(args):
             # fedasync mode warns and forces full precision inside
             compression=getattr(args, "compression", None),
             heartbeat_s=getattr(args, "heartbeat_s", 0.0),
-            fault_plan=getattr(args, "fault_plan", None))
+            fault_plan=getattr(args, "fault_plan", None),
+            # control plane (quorum mode only; fedasync warns + ignores)
+            server_checkpoint_dir=getattr(args, "server_checkpoint_dir",
+                                          None),
+            pace_steering=getattr(args, "pace_steering", False),
+            join_rate_limit=getattr(args, "join_rate_limit", 0.0),
+            max_deadline_extensions=resolve_max_extensions(args))
         for rec in history:
             sink.log(rec, step=rec["round"])
         final = dict(history[-1]) if history else {}

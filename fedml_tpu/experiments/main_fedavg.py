@@ -17,7 +17,8 @@ import argparse
 import logging
 
 from fedml_tpu.experiments.args import (add_federated_args,
-                                        build_dataset_and_model)
+                                        build_dataset_and_model,
+                                        resolve_max_extensions)
 from fedml_tpu.trainer.functional import TrainConfig
 from fedml_tpu.utils.checkpoint import CheckpointManager
 from fedml_tpu.utils.metrics import MetricsSink
@@ -132,6 +133,11 @@ def run_cross_silo(args, ds, model, task, sink):
         min_quorum_frac=getattr(args, "min_quorum_frac", 0.5),
         heartbeat_s=getattr(args, "heartbeat_s", 0.0),
         fault_plan=getattr(args, "fault_plan", None),
+        # elastic control plane (fedml_tpu/control/)
+        server_checkpoint_dir=getattr(args, "server_checkpoint_dir", None),
+        pace_steering=getattr(args, "pace_steering", False),
+        join_rate_limit=getattr(args, "join_rate_limit", 0.0),
+        max_deadline_extensions=resolve_max_extensions(args),
         # fedopt-style server step when the launcher passes the fedopt flags
         server_optimizer=getattr(args, "cross_silo_server_optimizer", None),
         server_lr=getattr(args, "server_lr", 1e-3))

@@ -17,6 +17,13 @@ leaves the server blocked forever in ``check_whether_all_receive``
   ``liveness=`` to enrich stall logs with the per-silo staleness
   breakdown, so "the federation stalled" comes with "...because silo 2
   has been dark for 241 s".
+- :class:`SlidingQuantileTracker` — a bounded window of observations
+  with interpolated quantiles. The liveness table feeds it each silo's
+  report latency (round-broadcast to reply); the control plane's
+  :class:`~fedml_tpu.control.pace.PaceSteerer` reads its p90 to steer
+  the next round's deadline. Window contents round-trip through the
+  server control-plane checkpoint (``values()`` / ``load()``), so a
+  restored server steers from the same evidence as the unkilled one.
 
 Usage:
 
@@ -33,7 +40,47 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Dict, Iterable, Optional, Set
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Set
+
+
+class SlidingQuantileTracker:
+    """A fixed-width window of float observations with interpolated
+    quantiles (numpy's default 'linear' method, dependency-free).
+    Thread-safe: silo replies land on the server's receive thread while
+    tests and bench code read quantiles from elsewhere."""
+
+    def __init__(self, window: int = 128):
+        if window <= 0:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._buf: deque = deque(maxlen=int(window))
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._buf.append(float(value))
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Interpolated q-quantile of the window, None when empty."""
+        from fedml_tpu.control.pace import interpolated_quantile
+        with self._lock:
+            if not self._buf:
+                return None
+            return interpolated_quantile(list(self._buf), q)
+
+    def values(self) -> List[float]:
+        with self._lock:
+            return list(self._buf)
+
+    def load(self, values: Iterable[float]) -> None:
+        """Replace the window (checkpoint restore)."""
+        with self._lock:
+            self._buf.clear()
+            self._buf.extend(float(v) for v in values)
 
 
 class SiloLivenessTable:
@@ -54,6 +101,11 @@ class SiloLivenessTable:
         self._live: Set[int] = set(self._last_seen)
         self.evictions = 0
         self.rejoins = 0
+        #: observed round-broadcast -> reply latencies, fleet-wide — the
+        #: distribution pace steering feeds on
+        self.report_latencies = SlidingQuantileTracker()
+        #: small per-silo windows for snapshot diagnostics
+        self._silo_latency: Dict[int, deque] = {}
 
     def beat(self, worker: int) -> None:
         """Record proof of life (piggybacked on ANY inbound message, plus
@@ -91,6 +143,15 @@ class SiloLivenessTable:
             self.rejoins += 1
             return True
 
+    def observe_report_latency(self, worker: int, latency_s: float) -> None:
+        """Record how long ``worker`` took from round broadcast to its
+        model reply — fleet-wide into :attr:`report_latencies` (the pace
+        steerer's input) and per-silo for snapshots."""
+        self.report_latencies.observe(latency_s)
+        with self._lock:
+            self._silo_latency.setdefault(
+                worker, deque(maxlen=16)).append(float(latency_s))
+
     def stale(self, timeout_s: float) -> Set[int]:
         """Live workers with no proof of life for ``timeout_s``."""
         cutoff = time.monotonic() - timeout_s
@@ -101,10 +162,18 @@ class SiloLivenessTable:
     def snapshot(self) -> Dict[int, Dict[str, float]]:
         """Per-worker {live, silent_s} for logs and bench artifacts."""
         now = time.monotonic()
+        from fedml_tpu.control.pace import interpolated_quantile
         with self._lock:
-            return {w: {"live": w in self._live,
-                        "silent_s": round(now - t, 3)}
-                    for w, t in sorted(self._last_seen.items())}
+            out = {}
+            for w, t in sorted(self._last_seen.items()):
+                row = {"live": w in self._live,
+                       "silent_s": round(now - t, 3)}
+                lat = self._silo_latency.get(w)
+                if lat:
+                    row["report_p50_s"] = round(
+                        interpolated_quantile(list(lat), 0.5), 4)
+                out[w] = row
+            return out
 
 
 class RoundWatchdog:

@@ -1,0 +1,49 @@
+"""FT009 negative corpus: manifest-covered fields, ephemeral fields,
+exempt classes, non-server classes, and the pragma escape hatch."""
+
+
+class ServerManager:  # stand-in base
+    pass
+
+
+class ClientManager:
+    pass
+
+
+class WellKeptServerManager(ServerManager):
+    def handle_message(self, msg):
+        # every field here is in SERVER_CHECKPOINT_FIELDS...
+        self.round_idx = 1
+        self.global_model = msg
+        self.ft_counters["stale_replies"] = 1
+        self.live_history.append({"round": 0})
+        self._worker_base[0] = (1, "fp")
+        self.server_opt_state = msg
+
+    def _arm(self):
+        # ...or SERVER_EPHEMERAL_FIELDS (documented restart-fresh)
+        self._timer = None
+        self._bcast_at = 0.0
+
+    def handle_special(self, msg):
+        # deliberate exception, documented in place
+        self.debug_probe = msg  # ft: allow[FT009] test-only probe, never read by the round loop
+
+    def read_only(self, msg):
+        # reads and non-mutating calls are not mutations
+        return self.ft_counters.get("x", 0) + len(self.live_history)
+
+
+class AsyncFedAvgServerManager(ServerManager):
+    def handle_message(self, msg):
+        # exempt class (UNCHECKPOINTED_SERVER_CLASSES): FedAsync has no
+        # round schedule to resume
+        self.version = 1
+        self.update_log.append(msg)
+
+
+class BusyClientManager(ClientManager):
+    def handle_message(self, msg):
+        # not a server manager: silo-side state is out of scope
+        self.rounds_completed = 3
+        self.pending.append(msg)

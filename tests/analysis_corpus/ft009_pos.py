@@ -1,0 +1,32 @@
+"""FT009 positive corpus: server round-state mutated in the message loop
+without a checkpoint-manifest entry — every mutation shape the rule
+detects, on a class whose base names a ServerManager."""
+
+
+class ServerManager:  # stand-in base (the rule matches by base NAME)
+    pass
+
+
+class ForgetfulServerManager(ServerManager):
+    def __init__(self):
+        # __init__ writes are exempt: defaults are not "forgotten" until
+        # the round loop mutates them
+        self.shiny_counter = 0
+        self.reply_log = []
+        self.per_silo_score = {}
+
+    def handle_message(self, msg):
+        # plain assign of an unmanifested field
+        self.shiny_counter = 1
+        # augmented assign
+        self.shiny_counter += 1
+        # subscript store
+        self.per_silo_score[msg] = 0.5
+        # container mutator call
+        self.reply_log.append(msg)
+
+
+class SubclassedQuorumServerManager(ForgetfulServerManager):
+    def handle_round_timeout(self, msg):
+        # unmanifested field on a subclass — a restarted server resets it
+        self.extension_note = "still waiting"

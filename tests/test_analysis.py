@@ -21,7 +21,7 @@ from fedml_tpu.analysis.lint import (FileContext, is_corpus_path,
 REPO = Path(__file__).resolve().parent.parent
 CORPUS = REPO / "tests" / "analysis_corpus"
 RULES = ("FT001", "FT002", "FT003", "FT004", "FT005", "FT006", "FT007",
-         "FT008")
+         "FT008", "FT009")
 
 
 def _lint_file(path, **kw):
