@@ -1,17 +1,35 @@
 #!/usr/bin/env bash
-# CI static lane: fedml_tpu.analysis (AST lint FT001-FT006 + jaxpr audit
-# of the registered hot entry points) over fedml_tpu/ and tests/.
+# CI static lane: fedml_tpu.analysis over fedml_tpu/ and tests/ —
+# AST lint (FT001-FT011) + unused-pragma strictness (FT012) + the
+# whole-program protocol conformance pass (FT2xx, drift-checked against
+# ci/protocol_graph.json) + the jaxpr/collective audit of registered
+# hot entry points (FT10x, drift-checked against
+# ci/collective_baseline.json).
 # Exit non-zero on any finding that is not fixed, pragma'd
 # (# ft: allow[FTxxx]) or baselined in ci/analysis_baseline.json.
-# The JSON report lands in runs/static_analysis.json as a CI artifact.
-# Extra args pass through (e.g. --no-audit for a sub-second lint-only
-# pre-commit hook).
+# The JSON report lands in runs/static_analysis.json and the
+# sender->handler graph in runs/protocol_graph.json as CI artifacts.
+#
+# Fast pre-commit lane (sub-second, no jax import):
+#   ci/run_static.sh --changed-only            # lint files touched vs HEAD
+#   ci/run_static.sh --changed-only origin/main
+# (--changed-only implies --no-audit --no-protocol inside the CLI.)
+#
+# Under GitHub Actions ($GITHUB_ACTIONS set) findings are emitted as
+# ::error file=...,line=...:: annotations.
+# Extra args pass through (e.g. --no-audit for lint+protocol only).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p runs
+FORMAT=()
+if [ -n "${GITHUB_ACTIONS:-}" ]; then
+    FORMAT=(--format github)
+fi
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
     python -m fedml_tpu.analysis \
     --baseline ci/analysis_baseline.json \
+    --strict-pragmas \
     --output runs/static_analysis.json \
+    ${FORMAT[@]+"${FORMAT[@]}"} \
     "$@"

@@ -1050,6 +1050,10 @@ class FedAvgClientManager(ClientManager):
         #: the heartbeat thread must not mistake a long local_train for
         #: an eviction and escalate to JOIN mid-round
         self._busy = False
+        #: guards the receive-thread/heartbeat-thread shared flags
+        #: (_busy, _last_s2c, _join_backoff_until, rounds_completed) —
+        #: a leaf lock, never held across a send or device dispatch
+        self._hb_lock = threading.Lock()
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         from fedml_tpu.trainer.functional import validate_accum_steps
@@ -1133,7 +1137,8 @@ class FedAvgClientManager(ClientManager):
         clock must keep running so the JOIN retries after the backoff."""
         retry = float(msg.get_params().get(
             MSG_ARG_KEY_RETRY_AFTER, max(1.0, self.heartbeat_s)))
-        self._join_backoff_until = time.monotonic() + retry
+        with self._hb_lock:
+            self._join_backoff_until = time.monotonic() + retry
         logging.info("silo %d: JOIN backpressured — retrying in %.2fs",
                      self.rank, retry)
 
@@ -1153,7 +1158,9 @@ class FedAvgClientManager(ClientManager):
 
     def _send_join(self) -> None:
         msg = Message(MSG_TYPE_C2S_JOIN, self.rank, 0)
-        msg.add(MSG_ARG_KEY_ROUNDS_COMPLETED, self.rounds_completed)
+        with self._hb_lock:
+            done = self.rounds_completed
+        msg.add(MSG_ARG_KEY_ROUNDS_COMPLETED, done)
         try:
             self.send_message(msg)
         except OSError as exc:
@@ -1168,10 +1175,13 @@ class FedAvgClientManager(ClientManager):
         been silent past ``rejoin_idle_s`` (we were evicted, or the
         server restarted and forgot us)."""
         while not self._hb_stop.wait(self.heartbeat_s):
-            idle = time.monotonic() - self._last_s2c
-            if not self._busy \
+            with self._hb_lock:  # snapshot the receive-thread flags
+                idle = time.monotonic() - self._last_s2c
+                busy = self._busy
+                backoff_until = self._join_backoff_until
+            if not busy \
                     and idle > max(self.rejoin_idle_s, self.heartbeat_s) \
-                    and time.monotonic() >= self._join_backoff_until:
+                    and time.monotonic() >= backoff_until:
                 self._send_join()
                 continue
             try:
@@ -1238,16 +1248,18 @@ class FedAvgClientManager(ClientManager):
                                   np.asarray(self._residual))
 
     def handle_message_init(self, msg: Message) -> None:
-        self._last_s2c = time.monotonic()  # server traffic: not forgotten
         # busy-flag the whole handler: local_train can legitimately run
         # far longer than rejoin_idle_s, and the heartbeat thread must
         # not read that as "the server forgot us" and JOIN mid-round
-        self._busy = True
+        with self._hb_lock:
+            self._last_s2c = time.monotonic()  # server traffic: alive
+            self._busy = True
         try:
             self._train_and_reply(msg)
         finally:
-            self._busy = False
-            self._last_s2c = time.monotonic()
+            with self._hb_lock:
+                self._busy = False
+                self._last_s2c = time.monotonic()
 
     def _train_and_reply(self, msg: Message) -> None:
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
@@ -1327,7 +1339,8 @@ class FedAvgClientManager(ClientManager):
                 "down? a restarted server re-drives the round", self.rank,
                 round_idx, exc)
             return
-        self.rounds_completed += 1
+        with self._hb_lock:
+            self.rounds_completed += 1
 
 
 def run_fedavg_cross_silo(dataset: FederatedDataset, module,

@@ -23,11 +23,24 @@ bitten by:
   vs strong scalar, a flipped dtype, or a shape drift between rounds
   shows up as key count > ``max_lowerings`` and fails CI instead of a
   bench window.
+- **FT105/FT106** — collective-signature drift: each entry's traced
+  program yields a *collective signature* — every ``psum`` /
+  ``all_gather`` / ``ppermute`` / ``reduce_scatter`` / ... eqn with its
+  axis names, eqn count, and estimated output bytes — checked against
+  the fingerprinted ``ci/collective_baseline.json``. A new unsolicited
+  collective, a changed axis, or a changed count is FT105; a bytes
+  estimate drifting beyond ``BYTES_TOLERANCE`` is FT106. This is the
+  ROADMAP SPMD item's CI guard: when the multi-chip mesh lands, a
+  sharded lowering that silently grows an all-gather fails lint, not a
+  bench. Regenerate deliberately with ``--write-collective-baseline``.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
+from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -43,6 +56,18 @@ except ImportError:  # pragma: no cover - very old jax
 LOOP_PRIMITIVES = frozenset({"scan", "while"})
 CALLBACK_PRIMITIVES = frozenset(
     {"pure_callback", "io_callback", "debug_callback"})
+
+#: cross-device communication primitives (the collective signature)
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather"})
+
+#: FT106 fires when an entry's per-(op, axes) bytes estimate grows or
+#: shrinks beyond this factor vs the baseline (shape-tolerant: model or
+#: batch tweaks within 1.5x pass; a 4x all-gather blowup does not)
+BYTES_TOLERANCE = 1.5
+
+COLLECTIVE_BASELINE_VERSION = 1
 
 
 def _sub_jaxprs(eqn) -> List[Any]:
@@ -125,9 +150,37 @@ def audit_spec(name: str, spec: AuditSpec) -> Tuple[List[Finding], Dict]:
     f64_seen: List[str] = []
     callback_in_loop: List[str] = []
     upcasts: List[str] = []
+    #: (op, axes) -> [eqn count, output bytes] — the collective
+    #: signature, collected from the FIRST trace only so the numbers do
+    #: not scale with sweep length (signature stability across the
+    #: sweep is FT104's job)
+    collectives: Dict[Tuple[str, Tuple[str, ...]], List[int]] = {}
+    _first_walk = [True]
+
+    def _collective_axes(eqn) -> Tuple[str, ...]:
+        axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+        if axes is None:
+            return ()
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        return tuple(sorted(str(a) for a in axes))
 
     def visit(eqn, in_loop: bool) -> None:
         prim = eqn.primitive.name
+        if prim in COLLECTIVE_PRIMITIVES and _first_walk[0]:
+            key = (prim, _collective_axes(eqn))
+            entry = collectives.setdefault(key, [0, 0])
+            entry[0] += 1
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                shape = getattr(aval, "shape", None)
+                dtype = getattr(aval, "dtype", None)
+                if shape is None or dtype is None:
+                    continue
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                entry[1] += n * dtype.itemsize
         if prim in CALLBACK_PRIMITIVES and in_loop:
             callback_in_loop.append(prim)
         if not spec.allow_f64:
@@ -152,6 +205,7 @@ def audit_spec(name: str, spec: AuditSpec) -> Tuple[List[Finding], Dict]:
             continue
         walked_keys.add(key)
         _walk(closed, False, visit)
+        _first_walk[0] = False
         if not spec.allow_f64:
             for v in _as_jaxpr(closed).invars + _as_jaxpr(closed).outvars:
                 if _is_f64(getattr(v, "aval", None)):
@@ -190,8 +244,138 @@ def audit_spec(name: str, spec: AuditSpec) -> Tuple[List[Finding], Dict]:
               "n_lowering_keys": len(distinct),
               "max_lowerings": spec.max_lowerings,
               "n_eqns": len(_as_jaxpr(closed).eqns),
-              "grad_path": spec.grad_path}
+              "grad_path": spec.grad_path,
+              "collectives": [
+                  {"op": op, "axes": list(axes), "count": cnt,
+                   "bytes": nbytes}
+                  for (op, axes), (cnt, nbytes) in sorted(
+                      collectives.items())]}
     return findings, report
+
+
+# -- collective-signature baseline (FT105/FT106) -----------------------------
+
+def collective_signature(report: Dict) -> List[Dict]:
+    return report.get("collectives", [])
+
+
+def _signature_fingerprint(collectives: List[Dict]) -> str:
+    blob = json.dumps(collectives, sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def write_collective_baseline(path: Path, reports: Sequence[Dict]) -> None:
+    """Snapshot every audited entry's collective signature (op + axes +
+    count + bytes, fingerprinted) — the deliberate, reviewable way to
+    accept a collective change."""
+    entries = {}
+    for rep in reports:
+        sig = collective_signature(rep)
+        entries[rep["entry"]] = {
+            "collectives": sig,
+            "fingerprint": _signature_fingerprint(sig)}
+    payload = {"version": COLLECTIVE_BASELINE_VERSION, "entries": entries}
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+
+def check_collective_baseline(reports: Sequence[Dict], path: Path
+                              ) -> Tuple[List[Finding], List[str]]:
+    """-> (findings, stale_entry_names) vs ``ci/collective_baseline.json``.
+
+    A missing or unreadable baseline is a LOUD FT105 — a deleted
+    snapshot must fail CI, never silently skip the drift check. A
+    baseline entry whose entry point no longer exists is stale (warn,
+    like stale finding-baseline entries)."""
+    path = Path(path)
+    regen = ("accept deliberately: python -m fedml_tpu.analysis "
+             "--write-collective-baseline")
+    if not path.exists():
+        return [audit_finding(
+            "FT105", "<baseline>",
+            f"collective baseline {path} is MISSING — collective-"
+            "signature drift cannot be checked, and a silently skipped "
+            "check is the failure mode this audit exists to prevent",
+            hint=regen)], []
+    try:
+        data = json.loads(path.read_text())
+        if data.get("version") != COLLECTIVE_BASELINE_VERSION:
+            raise ValueError(
+                f"unsupported version {data.get('version')!r}")
+        baseline = data["entries"]
+    except (OSError, ValueError, KeyError) as exc:
+        return [audit_finding(
+            "FT105", "<baseline>",
+            f"collective baseline {path} is unreadable ({exc}) — "
+            "regenerate it", hint=regen)], []
+    findings: List[Finding] = []
+    seen = set()
+    for rep in reports:
+        name = rep["entry"]
+        seen.add(name)
+        sig = collective_signature(rep)
+        base = baseline.get(name)
+        if base is None:
+            findings.append(audit_finding(
+                "FT105", name,
+                "entry point has no collective-baseline entry — every "
+                "registered hot entry point must be covered so a new "
+                "collective cannot land unreviewed", hint=regen,
+                detail=_signature_fingerprint(sig)))
+            continue
+        if base.get("fingerprint") == _signature_fingerprint(sig):
+            continue
+        by_key_new = {(c["op"], tuple(c["axes"])): c for c in sig}
+        by_key_old = {(c["op"], tuple(c["axes"])): c
+                      for c in base.get("collectives", [])}
+        for key in sorted(set(by_key_new) - set(by_key_old)):
+            c = by_key_new[key]
+            findings.append(audit_finding(
+                "FT105", name,
+                f"NEW collective {c['op']} over axes {c['axes']} "
+                f"({c['count']} eqn(s), ~{c['bytes']} bytes) not in the "
+                "baseline — an unsolicited cross-device transfer on the "
+                "hot path", hint=regen,
+                detail=f"+{c['op']}{c['axes']}"))
+        for key in sorted(set(by_key_old) - set(by_key_new)):
+            c = by_key_old[key]
+            findings.append(audit_finding(
+                "FT105", name,
+                f"collective {c['op']} over axes {c['axes']} DISAPPEARED "
+                "from the traced program — an aggregation the protocol "
+                "depends on may have been sharded away", hint=regen,
+                detail=f"-{c['op']}{c['axes']}"))
+        for key in sorted(set(by_key_old) & set(by_key_new)):
+            new, old = by_key_new[key], by_key_old[key]
+            if new["count"] != old["count"]:
+                    findings.append(audit_finding(
+                    "FT105", name,
+                    f"collective {new['op']} over axes {new['axes']} "
+                    f"changed eqn count {old['count']} -> "
+                    f"{new['count']}", hint=regen,
+                    detail=f"{new['op']}{new['axes']} "
+                           f"count {old['count']}->{new['count']}"))
+            elif old["bytes"] and not (
+                    1.0 / BYTES_TOLERANCE
+                    <= new["bytes"] / old["bytes"]
+                    <= BYTES_TOLERANCE):
+                    findings.append(audit_finding(
+                    "FT106", name,
+                    f"collective {new['op']} over axes {new['axes']} "
+                    f"bytes estimate drifted {old['bytes']} -> "
+                    f"{new['bytes']} (tolerance {BYTES_TOLERANCE}x) — "
+                    "a sharding or batching change moved real "
+                    "interconnect traffic", hint=regen,
+                    detail=f"{new['op']}{new['axes']} "
+                           f"{old['bytes']}->{new['bytes']}"))
+        # fingerprint moved but no per-key drift: bytes changed WITHIN
+        # tolerance — exactly what BYTES_TOLERANCE exists to absorb, so
+        # not a finding (the per-key checks above are the real compare;
+        # the fingerprint is only a fast-path short-circuit, and the
+        # stored one re-pins on the next deliberate regen)
+    stale = sorted(set(baseline) - seen)
+    return findings, stale
 
 
 def run_audit(only: Optional[Sequence[str]] = None

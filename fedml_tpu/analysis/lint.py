@@ -153,23 +153,49 @@ class FileContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=str(path))
         self.pragmas = self._collect_pragmas()
+        #: pragma line -> rule ids that actually suppressed a finding
+        self.pragmas_used: Dict[int, Set[str]] = {}
         self.lock_spans = self._collect_lock_spans()
         self.nested_def_spans = self._collect_nested_def_spans()
         self.jit_bindings = self._collect_jit_bindings()
 
     # -- pragmas ----------------------------------------------------------
     def _collect_pragmas(self) -> Dict[int, Set[str]]:
+        """Pragmas from real COMMENT tokens only: a pragma example
+        quoted in a docstring, a rule's hint string, or a test's
+        source-literal must neither suppress nor count as unused."""
+        import io
+        import tokenize
         out: Dict[int, Set[str]] = {}
-        for i, line in enumerate(self.lines, start=1):
-            m = PRAGMA_RE.search(line)
-            if m:
-                out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if m:
+                    out[tok.start[0]] = {r.strip()
+                                         for r in m.group(1).split(",")
+                                         if r.strip()}
+        except tokenize.TokenError:  # pragma: no cover — ast parsed, so
+            for i, line in enumerate(self.lines, start=1):  # regex fallback
+                m = PRAGMA_RE.search(line)
+                if m:
+                    out[i] = {r.strip() for r in m.group(1).split(",")
+                              if r.strip()}
         return out
 
     def allowed(self, rule: str, line: int) -> bool:
-        """Pragma on the finding's line or the line directly above it."""
+        """Pragma on the finding's line or the line directly above it.
+
+        A match is also RECORDED (``pragmas_used``): a pragma that never
+        suppresses anything across a whole run is stale and surfaces as
+        an unused-pragma warning / FT012 finding (``--strict-pragmas``).
+        """
         for ln in (line, line - 1):
             if rule in self.pragmas.get(ln, ()):
+                self.pragmas_used.setdefault(ln, set()).add(rule)
                 return True
         return False
 
@@ -272,18 +298,15 @@ def iter_python_files(paths: Sequence[Path]) -> Iterator[Path]:
                     yield sub
 
 
-def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
-               rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
-    """Run every rule over every python file under ``paths``.
-
-    ``root`` anchors the repo-relative paths findings carry (defaults to
-    the common parent, so fingerprints are stable no matter where the
-    CLI is invoked from). Unparseable files produce an FT000 finding
-    instead of crashing the run.
-    """
-    from fedml_tpu.analysis.rules import all_rules
-    rules = list(rules) if rules is not None else all_rules()
+def build_contexts(paths: Sequence[Path], root: Optional[Path] = None
+                   ) -> Tuple[List[FileContext], List[Finding]]:
+    """Parse every python file under ``paths`` once. Unparseable files
+    produce an FT000 finding instead of crashing the run. ``root``
+    anchors the repo-relative paths findings carry (defaults to the
+    common parent, so fingerprints are stable no matter where the CLI
+    is invoked from)."""
     root = Path(root).resolve() if root else None
+    ctxs: List[FileContext] = []
     findings: List[Finding] = []
     for path in iter_python_files(paths):
         resolved = path.resolve()
@@ -295,14 +318,24 @@ def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
         else:
             rel = path.as_posix()
         try:
-            ctx = FileContext(path, rel, path.read_text())
+            ctxs.append(FileContext(path, rel, path.read_text()))
         except (SyntaxError, UnicodeDecodeError) as exc:
             findings.append(Finding(
                 rule="FT000", path=rel,
                 line=getattr(exc, "lineno", 0) or 0,
                 message=f"unparseable: {type(exc).__name__}: {exc}",
                 hint="fix the syntax error; the linter cannot see this file"))
-            continue
+    return ctxs, findings
+
+
+def lint_contexts(ctxs: Sequence[FileContext],
+                  rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
+    """Run every rule over the pre-built contexts (pragma suppression
+    applied and recorded on each context's ``pragmas_used``)."""
+    from fedml_tpu.analysis.rules import all_rules
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for ctx in ctxs:
         for rule in rules:
             if not rule.applies(ctx.relpath):
                 continue
@@ -311,3 +344,53 @@ def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
+               rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
+    """Parse + lint (the one-call convenience the tests and callers that
+    don't need the shared contexts use)."""
+    ctxs, findings = build_contexts(paths, root=root)
+    findings.extend(lint_contexts(ctxs, rules=rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+UNUSED_PRAGMA_RULE = "FT012"
+
+
+def unused_pragmas(ctxs: Sequence[FileContext],
+                   active_rule_ids: Set[str],
+                   strict: bool = False) -> Tuple[List[Dict], List[Finding]]:
+    """-> (warnings, findings): pragma entries that suppressed nothing.
+
+    Run AFTER every pass that consumes pragmas (lint, protocol). Only
+    rule ids in ``active_rule_ids`` are judged — a pragma for a pass
+    that did not run this invocation (e.g. FT2xx under
+    ``--changed-only``) is not "unused", it is unexercised. ``strict``
+    additionally returns each stale pragma as an FT012 finding (itself
+    pragma-able: ``# ft: allow[FT012] why``)."""
+    warnings: List[Dict] = []
+    findings: List[Finding] = []
+    for ctx in ctxs:
+        for line, rules in sorted(ctx.pragmas.items()):
+            for rule in sorted(rules):
+                if rule == UNUSED_PRAGMA_RULE or rule not in active_rule_ids:
+                    continue
+                if rule in ctx.pragmas_used.get(line, ()):
+                    continue
+                warnings.append({"path": ctx.relpath, "line": line,
+                                 "rule": rule})
+                if strict and not ctx.allowed(UNUSED_PRAGMA_RULE, line):
+                    snippet = (ctx.lines[line - 1].strip()
+                               if 0 < line <= len(ctx.lines) else "")
+                    findings.append(Finding(
+                        rule=UNUSED_PRAGMA_RULE, path=ctx.relpath, line=line,
+                        message=f"pragma allow[{rule}] suppresses no "
+                                f"finding in this run — the flagged code "
+                                "was fixed or moved; stale suppressions "
+                                "accumulate and mask future regressions",
+                        hint="delete the pragma (or the stale rule id "
+                             "from its list)",
+                        snippet=snippet))
+    return warnings, findings

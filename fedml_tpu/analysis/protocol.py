@@ -1,0 +1,777 @@
+"""FT2xx — whole-program protocol conformance for the cross-silo wire.
+
+The actor protocol is a distributed contract with no single definition:
+``MSG_TYPE_*`` constants name the message types, ``Message(TYPE, ...)``
+constructions + ``msg.add(KEY, ...)`` calls define what each sender
+ships, and ``register_message_receive_handler(TYPE, self.handler)``
+registrations + the handler's ``msg.get(KEY)`` reads define what each
+receiver demands. PRs 4–7 grew this contract to 12+ message types
+across two files and three server flavors — and nothing checked the two
+sides against each other until a SIGKILL acceptance test hung.
+
+This pass extracts the full sender→handler graph statically:
+
+- **constants**: module-level ``MSG_TYPE_<NAME> = <int>`` definitions
+  (identity = *defining module + name*, so base_framework's type 10
+  NEIGHBOR_RESULT and cross-silo's type 10 HEARTBEAT never collide) and
+  ``MSG_ARG_KEY_<NAME>`` payload-key strings, both resolved through
+  ``from X import Y [as Z]`` chains and ``Class.ATTR`` class constants;
+- **send sites**: every ``Message(TYPE, ...)`` construction, with the
+  payload keys the surrounding function ``add``s to that message
+  variable (a non-literal key marks the site ``dynamic``: its key set
+  is open and payload checks stay quiet);
+- **handler sites**: every registration, resolved to the method in the
+  same class, with the keys it reads — ``msg.get(K)`` /
+  ``params[K]`` are *required*, ``get_params().get(K, default)`` is
+  *optional* — followed one call level deep through same-file helpers
+  the message is forwarded to.
+
+Findings (pragma-able at the send/registration line like every rule):
+
+- **FT200** — the checked-in snapshot ``ci/protocol_graph.json`` is
+  missing: CI must fail loudly, not silently skip the drift check.
+- **FT201** — a message type is sent but no handler is registered for
+  it anywhere (the S2C_JOIN_BACKPRESSURE-without-a-silo-handler class).
+- **FT202** — a handler is registered for a type nothing ever sends
+  (dead protocol surface, usually a renamed constant).
+- **FT203** — a handler *requires* a payload key no sender of that
+  type writes (KeyError on the receive thread => a hung federation).
+- **FT204** — the extracted graph drifted from the snapshot: new or
+  removed types/senders/handlers/keys fail lint until the snapshot is
+  regenerated with ``--write-protocol-graph`` (a deliberate,
+  reviewable protocol change).
+
+The pass is whole-program by construction — it runs over the full
+default tree and is skipped under ``--changed-only``.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, dotted_name, is_test_path
+
+GRAPH_VERSION = 1
+
+#: envelope/header keys every message carries — never payload contract
+_HEADER_KEYS = frozenset({"msg_type", "sender", "receiver", "__wire_seq__"})
+
+_HINTS = {
+    "FT200": ("regenerate the snapshot: python -m fedml_tpu.analysis "
+              "--write-protocol-graph"),
+    "FT201": ("register a handler for this type on the receiving role "
+              "(register_message_receive_handler) or delete the dead "
+              "send path"),
+    "FT202": ("add the send site this handler is waiting for, or remove "
+              "the registration (dead protocol surface)"),
+    "FT203": ("add the key at every send site of this type, or read it "
+              "optionally: msg.get_params().get(key, default)"),
+    "FT204": ("review the protocol change, then refresh the snapshot: "
+              "python -m fedml_tpu.analysis --write-protocol-graph"),
+}
+
+
+def _module_of(relpath: str) -> str:
+    """``fedml_tpu/comm/message.py`` -> ``fedml_tpu.comm.message``;
+    package ``__init__`` files map to the package itself."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class _ModuleTable:
+    """Per-module symbol information the resolver needs."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.module = _module_of(ctx.relpath)
+        #: NAME -> int value (module-level MSG_TYPE-shaped constants)
+        self.int_consts: Dict[str, int] = {}
+        #: NAME -> str value (module-level key constants)
+        self.str_consts: Dict[str, str] = {}
+        #: NAME -> (module, name) import aliases
+        self.imports: Dict[str, Tuple[str, str]] = {}
+        #: NAME -> unresolved RHS expr (e.g. Message.MSG_ARG_KEY_X)
+        self.alias_exprs: Dict[str, ast.expr] = {}
+        #: ClassName -> {ATTR: str value}
+        self.class_str_attrs: Dict[str, Dict[str, str]] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in self.ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                val = node.value
+                if isinstance(val, ast.Constant):
+                    if isinstance(val.value, bool):
+                        pass
+                    elif isinstance(val.value, int):
+                        self.int_consts[name] = val.value
+                    elif isinstance(val.value, str):
+                        self.str_consts[name] = val.value
+                else:
+                    self.alias_exprs[name] = val
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (
+                        node.module, alias.name)
+            elif isinstance(node, ast.ClassDef):
+                attrs: Dict[str, str] = {}
+                for stmt in node.body:
+                    if isinstance(stmt, ast.Assign) \
+                            and len(stmt.targets) == 1 \
+                            and isinstance(stmt.targets[0], ast.Name) \
+                            and isinstance(stmt.value, ast.Constant) \
+                            and isinstance(stmt.value.value, str):
+                        attrs[stmt.targets[0].id] = stmt.value.value
+                if attrs:
+                    self.class_str_attrs[node.name] = attrs
+
+
+class _Program:
+    """The resolver over every module table (whole-program view)."""
+
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.tables: Dict[str, _ModuleTable] = {}
+        for ctx in ctxs:
+            t = _ModuleTable(ctx)
+            self.tables[t.module] = t
+
+    # -- constant resolution ------------------------------------------------
+    def resolve_int(self, module: str, name: str, _depth: int = 0
+                    ) -> Optional[Tuple[str, str, int]]:
+        """-> (defining module, name, value) for an int constant."""
+        if _depth > 8:
+            return None
+        t = self.tables.get(module)
+        if t is None:
+            return None
+        if name in t.int_consts:
+            return (module, name, t.int_consts[name])
+        if name in t.imports:
+            mod, orig = t.imports[name]
+            return self.resolve_int(mod, orig, _depth + 1)
+        return None
+
+    def resolve_str(self, module: str, expr: ast.expr, _depth: int = 0
+                    ) -> Optional[str]:
+        """String value of a key expression: literal, module constant,
+        imported constant, or ``Class.ATTR`` class constant."""
+        if _depth > 8:
+            return None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        name = dotted_name(expr)
+        if not name:
+            return None
+        return self._resolve_str_name(module, name, _depth)
+
+    def _resolve_str_name(self, module: str, name: str, _depth: int
+                          ) -> Optional[str]:
+        if _depth > 8:
+            return None
+        t = self.tables.get(module)
+        if t is None:
+            return None
+        head, _, rest = name.partition(".")
+        if rest:  # Class.ATTR or imported-module attribute
+            cls_mod, cls_name = self._resolve_name_target(module, head)
+            if cls_name is not None:
+                ct = self.tables.get(cls_mod)
+                if ct and cls_name in ct.class_str_attrs:
+                    return ct.class_str_attrs[cls_name].get(rest)
+            return None
+        if name in t.str_consts:
+            return t.str_consts[name]
+        if name in t.alias_exprs:
+            return self.resolve_str(module, t.alias_exprs[name], _depth + 1)
+        if name in t.imports:
+            mod, orig = t.imports[name]
+            return self._resolve_str_name(mod, orig, _depth + 1)
+        return None
+
+    def _resolve_name_target(self, module: str, name: str, _depth: int = 0
+                             ) -> Tuple[str, Optional[str]]:
+        """Follow import chains for a bare name until the module that
+        really defines it (class or constant)."""
+        if _depth > 8:
+            return module, None
+        t = self.tables.get(module)
+        if t is None:
+            return module, None
+        if name in t.class_str_attrs:
+            return module, name
+        if name in t.imports:
+            mod, orig = t.imports[name]
+            return self._resolve_name_target(mod, orig, _depth + 1)
+        return module, name  # defined (or at least terminal) here
+
+
+# -- per-function extraction -------------------------------------------------
+
+class _SendSite:
+    def __init__(self, type_id: Tuple[str, str, int], path: str, line: int,
+                 where: str):
+        self.type_id = type_id
+        self.path = path
+        self.line = line
+        self.where = where
+        self.keys: Set[str] = set()
+        self.dynamic = False
+
+
+class _ParametricSend:
+    """A ``Message(param, ...)`` construction whose type flows in as a
+    function parameter (the ``_broadcast_model(msg_type, idxs)`` shape).
+    Callers passing a resolvable constant materialize one send site per
+    distinct type."""
+
+    def __init__(self, fn_name: str, param: str, params: List[str],
+                 path: str, line: int, where: str):
+        self.fn_name = fn_name
+        self.param = param
+        self.params = params  # full positional parameter list (incl self)
+        self.path = path
+        self.line = line
+        self.where = where
+        self.keys: Set[str] = set()
+        self.dynamic = False
+
+
+def _shallow_walk(root: ast.AST):
+    """ast.walk that does NOT descend into nested function defs — each
+    nested def (timer ``fire``, thread ``runner``) is its own extraction
+    unit, so its sends are never double-counted."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+class _HandlerSite:
+    def __init__(self, type_id: Tuple[str, str, int], path: str, line: int,
+                 cls: str, handler: str):
+        self.type_id = type_id
+        self.path = path
+        self.line = line
+        self.cls = cls
+        self.handler = handler
+        self.required: Set[str] = set()
+        self.optional: Set[str] = set()
+        self.resolved = False  # handler method found + analyzed
+
+
+def _functions(tree: ast.AST):
+    """Every function/method def with its enclosing class name ('' for
+    module level)."""
+    out = []
+
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((cls, child))
+                visit(child, cls)
+            else:
+                visit(child, cls)
+
+    visit(tree, "")
+    return out
+
+
+def _extract_sends(prog: _Program, table: _ModuleTable, fn: ast.AST,
+                   cls: str
+                   ) -> Tuple[List[_SendSite], List[_ParametricSend]]:
+    """``Message(TYPE, ...)`` constructions in one function, with the
+    keys added to the bound variable in the same function body.
+
+    Statement order matters: the codebase rebinds the same variable to
+    different messages in one handler (``out = Message(BACKPRESSURE,
+    ...)`` then ``out = Message(SYNC_MODEL, ...)``), so bind/add events
+    replay in line order. A type expression that is a *parameter* of
+    ``fn`` yields a :class:`_ParametricSend` for caller resolution; a
+    conditional ``A if c else B`` yields a site per branch."""
+    module, ctx = table.module, table.ctx
+    where = f"{cls or '<module>'}.{fn.name}"
+    param_names = [a.arg for a in fn.args.args]
+    sites: List[_SendSite] = []
+    parametrics: List[_ParametricSend] = []
+    # (line, kind, payload): kind "bind" -> (var, targets) | "add" ->
+    # (var, key expr)
+    events: List[Tuple[int, int, str, object]] = []
+
+    def type_exprs(call: ast.Call) -> List[ast.expr]:
+        expr = None
+        if call.args:
+            expr = call.args[0]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "type":
+                    expr = kw.value
+        if expr is None:
+            return []
+        if isinstance(expr, ast.IfExp):
+            return [expr.body, expr.orelse]
+        return [expr]
+
+    def targets_of(call: ast.Call) -> List[object]:
+        """Send sites / parametric sends this construction creates."""
+        callee = dotted_name(call.func)
+        if not callee or callee.split(".")[-1] != "Message":
+            return []
+        out: List[object] = []
+        for expr in type_exprs(call):
+            name = dotted_name(expr)
+            if not name or "." in name:
+                continue  # literal ints / computed types: undeclared
+            if name in param_names:
+                out.append(_ParametricSend(fn.name, name, param_names,
+                                           ctx.relpath, call.lineno, where))
+                continue
+            tid = prog.resolve_int(module, name)
+            if tid is not None:
+                out.append(_SendSite(tid, ctx.relpath, call.lineno, where))
+        return out
+
+    def register(made: List[object]) -> None:
+        for m in made:
+            if isinstance(m, _SendSite):
+                sites.append(m)
+            else:
+                parametrics.append(m)
+
+    bound_calls: Set[int] = set()  # Call node ids consumed by an Assign
+    for node in _shallow_walk(fn):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            made = targets_of(node.value)
+            if made:
+                bound_calls.add(id(node.value))
+                register(made)
+                for tgt in node.targets:
+                    nm = dotted_name(tgt)
+                    if nm:
+                        events.append((node.lineno, 0, "bind", (nm, made)))
+    for node in _shallow_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if id(node) not in bound_calls:
+            made = targets_of(node)
+            register(made)  # inline Message(...) passed straight to send
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("add", "add_params") and node.args:
+            recv = dotted_name(node.func.value)
+            if recv:
+                events.append((node.lineno, 1, "add",
+                               (recv, node.args[0])))
+    # replay in source order: a bind replaces the variable's message
+    by_var: Dict[str, List[object]] = {}
+    for _, _, kind, payload in sorted(events, key=lambda e: (e[0], e[1])):
+        if kind == "bind":
+            by_var[payload[0]] = payload[1]
+        else:
+            recv, key_expr = payload
+            for site in by_var.get(recv, ()):
+                key = prog.resolve_str(module, key_expr)
+                if key is None:
+                    site.dynamic = True
+                elif key not in _HEADER_KEYS:
+                    site.keys.add(key)
+    return sites, parametrics
+
+
+class _KeyReads:
+    def __init__(self):
+        self.required: Set[str] = set()
+        self.optional: Set[str] = set()
+        self.forwards: List[Tuple[str, str]] = []  # (callee, via) — msg fwd
+
+
+def _method_key_reads(prog: _Program, table: _ModuleTable,
+                      fn: ast.AST, msg_param: str) -> _KeyReads:
+    """Keys one function reads off its message parameter.
+
+    ``msg.get(K)`` / ``msg.get_params()[K]`` / ``params[K]`` (where
+    ``params = msg.get_params()``) are required; ``.get(K, default)``
+    dict-gets are optional. Calls that forward the message variable are
+    recorded for one-level expansion."""
+    out = _KeyReads()
+    module = table.module
+    #: local aliases of msg.get_params() results
+    param_aliases: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func)
+            if callee == f"{msg_param}.get_params":
+                for tgt in node.targets:
+                    nm = dotted_name(tgt)
+                    if nm:
+                        param_aliases.add(nm)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee == f"{msg_param}.get" and node.args:
+                key = prog.resolve_str(module, node.args[0])
+                if key is not None and key not in _HEADER_KEYS:
+                    out.required.add(key)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get":
+                recv = dotted_name(node.func.value)
+                recv_is_params = (recv in param_aliases
+                                  or (isinstance(node.func.value, ast.Call)
+                                      and dotted_name(node.func.value.func)
+                                      == f"{msg_param}.get_params"))
+                if recv_is_params and node.args:
+                    key = prog.resolve_str(module, node.args[0])
+                    if key is not None and key not in _HEADER_KEYS:
+                        out.optional.add(key)  # dict-get tolerates absence
+            else:
+                # forwarded message: self.helper(msg) / helper(msg, ...)
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if any(isinstance(a, ast.Name) and a.id == msg_param
+                       for a in args) and callee:
+                    last = callee.split(".")[-1]
+                    out.forwards.append((last, callee))
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            recv = dotted_name(node.value)
+            if recv in param_aliases or (
+                    isinstance(node.value, ast.Call)
+                    and dotted_name(node.value.func)
+                    == f"{msg_param}.get_params"):
+                key = prog.resolve_str(module, node.slice)
+                if key is not None and key not in _HEADER_KEYS:
+                    out.required.add(key)
+    return out
+
+
+def _msg_param_name(fn: ast.AST) -> Optional[str]:
+    """The message parameter of a handler/helper: the first non-self
+    positional arg."""
+    args = [a.arg for a in fn.args.args if a.arg != "self"]
+    return args[0] if args else None
+
+
+def extract_protocol(ctxs: Sequence[FileContext]) -> Dict:
+    """-> the full protocol graph (see module docstring) as a dict:
+    ``{"version", "types": [{module, name, value, senders, handlers}]}``
+    with line numbers included (the ``runs/`` artifact shape)."""
+    prog = _Program(ctxs)
+    sends: List[_SendSite] = []
+    handlers: List[_HandlerSite] = []
+
+    for ctx in ctxs:
+        table = prog.tables[_module_of(ctx.relpath)]
+        funcs = _functions(ctx.tree)
+        #: (cls, name) -> fn node for handler resolution
+        methods = {(c, f.name): f for c, f in funcs}
+        #: fn name -> parametric sends declared in this file
+        file_parametrics: Dict[str, List[_ParametricSend]] = {}
+        for cls, fn in funcs:
+            got, pars = _extract_sends(prog, table, fn, cls)
+            sends.extend(got)
+            for p in pars:
+                file_parametrics.setdefault(p.fn_name, []).append(p)
+            for node in _shallow_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func) or ""
+                if callee.split(".")[-1] != \
+                        "register_message_receive_handler" \
+                        or len(node.args) < 2:
+                    continue
+                tname = dotted_name(node.args[0])
+                if not tname or "." in tname:
+                    continue
+                tid = prog.resolve_int(table.module, tname)
+                if tid is None:
+                    continue
+                hname = dotted_name(node.args[1]) or "<lambda>"
+                short = hname.split(".")[-1]
+                site = _HandlerSite(tid, ctx.relpath, node.lineno, cls,
+                                    short)
+                handlers.append(site)
+                target = methods.get((cls, short)) or methods.get(("", short))
+                if target is None:
+                    continue
+                site.resolved = True
+                msg_param = _msg_param_name(target)
+                if msg_param is None:
+                    continue
+                reads = _method_key_reads(prog, table, target, msg_param)
+                site.required |= reads.required
+                site.optional |= reads.optional
+                # one-level expansion through same-file helpers the
+                # message is forwarded to
+                seen = {short}
+                work = list(reads.forwards)
+                while work:
+                    helper, _ = work.pop()
+                    if helper in seen:
+                        continue
+                    seen.add(helper)
+                    hfn = methods.get((cls, helper)) or \
+                        methods.get(("", helper))
+                    if hfn is None:
+                        continue
+                    hparam = _msg_param_name(hfn)
+                    if hparam is None:
+                        continue
+                    hreads = _method_key_reads(prog, table, hfn, hparam)
+                    site.required |= hreads.required
+                    site.optional |= hreads.optional
+                    work.extend(hreads.forwards)
+        # materialize parametric sends at their call sites: the caller
+        # chooses the type, the callee's body defines the payload keys
+        # (the `_broadcast_model(MSG_TYPE_..., idxs)` shape)
+        if file_parametrics:
+            for cls, fn in funcs:
+                for node in _shallow_walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = dotted_name(node.func) or ""
+                    last = callee.split(".")[-1]
+                    for p in file_parametrics.get(last, ()):
+                        idx = p.params.index(p.param)
+                        if p.params and p.params[0] == "self" \
+                                and "." in callee:
+                            idx -= 1  # bound-method call drops self
+                        if not 0 <= idx < len(node.args):
+                            continue
+                        tname = dotted_name(node.args[idx])
+                        if not tname or "." in tname:
+                            continue
+                        tid = prog.resolve_int(table.module, tname)
+                        if tid is None:
+                            continue
+                        site = _SendSite(
+                            tid, ctx.relpath, node.lineno,
+                            f"{cls or '<module>'}.{fn.name}")
+                        site.keys = set(p.keys)
+                        site.dynamic = p.dynamic
+                        sends.append(site)
+
+    types: Dict[Tuple[str, str, int], Dict] = {}
+
+    def entry(tid):
+        if tid not in types:
+            types[tid] = {"module": tid[0], "name": tid[1],
+                          "value": tid[2], "senders": [], "handlers": []}
+        return types[tid]
+
+    for s in sends:
+        entry(s.type_id)["senders"].append({
+            "path": s.path, "line": s.line, "where": s.where,
+            "keys": sorted(s.keys), "dynamic": s.dynamic})
+    for h in handlers:
+        entry(h.type_id)["handlers"].append({
+            "path": h.path, "line": h.line, "class": h.cls,
+            "handler": h.handler, "required": sorted(h.required),
+            "optional": sorted(h.optional), "resolved": h.resolved})
+    # declared-but-unused constants still appear (value-only nodes):
+    # the graph must cover EVERY msg type the tree defines
+    for module, table in prog.tables.items():
+        if is_test_path(table.ctx.relpath):
+            continue
+        for name, value in table.int_consts.items():
+            if name.startswith("MSG_TYPE_"):
+                entry((module, name, value))
+    rows = [types[k] for k in sorted(types)]
+    for row in rows:
+        row["senders"].sort(key=lambda s: (s["path"], s["line"]))
+        row["handlers"].sort(key=lambda h: (h["path"], h["line"]))
+    return {"version": GRAPH_VERSION, "types": rows}
+
+
+def normalize_graph(graph: Dict) -> Dict:
+    """Line-free shape for the checked-in snapshot: unrelated edits must
+    not drift the fingerprint."""
+    out = []
+    for row in graph["types"]:
+        out.append({
+            "module": row["module"], "name": row["name"],
+            "value": row["value"],
+            "senders": sorted({json.dumps(
+                {"path": s["path"], "keys": s["keys"],
+                 "dynamic": s["dynamic"]}, sort_keys=True)
+                for s in row["senders"]}),
+            "handlers": sorted({json.dumps(
+                {"path": h["path"], "class": h["class"],
+                 "handler": h["handler"], "required": h["required"],
+                 "optional": h["optional"]}, sort_keys=True)
+                for h in row["handlers"]}),
+        })
+    payload = {"version": GRAPH_VERSION, "types": out}
+    blob = json.dumps(payload, sort_keys=True)
+    payload["fingerprint"] = hashlib.sha1(blob.encode()).hexdigest()[:16]
+    return payload
+
+
+def _finding(rule: str, path: str, line: int, message: str,
+             snippet: str = "") -> Finding:
+    return Finding(rule=rule, path=path, line=line, message=message,
+                   hint=_HINTS[rule], snippet=snippet)
+
+
+def _type_label(row: Dict) -> str:
+    return f"{row['module']}.{row['name']} (= {row['value']})"
+
+
+def conformance_findings(graph: Dict,
+                         ctxs: Sequence[FileContext]) -> List[Finding]:
+    """FT201/FT202/FT203 over the extracted graph. Pragma suppression is
+    applied via the originating file's context (``# ft: allow[FT20x]``
+    at the send/registration line)."""
+    by_path = {ctx.relpath: ctx for ctx in ctxs}
+
+    def allowed(rule: str, path: str, line: int) -> bool:
+        ctx = by_path.get(path)
+        return ctx.allowed(rule, line) if ctx else False
+
+    def snippet(path: str, line: int) -> str:
+        ctx = by_path.get(path)
+        if ctx and 0 < line <= len(ctx.lines):
+            return ctx.lines[line - 1].strip()
+        return ""
+
+    findings: List[Finding] = []
+    for row in graph["types"]:
+        senders, hands = row["senders"], row["handlers"]
+        if senders and not hands:
+            s = senders[0]
+            if not allowed("FT201", s["path"], s["line"]):
+                findings.append(_finding(
+                    "FT201", s["path"], s["line"],
+                    f"message type {_type_label(row)} is sent "
+                    f"({len(senders)} site(s)) but NO handler is "
+                    "registered for it anywhere — the receiver's "
+                    "dispatch raises KeyError (or the frame is dropped) "
+                    "and the protocol hangs at the next barrier",
+                    snippet(s["path"], s["line"])))
+        if hands and not senders:
+            h = hands[0]
+            if not allowed("FT202", h["path"], h["line"]):
+                findings.append(_finding(
+                    "FT202", h["path"], h["line"],
+                    f"handler {h['class']}.{h['handler']} is registered "
+                    f"for {_type_label(row)} but nothing in the tree "
+                    "ever sends that type — dead protocol surface "
+                    "(renamed constant? deleted sender?)",
+                    snippet(h["path"], h["line"])))
+        if not (senders and hands):
+            continue
+        any_dynamic = any(s["dynamic"] for s in senders)
+        sent_everywhere = set(senders[0]["keys"])
+        for s in senders[1:]:
+            sent_everywhere &= set(s["keys"])
+        for h in hands:
+            if not h["resolved"]:
+                continue
+            for key in h["required"]:
+                if key in sent_everywhere or any_dynamic:
+                    continue
+                sent_somewhere = any(key in s["keys"] for s in senders)
+                if allowed("FT203", h["path"], h["line"]):
+                    continue
+                where = ("only SOME senders write it"
+                         if sent_somewhere else "no sender writes it")
+                findings.append(_finding(
+                    "FT203", h["path"], h["line"],
+                    f"handler {h['class']}.{h['handler']} REQUIRES "
+                    f"payload key {key!r} of {_type_label(row)} but "
+                    f"{where} — msg.get raises KeyError on the receive "
+                    "thread and the round never closes",
+                    snippet(h["path"], h["line"])))
+    return findings
+
+
+def snapshot_findings(graph: Dict, snapshot_path: Path) -> List[Finding]:
+    """FT200 (missing snapshot) / FT204 (drift) against ``ci/``."""
+    norm = normalize_graph(graph)
+    path = Path(snapshot_path)
+    if not path.exists():
+        return [_finding(
+            "FT200", str(snapshot_path), 0,
+            "protocol-graph snapshot is MISSING — the drift check "
+            "cannot run, and a silently skipped check is exactly the "
+            "failure mode this pass exists to prevent")]
+    try:
+        old = json.loads(path.read_text())
+    except (OSError, ValueError) as exc:
+        return [_finding(
+            "FT200", str(snapshot_path), 0,
+            f"protocol-graph snapshot is unreadable ({exc}) — "
+            "regenerate it")]
+    if old.get("fingerprint") == norm["fingerprint"]:
+        return []
+    # diff at type granularity for an actionable message
+    old_types = {(t["module"], t["name"]): t for t in old.get("types", [])}
+    new_types = {(t["module"], t["name"]): t for t in norm["types"]}
+    changes: List[str] = []
+    for key in sorted(set(new_types) - set(old_types)):
+        changes.append(f"new type {key[0]}.{key[1]}")
+    for key in sorted(set(old_types) - set(new_types)):
+        changes.append(f"removed type {key[0]}.{key[1]}")
+    for key in sorted(set(old_types) & set(new_types)):
+        if old_types[key] != new_types[key]:
+            changes.append(f"changed senders/handlers/keys of "
+                           f"{key[0]}.{key[1]}")
+    detail = "; ".join(changes) or "graph fingerprint changed"
+    return [_finding(
+        "FT204", str(snapshot_path), 0,
+        f"protocol graph drifted from the checked-in snapshot: {detail}")]
+
+
+def write_graph(graph: Dict, artifact_path: Path,
+                snapshot_path: Optional[Path] = None) -> None:
+    """Write the line-bearing artifact (``runs/``) and optionally the
+    normalized snapshot (``ci/``)."""
+    artifact_path = Path(artifact_path)
+    artifact_path.parent.mkdir(parents=True, exist_ok=True)
+    artifact_path.write_text(json.dumps(graph, indent=2, sort_keys=True)
+                             + "\n")
+    if snapshot_path is not None:
+        snapshot_path = Path(snapshot_path)
+        snapshot_path.parent.mkdir(parents=True, exist_ok=True)
+        snapshot_path.write_text(
+            json.dumps(normalize_graph(graph), indent=2, sort_keys=True)
+            + "\n")
+
+
+def check_protocol(ctxs: Sequence[FileContext], snapshot_path: Path,
+                   artifact_path: Optional[Path] = None,
+                   write_snapshot: bool = False
+                   ) -> Tuple[List[Finding], Dict]:
+    """The CLI entry: extract, emit the artifact, check conformance +
+    snapshot. With ``write_snapshot`` the snapshot is refreshed instead
+    of compared (conformance findings still apply — a snapshot must
+    never launder an FT201)."""
+    lib_ctxs = [c for c in ctxs if not is_test_path(c.relpath)]
+    graph = extract_protocol(lib_ctxs)
+    if artifact_path is not None:
+        write_graph(graph, artifact_path)
+    findings = conformance_findings(graph, lib_ctxs)
+    if write_snapshot:
+        snapshot_path = Path(snapshot_path)
+        snapshot_path.parent.mkdir(parents=True, exist_ok=True)
+        snapshot_path.write_text(
+            json.dumps(normalize_graph(graph), indent=2, sort_keys=True)
+            + "\n")
+    else:
+        findings.extend(snapshot_findings(graph, snapshot_path))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, graph

@@ -1,8 +1,23 @@
 """Rule registry: one module per project-specific rule.
 
-Each rule carries an id (FT001..FT009), a docstring explaining the
+Each rule carries an id (FT001..FT011), a docstring explaining the
 hazard in THIS codebase's terms, and a fix hint. ``all_rules()`` is the
 canonical ordered instantiation the engine and the CLI share.
+
+Beyond the per-file AST rules live three engine/whole-program families
+(listed in ``rule_table()`` so ``--list-rules`` and the README show the
+complete surface):
+
+- FT012 — unused-pragma detection (engine pass in ``analysis/lint.py``)
+- FT10x — jaxpr audit of registered hot entry points
+  (``analysis/jaxpr_audit.py``)
+- FT2xx — whole-program protocol conformance (``analysis/protocol.py``)
+
+``CORPUS_RULE_IDS`` names every rule that must ship a
+``tests/analysis_corpus/<id>_pos.py`` / ``_neg.py`` pair — the
+corpus-completeness meta-test enforces it, so a future rule cannot land
+untested. Trace-level (FT10x) and snapshot-level (FT200/FT204) checks
+are exercised by planted in-process specs instead of corpus files.
 """
 
 from __future__ import annotations
@@ -12,6 +27,8 @@ from typing import List
 from fedml_tpu.analysis.lint import Rule
 from fedml_tpu.analysis.rules.broad_except import BroadExceptRule
 from fedml_tpu.analysis.rules.comm_timeouts import CommTimeoutRule
+from fedml_tpu.analysis.rules.concurrency import (LockOrderRule,
+                                                  SharedStateLockRule)
 from fedml_tpu.analysis.rules.donation import DonatedReuseRule
 from fedml_tpu.analysis.rules.float64 import Float64Rule
 from fedml_tpu.analysis.rules.host_sync import HostSyncRule
@@ -22,7 +39,68 @@ from fedml_tpu.analysis.rules.server_state import ServerStateRule
 
 _RULES = (GlobalRngRule, DonatedReuseRule, HostSyncRule,
           JitScalarArgRule, BroadExceptRule, Float64Rule,
-          CommTimeoutRule, PopulationGrowthRule, ServerStateRule)
+          CommTimeoutRule, PopulationGrowthRule, ServerStateRule,
+          SharedStateLockRule, LockOrderRule)
+
+#: engine / whole-program / audit checks that are not per-file Rule
+#: instances but are part of the rule surface
+_EXTRA_RULE_ROWS = (
+    {"id": "FT012",
+     "title": "pragma that suppresses no finding (stale suppression)",
+     "hint": "delete the pragma; warned by default, a finding under "
+             "--strict-pragmas"},
+    {"id": "FT100",
+     "title": "jaxpr audit: entry point failed to build/trace",
+     "hint": "an auditable entry must stay traceable on the CPU CI "
+             "backend"},
+    {"id": "FT101",
+     "title": "jaxpr audit: float64 aval under x64-off intent",
+     "hint": "pin the dtype to f32 or set allow_f64 on the AuditSpec"},
+    {"id": "FT102",
+     "title": "jaxpr audit: host callback inside a scan/while body",
+     "hint": "hoist the callback out of the fused loop"},
+    {"id": "FT103",
+     "title": "jaxpr audit: float upcast on a grad-declared path",
+     "hint": "make the accumulation dtype explicit at the cast site"},
+    {"id": "FT104",
+     "title": "jaxpr audit: lowering-key count exceeds the declared "
+              "contract (recompile class)",
+     "hint": "align caller arg dtypes/weak-types or mark variant args "
+             "static"},
+    {"id": "FT105",
+     "title": "collective audit: new/removed/changed collective vs "
+              "ci/collective_baseline.json (or missing baseline)",
+     "hint": "review, then --write-collective-baseline"},
+    {"id": "FT106",
+     "title": "collective audit: bytes estimate drifted beyond "
+              "tolerance",
+     "hint": "review the sharding change, then "
+             "--write-collective-baseline"},
+    {"id": "FT200",
+     "title": "protocol audit: ci/protocol_graph.json snapshot missing "
+              "or unreadable",
+     "hint": "--write-protocol-graph"},
+    {"id": "FT201",
+     "title": "protocol audit: message type sent but no handler "
+              "registered",
+     "hint": "register the peer-side handler or delete the send path"},
+    {"id": "FT202",
+     "title": "protocol audit: handler registered for a type nothing "
+              "sends",
+     "hint": "add the sender or remove the dead registration"},
+    {"id": "FT203",
+     "title": "protocol audit: handler requires a payload key no "
+              "sender writes",
+     "hint": "add the key at every send site or read it optionally"},
+    {"id": "FT204",
+     "title": "protocol audit: sender->handler graph drifted from the "
+              "snapshot",
+     "hint": "review the protocol change, then --write-protocol-graph"},
+)
+
+#: every rule id that must have a pos/neg corpus pair (meta-tested)
+CORPUS_RULE_IDS = tuple(sorted(
+    [cls.id for cls in _RULES] + ["FT012", "FT201", "FT202", "FT203"]))
 
 
 def all_rules() -> List[Rule]:
@@ -30,6 +108,9 @@ def all_rules() -> List[Rule]:
 
 
 def rule_table() -> List[dict]:
-    """id/title/hint rows for --list-rules and the README table."""
-    return [{"id": cls.id, "title": cls.title, "hint": cls.hint}
+    """id/title/hint rows for --list-rules and the README table — the
+    full surface: AST rules, engine passes, jaxpr audit, protocol."""
+    rows = [{"id": cls.id, "title": cls.title, "hint": cls.hint}
             for cls in _RULES]
+    rows.extend(dict(r) for r in _EXTRA_RULE_ROWS)
+    return sorted(rows, key=lambda r: r["id"])

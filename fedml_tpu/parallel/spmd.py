@@ -863,3 +863,34 @@ def _audit_block_multiround() -> AuditSpec:
 
     return AuditSpec(fn=fn, sweep=[window(0, 2), window(2, 2)],
                      max_lowerings=1, grad_path=True)
+
+
+@hot_entry_point("spmd.sharded_eval")
+def _audit_sharded_eval() -> AuditSpec:
+    """The shard_map'd eval path (make_sharded_eval): per-device stat
+    sums meeting in one psum over 'clients'. Registered so the
+    collective-signature audit (FT105/FT106) pins the psum set of the
+    sharded eval lowering — the mesh work inherits drift detection on
+    its simplest collective program. The eval batch (24) divides every
+    CI device count (1 and 8), so one lowering serves both."""
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh({"clients": n_dev})
+    ds = make_blob_federated(client_num=4, n_samples=240, seed=0)
+    module = LogisticRegression(num_classes=ds.class_num)
+    xt, yt = ds.test_data_global
+    n = (24 // n_dev) * n_dev or n_dev  # largest multiple of n_dev <= 24
+    xt, yt = jnp.asarray(xt[:n]), jnp.asarray(yt[:n])
+    mask = jnp.ones(len(xt), jnp.float32)
+    variables = module.init(jax.random.key(0), xt[:1], train=False)
+    fn = make_sharded_eval(module, "classification", mesh)
+    # sweep point 2 mirrors the actor path: wire-decoded NUMPY arrays
+    # (uncommitted) — a different caller that must share the jnp-typed
+    # point's lowering key, like the cross-silo warmup contract
+    np_args = (variables, np.asarray(xt), np.asarray(yt),
+               np.ones(len(xt), np.float32))
+    return AuditSpec(fn=fn,
+                     sweep=[(variables, xt, yt, mask), np_args],
+                     max_lowerings=1, grad_path=False)

@@ -1,0 +1,38 @@
+"""FT010 negative: the same two-root shape, but every access to the
+shared flags holds one common lock (plus a single-root counter, which
+is never a finding)."""
+import threading
+import time
+
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._busy = False
+        self._last_seen = 0.0
+        self._handled = 0  # receive-root-only: no cross-thread access
+        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher.start()
+
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(1, self.handle_sync)
+
+    def handle_sync(self, msg):
+        with self._lock:
+            self._busy = True
+            self._last_seen = time.monotonic()
+        self._handled += 1
+        with self._lock:
+            self._busy = False
+
+    def _watch(self):
+        while True:
+            with self._lock:
+                idle = time.monotonic() - self._last_seen
+                busy = self._busy
+            if not busy and idle > 30.0:
+                return idle
+            time.sleep(1.0)

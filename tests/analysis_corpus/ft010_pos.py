@@ -1,0 +1,32 @@
+"""FT010 positive: receive-loop handlers write flags the heartbeat
+thread reads — no common lock (the silo ``_busy``/``_last_s2c`` class
+of race, pre-fix)."""
+import threading
+import time
+
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._busy = False
+        self._last_seen = 0.0
+        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher.start()
+
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(1, self.handle_sync)
+
+    def handle_sync(self, msg):
+        self._busy = True          # unguarded cross-thread write
+        self._last_seen = time.monotonic()  # ditto
+        self._busy = False
+
+    def _watch(self):
+        while True:
+            idle = time.monotonic() - self._last_seen
+            if not self._busy and idle > 30.0:
+                return idle
+            time.sleep(1.0)

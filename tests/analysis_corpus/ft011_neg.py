@@ -1,0 +1,26 @@
+"""FT011 negative: both methods take the two locks in ONE global
+order (and a third method takes only the inner lock — never a pair)."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self.value = 0
+
+    def forward(self):
+        with self._state_lock:
+            with self._io_lock:
+                self.value += 1
+                return self.value
+
+    def backward(self):
+        with self._state_lock:
+            with self._io_lock:
+                self.value -= 1
+                return self.value
+
+    def flush(self):
+        with self._io_lock:
+            return self.value

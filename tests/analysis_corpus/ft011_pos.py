@@ -1,0 +1,22 @@
+"""FT011 positive: two locks acquired in opposite orders by two
+methods — the AB/BA deadlock no single-threaded test ever hits."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self.value = 0
+
+    def forward(self):
+        with self._state_lock:
+            with self._io_lock:
+                self.value += 1
+                return self.value
+
+    def backward(self):
+        with self._io_lock:
+            with self._state_lock:
+                self.value -= 1
+                return self.value

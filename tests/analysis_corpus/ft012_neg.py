@@ -1,0 +1,8 @@
+"""FT012 negative: the pragma still suppresses a live finding (a real
+global-RNG draw), so it is consumed, not stale."""
+import numpy as np
+
+
+def reseed_for_parity(seed):
+    # ft: allow[FT001] reference bit-parity, single-threaded bootstrap
+    np.random.seed(seed)

@@ -1,0 +1,8 @@
+"""FT012 positive (under --strict-pragmas): a pragma whose flagged
+code was fixed — the suppression outlived the finding."""
+
+
+def sample_cohort(rng, population, k):
+    # ft: allow[FT001] legacy suppression — the global draw below was
+    # replaced by the local-generator call, so this pragma is stale
+    return rng.choice(population, size=k, replace=False)

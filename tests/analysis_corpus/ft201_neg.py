@@ -1,0 +1,28 @@
+"""FT201 negative: the sent type has a registered handler whose reads
+match the sender's payload."""
+from fedml_tpu.comm.message import Message
+
+MSG_TYPE_S2C_PING = 41
+MSG_ARG_KEY_NONCE = "nonce"
+
+
+class Server:
+    def send_message(self, msg):
+        """Stub of the comm-layer send (AST-only corpus)."""
+
+    def ping(self, worker):
+        msg = Message(MSG_TYPE_S2C_PING, 0, worker)
+        msg.add(MSG_ARG_KEY_NONCE, 7)
+        self.send_message(msg)
+
+
+class Client:
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(MSG_TYPE_S2C_PING,
+                                              self.handle_ping)
+
+    def handle_ping(self, msg):
+        return msg.get(MSG_ARG_KEY_NONCE)

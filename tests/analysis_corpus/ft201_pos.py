@@ -1,0 +1,17 @@
+"""FT201 positive: a message type is sent but no handler is ever
+registered for it — the S2C_JOIN_BACKPRESSURE-without-a-silo-handler
+class (AST-only corpus; imports are never executed)."""
+from fedml_tpu.comm.message import Message
+
+MSG_TYPE_S2C_PING = 41
+MSG_ARG_KEY_NONCE = "nonce"
+
+
+class Server:
+    def send_message(self, msg):
+        """Stub of the comm-layer send (AST-only corpus)."""
+
+    def ping(self, worker):
+        msg = Message(MSG_TYPE_S2C_PING, 0, worker)
+        msg.add(MSG_ARG_KEY_NONCE, 7)
+        self.send_message(msg)

@@ -1,0 +1,27 @@
+"""FT202 negative: the registered type has a live sender writing the
+key the handler reads."""
+from fedml_tpu.comm.message import Message
+
+MSG_TYPE_C2S_STATS = 42
+
+
+class Worker:
+    def send_message(self, msg):
+        """Stub of the comm-layer send (AST-only corpus)."""
+
+    def report(self, loss_sum):
+        msg = Message(MSG_TYPE_C2S_STATS, 1, 0)
+        msg.add("loss_sum", loss_sum)
+        self.send_message(msg)
+
+
+class Server:
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(MSG_TYPE_C2S_STATS,
+                                              self.handle_stats)
+
+    def handle_stats(self, msg):
+        return msg.get("loss_sum")

@@ -1,0 +1,16 @@
+"""FT202 positive: a handler is registered for a type nothing ever
+sends — dead protocol surface (usually a renamed constant)."""
+
+MSG_TYPE_C2S_STATS = 42
+
+
+class Server:
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(MSG_TYPE_C2S_STATS,
+                                              self.handle_stats)
+
+    def handle_stats(self, msg):
+        return msg.get("loss_sum")

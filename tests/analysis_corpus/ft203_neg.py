@@ -1,0 +1,31 @@
+"""FT203 negative: every required key is written by the sender; the
+genuinely optional key is read with a defaulted dict-get."""
+from fedml_tpu.comm.message import Message
+
+MSG_TYPE_C2S_REPORT = 43
+
+
+class Worker:
+    def send_message(self, msg):
+        """Stub of the comm-layer send (AST-only corpus)."""
+
+    def report(self, loss_sum, n):
+        msg = Message(MSG_TYPE_C2S_REPORT, 1, 0)
+        msg.add("loss_sum", loss_sum)
+        msg.add("sample_count", n)
+        self.send_message(msg)
+
+
+class Server:
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(MSG_TYPE_C2S_REPORT,
+                                              self.handle_report)
+
+    def handle_report(self, msg):
+        mean = msg.get("loss_sum") / msg.get("sample_count")
+        # optional: senders from older builds may omit it
+        tag = msg.get_params().get("build_tag", None)
+        return mean, tag

@@ -1,0 +1,29 @@
+"""FT203 positive: the handler REQUIRES a payload key no sender of
+that type writes — msg.get raises KeyError on the receive thread and
+the round never closes."""
+from fedml_tpu.comm.message import Message
+
+MSG_TYPE_C2S_REPORT = 43
+
+
+class Worker:
+    def send_message(self, msg):
+        """Stub of the comm-layer send (AST-only corpus)."""
+
+    def report(self, loss_sum):
+        msg = Message(MSG_TYPE_C2S_REPORT, 1, 0)
+        msg.add("loss_sum", loss_sum)
+        self.send_message(msg)
+
+
+class Server:
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(MSG_TYPE_C2S_REPORT,
+                                              self.handle_report)
+
+    def handle_report(self, msg):
+        # "sample_count" is never added by Worker.report — KeyError
+        return msg.get("loss_sum") / msg.get("sample_count")

@@ -14,37 +14,57 @@ import pytest
 
 from fedml_tpu.analysis.baseline import (apply_baseline, load_baseline,
                                          save_baseline)
+from fedml_tpu.analysis.driver import analyze_files
 from fedml_tpu.analysis.lint import (FileContext, is_corpus_path,
                                      is_test_path, iter_python_files,
-                                     lint_paths)
+                                     lint_paths, unused_pragmas)
+from fedml_tpu.analysis.rules import CORPUS_RULE_IDS
 
 REPO = Path(__file__).resolve().parent.parent
 CORPUS = REPO / "tests" / "analysis_corpus"
-RULES = ("FT001", "FT002", "FT003", "FT004", "FT005", "FT006", "FT007",
-         "FT008", "FT009")
+RULES = CORPUS_RULE_IDS
 
 
 def _lint_file(path, **kw):
     return lint_paths([path], root=REPO, **kw)
 
 
+def _analyze_file(path):
+    # the full per-file stream: lint + protocol conformance + strict
+    # pragma staleness — what the corpus contract is defined against
+    return analyze_files([path], root=REPO, strict_pragmas=True)
+
+
 class TestRuleCorpus:
+    """The corpus-completeness meta-test: EVERY registered rule id must
+    ship a pos/neg pair, the pos must fire exactly that rule, and the
+    neg must be clean — a future rule cannot land untested."""
+
+    def test_every_registered_rule_has_a_corpus_pair(self):
+        for rule in CORPUS_RULE_IDS:
+            pos = CORPUS / f"{rule.lower()}_pos.py"
+            neg = CORPUS / f"{rule.lower()}_neg.py"
+            assert pos.is_file(), f"{rule}: missing {pos.name}"
+            assert neg.is_file(), f"{rule}: missing {neg.name}"
+
     @pytest.mark.parametrize("rule", RULES)
     def test_positive_fires_and_only_its_rule(self, rule):
-        findings = _lint_file(CORPUS / f"{rule.lower()}_pos.py")
+        findings = _analyze_file(CORPUS / f"{rule.lower()}_pos.py")
         assert findings, f"{rule} positive corpus produced no findings"
-        assert {f.rule for f in findings} == {rule}
+        assert {f.rule for f in findings} == {rule}, \
+            [f.format_text() for f in findings]
 
     @pytest.mark.parametrize("rule", RULES)
     def test_negative_is_clean(self, rule):
-        findings = _lint_file(CORPUS / f"{rule.lower()}_neg.py")
+        findings = _analyze_file(CORPUS / f"{rule.lower()}_neg.py")
         assert findings == [], [f.format_text() for f in findings]
 
     def test_corpus_covers_every_rule(self):
-        # the acceptance criterion: every rule FT001-FT006 fires at least
+        # the acceptance criterion: every registered rule fires at least
         # once over the whole corpus, and the corpus exits non-zero via
         # the CLI (TestCli covers the exit code)
-        findings = lint_paths(sorted(CORPUS.glob("ft*_pos.py")), root=REPO)
+        findings = analyze_files(sorted(CORPUS.glob("ft*_pos.py")),
+                                 root=REPO, strict_pragmas=True)
         assert {f.rule for f in findings} == set(RULES)
 
 
@@ -180,7 +200,8 @@ class TestCli:
 
     def test_corpus_exits_nonzero_with_every_rule(self):
         pos = sorted(str(p) for p in CORPUS.glob("ft*_pos.py"))
-        r = self._run(*pos, "--format", "json", "--no-audit")
+        r = self._run(*pos, "--format", "json", "--no-audit",
+                      "--strict-pragmas", "--no-baseline")
         assert r.returncode == 1, r.stderr
         report = json.loads(r.stdout)
         assert {f["rule"] for f in report["findings"]} == set(RULES)
@@ -260,3 +281,266 @@ class TestCli:
         mod.write_text("x = 1\n")
         r = self._run(str(mod), "--no-audit", "--baseline", str(bad))
         assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+
+
+class TestConcurrencyRuleEdges:
+    def _check(self, tmp_path, src, rules=None):
+        from fedml_tpu.analysis.lint import build_contexts, lint_contexts
+        from fedml_tpu.analysis.rules.concurrency import (
+            LockOrderRule, SharedStateLockRule)
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        ctxs, _ = build_contexts([p], root=tmp_path)
+        return lint_contexts(ctxs, rules=rules or [SharedStateLockRule(),
+                                                   LockOrderRule()])
+
+    def test_thread_target_nested_in_init_is_a_root(self, tmp_path):
+        # the nested-def-in-__init__ thread runs AFTER start(): its
+        # writes are not construction-time and must be analyzed
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        def runner():\n"
+            "            self.counter += 1\n"
+            "        threading.Thread(target=runner).start()\n"
+            "    def register_message_receive_handler(self, t, h): ...\n"
+            "    def run(self):\n"
+            "        self.register_message_receive_handler(1, self.on_m)\n"
+            "    def on_m(self, msg):\n"
+            "        self.counter = 0\n")
+        findings = self._check(tmp_path, src)
+        assert {f.rule for f in findings} == {"FT010"}
+        assert any("counter" in f.message for f in findings)
+
+    def test_same_named_locks_in_different_classes_no_inversion(
+            self, tmp_path):
+        # per-instance locks of UNRELATED classes can never deadlock —
+        # a module-wide pair table would report a bogus AB/BA here
+        src = (
+            "import threading\n"
+            "class A:\n"
+            "    def m(self):\n"
+            "        with self.alpha_lock:\n"
+            "            with self.beta_lock:\n"
+            "                return 1\n"
+            "class B:\n"
+            "    def m(self):\n"
+            "        with self.beta_lock:\n"
+            "            with self.alpha_lock:\n"
+            "                return 2\n")
+        assert self._check(tmp_path, src) == []
+
+    def test_inversion_within_one_class_still_fires(self, tmp_path):
+        src = (
+            "class A:\n"
+            "    def fwd(self):\n"
+            "        with self.alpha_lock:\n"
+            "            with self.beta_lock:\n"
+            "                return 1\n"
+            "    def bwd(self):\n"
+            "        with self.beta_lock:\n"
+            "            with self.alpha_lock:\n"
+            "                return 2\n")
+        findings = self._check(tmp_path, src)
+        assert [f.rule for f in findings] == ["FT011"]
+
+
+class TestUnusedPragmas:
+    def _ctxs(self, tmp_path, src):
+        from fedml_tpu.analysis.lint import build_contexts, lint_contexts
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        ctxs, _ = build_contexts([p], root=tmp_path)
+        lint_contexts(ctxs)
+        return ctxs
+
+    def test_consumed_pragma_is_not_stale(self, tmp_path):
+        ctxs = self._ctxs(tmp_path,
+                          "import numpy as np\n"
+                          "np.random.seed(0)  # ft: allow[FT001] boot\n")
+        warnings, findings = unused_pragmas(ctxs, {"FT001"}, strict=True)
+        assert warnings == [] and findings == []
+
+    def test_stale_pragma_warns_and_strict_makes_finding(self, tmp_path):
+        ctxs = self._ctxs(tmp_path, "x = 1  # ft: allow[FT001] stale\n")
+        warnings, findings = unused_pragmas(ctxs, {"FT001"}, strict=False)
+        assert [w["rule"] for w in warnings] == ["FT001"]
+        assert findings == []
+        warnings, findings = unused_pragmas(ctxs, {"FT001"}, strict=True)
+        assert [f.rule for f in findings] == ["FT012"]
+
+    def test_inactive_rule_ids_are_not_judged(self, tmp_path):
+        # a pragma for a pass that did not run (FT2xx under
+        # --changed-only) is unexercised, not unused
+        ctxs = self._ctxs(tmp_path, "x = 1  # ft: allow[FT201] protocol\n")
+        warnings, findings = unused_pragmas(ctxs, {"FT001"}, strict=True)
+        assert warnings == [] and findings == []
+
+    def test_pragma_in_string_literal_is_ignored(self, tmp_path):
+        ctxs = self._ctxs(
+            tmp_path,
+            'DOC = "suppress with # ft: allow[FT001] why"\n')
+        warnings, findings = unused_pragmas(ctxs, {"FT001"}, strict=True)
+        assert warnings == [] and findings == []
+
+    def test_ft012_is_itself_pragmable(self, tmp_path):
+        # a deliberately kept stale suppression: allow[FT012] on the
+        # same pragma line downgrades the strict finding to the warning
+        ctxs = self._ctxs(
+            tmp_path,
+            "x = 1  # ft: allow[FT001,FT012] transitional suppression\n")
+        warnings, findings = unused_pragmas(ctxs, {"FT001"}, strict=True)
+        assert [w["rule"] for w in warnings] == ["FT001"]
+        assert findings == []
+
+
+class TestChangedOnly:
+    """In-process (a tmp dir named fedml_tpu/ would shadow the real
+    package under ``python -m``): cwd pinned to a throwaway git repo so
+    ``_repo_root``/``git diff`` both resolve there."""
+
+    def _git(self, cwd, *args):
+        r = subprocess.run(["git", "-c", "user.email=t@t",
+                            "-c", "user.name=t", *args],
+                           cwd=cwd, capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        return r
+
+    def _seed_repo(self, tmp_path, files):
+        pkg = tmp_path / "fedml_tpu"
+        pkg.mkdir()
+        for name, src in files.items():
+            (pkg / name).write_text(src)
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        return pkg
+
+    def _run(self, monkeypatch, capsys, tmp_path, *args):
+        from fedml_tpu.analysis.__main__ import main
+        monkeypatch.chdir(tmp_path)
+        rc = main(list(args))
+        return rc, capsys.readouterr().out
+
+    def test_changed_only_lints_only_touched_files(
+            self, tmp_path, monkeypatch, capsys):
+        pkg = self._seed_repo(tmp_path, {
+            "touched.py": "x = 1\n",
+            # a PRE-EXISTING violation in an untouched file: not seen
+            "untouched.py": "import numpy as np\nnp.random.seed(0)\n"})
+        (pkg / "touched.py").write_text(
+            "import numpy as np\nnp.random.shuffle([1])\n")
+        rc, out = self._run(monkeypatch, capsys, tmp_path,
+                            "--changed-only", "--format", "json")
+        assert rc == 1, out
+        report = json.loads(out)
+        assert {f["path"] for f in report["findings"]} == \
+            {"fedml_tpu/touched.py"}, report["findings"]
+        # the full walk still sees both files' findings
+        rc, out = self._run(monkeypatch, capsys, tmp_path,
+                            "--no-audit", "--no-protocol")
+        assert rc == 1 and "untouched.py" in out
+
+    def test_changed_only_clean_when_nothing_touched(
+            self, tmp_path, monkeypatch, capsys):
+        self._seed_repo(tmp_path, {"mod.py": "x = 1\n"})
+        rc, out = self._run(monkeypatch, capsys, tmp_path,
+                            "--changed-only")
+        assert rc == 0, out
+
+    def test_changed_only_sees_untracked_files(
+            self, tmp_path, monkeypatch, capsys):
+        pkg = self._seed_repo(tmp_path, {"mod.py": "x = 1\n"})
+        (pkg / "fresh.py").write_text(
+            "import numpy as np\nnp.random.seed(0)\n")
+        rc, out = self._run(monkeypatch, capsys, tmp_path,
+                            "--changed-only", "--format", "json")
+        assert rc == 1
+        report = json.loads(out)
+        assert {f["path"] for f in report["findings"]} == \
+            {"fedml_tpu/fresh.py"}
+
+
+class TestPruneStale:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.analysis", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+
+    def test_prune_rewrites_minus_dead_entries_keeping_notes(
+            self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import numpy as np\n"
+                       "np.random.seed(0)\n"
+                       "np.random.seed(1)\n")
+        bl = tmp_path / "bl.json"
+        r = self._run(str(mod), "--no-audit", "--no-protocol",
+                      "--write-baseline", str(bl))
+        assert r.returncode == 0, r.stdout + r.stderr
+        entries = json.loads(bl.read_text())["entries"]
+        assert len(entries) == 2
+        for e in entries:
+            e["note"] = f"keep: {e['snippet']}"
+        bl.write_text(json.dumps({"version": 1, "entries": entries}))
+        # fix ONE of the two findings -> its entry goes stale
+        mod.write_text("import numpy as np\n"
+                       "np.random.seed(0)\n"
+                       "rng = np.random.RandomState(1)\n")
+        r = self._run(str(mod), "--no-audit", "--no-protocol",
+                      "--baseline", str(bl), "--prune-stale")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "pruned 1 stale entry" in r.stdout
+        kept = json.loads(bl.read_text())["entries"]
+        assert len(kept) == 1
+        assert kept[0]["note"] == f"keep: {kept[0]['snippet']}"
+        # and the pruned baseline still suppresses the live finding
+        r = self._run(str(mod), "--no-audit", "--no-protocol",
+                      "--baseline", str(bl))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_prune_without_baseline_is_an_error(self, tmp_path):
+        mod = tmp_path / "ok.py"
+        mod.write_text("x = 1\n")
+        r = self._run(str(mod), "--no-audit", "--no-protocol",
+                      "--no-baseline", "--prune-stale")
+        assert r.returncode == 2
+
+
+class TestGithubFormat:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.analysis", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+
+    def test_error_annotations_from_findings(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import numpy as np\nnp.random.seed(0)\n")
+        r = self._run(str(mod), "--no-audit", "--no-protocol",
+                      "--no-baseline", "--format", "github")
+        assert r.returncode == 1
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("::error ")]
+        assert len(line) == 1
+        assert "file=" in line[0] and "line=2" in line[0] \
+            and "title=FT001" in line[0]
+
+    def test_unused_pragma_warning_annotation(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("x = 1  # ft: allow[FT001] stale\n")
+        r = self._run(str(mod), "--no-audit", "--no-protocol",
+                      "--no-baseline", "--format", "github")
+        assert r.returncode == 0  # warning, not finding, without strict
+        assert any(ln.startswith("::warning ")
+                   and "unused-pragma" in ln
+                   for ln in r.stdout.splitlines())
+
+    def test_strict_pragmas_cli_promotes_to_finding(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("x = 1  # ft: allow[FT001] stale\n")
+        r = self._run(str(mod), "--no-audit", "--no-protocol",
+                      "--no-baseline", "--strict-pragmas",
+                      "--format", "json")
+        assert r.returncode == 1
+        report = json.loads(r.stdout)
+        assert {f["rule"] for f in report["findings"]} == {"FT012"}

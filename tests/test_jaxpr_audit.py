@@ -1,19 +1,27 @@
 """fedml_tpu.analysis layer 2 — jaxpr audit: planted violations, the
-shipped entry-point registry, and the lowering-key sweep contract."""
+shipped entry-point registry, the lowering-key sweep contract, and the
+collective-signature baseline (FT105/FT106)."""
+
+import json
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from fedml_tpu.analysis.jaxpr_audit import (audit_spec, run_audit,
-                                            signature_key)
+from fedml_tpu.analysis.jaxpr_audit import (audit_spec,
+                                            check_collective_baseline,
+                                            run_audit, signature_key,
+                                            write_collective_baseline)
 from fedml_tpu.analysis.registry import (AuditSpec, _REGISTRY,
                                          hot_entry_point,
                                          load_entry_points)
 
+REPO = Path(__file__).resolve().parent.parent
 REQUIRED_ENTRIES = {"fedavg.round_fn", "fedopt.round_fn",
-                    "spmd.block_multiround", "ops.flash_attention_fwd_bwd"}
+                    "spmd.block_multiround", "spmd.sharded_eval",
+                    "ops.flash_attention_fwd_bwd"}
 
 
 def _host_sin(x):
@@ -143,6 +151,7 @@ class TestShippedRegistry:
         ("fedavg.round_fn", 3),
         ("fedopt.round_fn", 3),
         ("spmd.block_multiround", 2),
+        ("spmd.sharded_eval", 2),
         ("ops.flash_attention_fwd_bwd", 2),
     ])
     def test_shape_sweep_is_one_lowering_key(self, entry, sweep_len):
@@ -155,3 +164,139 @@ class TestShippedRegistry:
         assert report["sweep_len"] == sweep_len
         assert report["n_lowering_keys"] == 1
         assert report["n_lowering_keys"] <= report["max_lowerings"]
+
+
+def _mesh_psum_spec(scale=1.0):
+    """A tiny shard_map'd program with one real psum — the planted
+    substrate for the collective-signature tests."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("clients",))
+
+    def body(x):
+        return jax.lax.psum(x * scale, ("clients",))
+
+    n = 8 * len(jax.devices())
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("clients"),
+                               out_specs=P()))
+    return AuditSpec(fn=fn, sweep=[(jnp.ones(n, jnp.float32),)])
+
+
+class TestCollectiveSignature:
+    def test_psum_is_recorded_with_axes_and_bytes(self):
+        findings, report = audit_spec("planted.psum", _mesh_psum_spec())
+        assert findings == []
+        colls = report["collectives"]
+        assert len(colls) == 1
+        assert colls[0]["op"] == "psum"
+        assert colls[0]["axes"] == ["clients"]
+        assert colls[0]["count"] == 1
+        assert colls[0]["bytes"] > 0
+
+    def test_collective_free_entry_has_empty_signature(self):
+        spec = AuditSpec(fn=lambda x: x * 2,
+                         sweep=[(jnp.ones(4, jnp.float32),)])
+        _, report = audit_spec("planted.none", spec)
+        assert report["collectives"] == []
+
+    def test_missing_baseline_file_is_loud_ft105(self, tmp_path):
+        _, report = audit_spec("planted.psum", _mesh_psum_spec())
+        findings, stale = check_collective_baseline(
+            [report], tmp_path / "absent.json")
+        assert [f.rule for f in findings] == ["FT105"]
+        assert "MISSING" in findings[0].message
+
+    def test_round_trip_matches_then_rogue_collective_is_ft105(
+            self, tmp_path):
+        _, clean = audit_spec("planted.entry", _mesh_psum_spec())
+        bl = tmp_path / "coll.json"
+        write_collective_baseline(bl, [clean])
+        findings, stale = check_collective_baseline([clean], bl)
+        assert findings == [] and stale == []
+        # the rogue: the same entry grows an all_gather the baseline
+        # never sanctioned
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()), ("clients",))
+
+        def rogue_body(x):
+            g = jax.lax.all_gather(x, "clients")
+            return jax.lax.psum(x, ("clients",)) + g.sum()
+
+        n = 8 * len(jax.devices())
+        rogue = AuditSpec(fn=jax.jit(jax.shard_map(
+            rogue_body, mesh=mesh, in_specs=P("clients"), out_specs=P())),
+            sweep=[(jnp.ones(n, jnp.float32),)])
+        _, rep = audit_spec("planted.entry", rogue)
+        findings, _ = check_collective_baseline([rep], bl)
+        assert [f.rule for f in findings] == ["FT105"]
+        assert "all_gather" in findings[0].message
+        assert "NEW collective" in findings[0].message
+
+    def test_bytes_drift_within_tolerance_is_clean(self, tmp_path):
+        # the tolerance must actually tolerate: same op/axes/count with
+        # a small bytes delta (fingerprint mismatch) is NOT a finding
+        _, clean = audit_spec("planted.entry", _mesh_psum_spec())
+        bl = tmp_path / "coll.json"
+        tweaked = json.loads(json.dumps(clean))  # deep copy
+        tweaked["collectives"][0]["bytes"] = int(
+            tweaked["collectives"][0]["bytes"] * 1.2)
+        write_collective_baseline(bl, [tweaked])
+        findings, stale = check_collective_baseline([clean], bl)
+        assert findings == [], [f.format_text() for f in findings]
+        assert stale == []
+
+    def test_bytes_drift_beyond_tolerance_is_ft106(self, tmp_path):
+        _, clean = audit_spec("planted.entry", _mesh_psum_spec())
+        bl = tmp_path / "coll.json"
+        write_collective_baseline(bl, [clean])
+        # same op/axes/count, 4x the bytes (psum over a 4x-wider array)
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()), ("clients",))
+
+        def body(x):
+            return jax.lax.psum(
+                jnp.tile(x, 4).reshape(4, -1), ("clients",))
+
+        n = 8 * len(jax.devices())
+        fat = AuditSpec(fn=jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P("clients"), out_specs=P())),
+            sweep=[(jnp.ones(n, jnp.float32),)])
+        _, rep = audit_spec("planted.entry", fat)
+        findings, _ = check_collective_baseline([rep], bl)
+        assert [f.rule for f in findings] == ["FT106"]
+        assert "bytes estimate drifted" in findings[0].message
+
+    def test_uncovered_entry_is_ft105_and_dead_entry_is_stale(
+            self, tmp_path):
+        _, rep = audit_spec("planted.entry", _mesh_psum_spec())
+        bl = tmp_path / "coll.json"
+        other = dict(rep, entry="planted.retired")
+        write_collective_baseline(bl, [other])
+        findings, stale = check_collective_baseline([rep], bl)
+        assert [f.rule for f in findings] == ["FT105"]
+        assert "no collective-baseline entry" in findings[0].message
+        assert stale == ["planted.retired"]
+
+
+class TestShippedCollectiveBaseline:
+    def test_covers_every_registered_entry_and_matches(self):
+        # the acceptance bar: the checked-in baseline covers EVERY
+        # registered hot entry point and the current tree matches it
+        findings, reports = run_audit()
+        assert findings == [], [f.format_text() for f in findings]
+        coll_findings, stale = check_collective_baseline(
+            reports, REPO / "ci" / "collective_baseline.json")
+        assert coll_findings == [], [f.format_text()
+                                     for f in coll_findings]
+        assert stale == []
+        baseline = json.loads(
+            (REPO / "ci" / "collective_baseline.json").read_text())
+        assert set(baseline["entries"]) == {r["entry"] for r in reports}
+
+    def test_spmd_entries_pin_their_psums(self):
+        baseline = json.loads(
+            (REPO / "ci" / "collective_baseline.json").read_text())
+        block = baseline["entries"]["spmd.block_multiround"]
+        assert any(c["op"] == "psum" and c["axes"] == ["clients"]
+                   for c in block["collectives"])
+        ev = baseline["entries"]["spmd.sharded_eval"]
+        assert any(c["op"] == "psum" for c in ev["collectives"])

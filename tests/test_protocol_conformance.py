@@ -1,0 +1,240 @@
+"""fedml_tpu.analysis protocol pass (FT2xx) — extractor fidelity on the
+real tree, planted-defect conformance, and snapshot drift semantics."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from fedml_tpu.analysis.lint import build_contexts
+from fedml_tpu.analysis.protocol import (conformance_findings,
+                                         extract_protocol,
+                                         normalize_graph,
+                                         snapshot_findings)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def tree_graph():
+    ctxs, errs = build_contexts([REPO / "fedml_tpu"], root=REPO)
+    assert errs == []
+    return extract_protocol(ctxs), ctxs
+
+
+def _graph_of(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    ctxs, _ = build_contexts([p], root=tmp_path)
+    return extract_protocol(ctxs), ctxs
+
+
+class TestExtractorOnTheRealTree:
+    def test_covers_every_declared_msg_type(self, tree_graph):
+        graph, _ = tree_graph
+        names = {(t["module"], t["name"]) for t in graph["types"]}
+        # the acceptance bar: all 12+ message types of the cross-silo
+        # protocol plus the base-framework schema, each with identity
+        # (module, name) so equal ints on different protocols stay apart
+        cs = "fedml_tpu.algorithms.fedavg_cross_silo"
+        bf = "fedml_tpu.algorithms.base_framework"
+        for mod, name in [
+                (cs, "MSG_TYPE_S2C_INIT_CONFIG"),
+                (cs, "MSG_TYPE_S2C_SYNC_MODEL"),
+                (cs, "MSG_TYPE_S2C_FINISH"),
+                (cs, "MSG_TYPE_C2S_SEND_MODEL"),
+                (cs, "MSG_TYPE_ROUND_TIMEOUT"),
+                (cs, "MSG_TYPE_C2S_HEARTBEAT"),
+                (cs, "MSG_TYPE_C2S_JOIN"),
+                (cs, "MSG_TYPE_S2C_JOIN_BACKPRESSURE"),
+                (bf, "MSG_TYPE_S2C_INIT"),
+                (bf, "MSG_TYPE_C2S_INFORMATION"),
+                (bf, "MSG_TYPE_S2C_SYNC"),
+                (bf, "MSG_TYPE_FINISH"),
+                (bf, "MSG_TYPE_NEIGHBOR_RESULT")]:
+            assert (mod, name) in names, f"missing {mod}.{name}"
+        assert len(names) >= 12
+
+    def test_every_type_has_sender_and_handler(self, tree_graph):
+        # the shipped protocol is fully wired: no sent-but-unhandled
+        # types, no dead registrations — the tree-level invariant FT201/
+        # FT202 freeze in place
+        graph, ctxs = tree_graph
+        for row in graph["types"]:
+            assert row["senders"], f"{row['name']}: no senders"
+            assert row["handlers"], f"{row['name']}: no handlers"
+        assert conformance_findings(graph, ctxs) == []
+
+    def test_parametric_broadcast_sites_are_attributed_to_callers(
+            self, tree_graph):
+        # the `_broadcast_model(MSG_TYPE_..., idxs)` shape: the type is
+        # chosen by the caller, the payload keys by the callee
+        graph, _ = tree_graph
+        by_name = {t["name"]: t for t in graph["types"]}
+        init = by_name["MSG_TYPE_S2C_INIT_CONFIG"]
+        assert any(s["where"].endswith("send_init_msg")
+                   for s in init["senders"])
+        assert {"model_params", "client_idx", "round_idx",
+                "bcast_seq"} <= set(init["senders"][0]["keys"])
+
+    def test_rebinding_the_message_variable_splits_key_sets(
+            self, tree_graph):
+        # handle_message_join builds BACKPRESSURE then SYNC_MODEL in one
+        # body via the same variable: keys must not bleed across
+        graph, _ = tree_graph
+        by_name = {t["name"]: t for t in graph["types"]}
+        bp = by_name["MSG_TYPE_S2C_JOIN_BACKPRESSURE"]
+        assert bp["senders"][0]["keys"] == ["retry_after_s"]
+
+    def test_reply_keys_cover_the_server_requirements(self, tree_graph):
+        graph, _ = tree_graph
+        by_name = {t["name"]: t for t in graph["types"]}
+        reply = by_name["MSG_TYPE_C2S_SEND_MODEL"]
+        handler = reply["handlers"][0]
+        sent = set(reply["senders"][0]["keys"])
+        assert set(handler["required"]) <= sent
+        assert "round_idx" in handler["optional"]  # defaulted dict-get
+
+
+SEND_ONLY = '''
+from fedml_tpu.comm.message import Message
+MSG_TYPE_PING = 77
+class S:
+    def send_message(self, m): ...
+    def ping(self):
+        m = Message(MSG_TYPE_PING, 0, 1)
+        self.send_message(m)
+'''
+
+WIRED = '''
+from fedml_tpu.comm.message import Message
+MSG_TYPE_PING = 77
+class S:
+    def send_message(self, m): ...
+    def ping(self):
+        m = Message(MSG_TYPE_PING, 0, 1)
+        m.add("payload", 1)
+        self.send_message(m)
+class C:
+    def register_message_receive_handler(self, t, h): ...
+    def run(self):
+        self.register_message_receive_handler(MSG_TYPE_PING,
+                                              self.on_ping)
+    def on_ping(self, msg):
+        return msg.get("payload")
+'''
+
+
+class TestPlantedDefects:
+    def test_unhandled_type_is_ft201(self, tmp_path):
+        graph, ctxs = _graph_of(tmp_path, SEND_ONLY)
+        assert [f.rule for f in conformance_findings(graph, ctxs)] == \
+            ["FT201"]
+
+    def test_wired_protocol_is_clean(self, tmp_path):
+        graph, ctxs = _graph_of(tmp_path, WIRED)
+        assert conformance_findings(graph, ctxs) == []
+
+    def test_key_mismatch_is_ft203(self, tmp_path):
+        src = WIRED.replace('msg.get("payload")', 'msg.get("missing")')
+        graph, ctxs = _graph_of(tmp_path, src)
+        fs = conformance_findings(graph, ctxs)
+        assert [f.rule for f in fs] == ["FT203"]
+        assert "'missing'" in fs[0].message
+
+    def test_dynamic_sender_quiets_key_checks(self, tmp_path):
+        src = WIRED.replace('m.add("payload", 1)',
+                            'm.add(key_var, 1)')
+        graph, ctxs = _graph_of(tmp_path, src)
+        assert conformance_findings(graph, ctxs) == []
+
+    def test_conditional_type_counts_both_branches(self, tmp_path):
+        src = '''
+from fedml_tpu.comm.message import Message
+MSG_TYPE_A = 1
+MSG_TYPE_B = 2
+class S:
+    def send_message(self, m): ...
+    def emit(self, done):
+        m = Message(MSG_TYPE_A if done else MSG_TYPE_B, 0, 1)
+        self.send_message(m)
+class C:
+    def register_message_receive_handler(self, t, h): ...
+    def run(self):
+        self.register_message_receive_handler(MSG_TYPE_A, self.on_a)
+        self.register_message_receive_handler(MSG_TYPE_B, self.on_b)
+    def on_a(self, msg): ...
+    def on_b(self, msg): ...
+'''
+        graph, ctxs = _graph_of(tmp_path, src)
+        assert conformance_findings(graph, ctxs) == []
+        assert all(len(t["senders"]) == 1 for t in graph["types"])
+
+    def test_pragma_suppresses_at_the_send_line(self, tmp_path):
+        src = SEND_ONLY.replace(
+            "m = Message(MSG_TYPE_PING, 0, 1)",
+            "m = Message(MSG_TYPE_PING, 0, 1)  "
+            "# ft: allow[FT201] one-way fire-and-forget probe")
+        graph, ctxs = _graph_of(tmp_path, src)
+        assert conformance_findings(graph, ctxs) == []
+
+
+class TestSnapshot:
+    def test_missing_snapshot_is_loud_ft200(self, tmp_path):
+        graph, _ = _graph_of(tmp_path, WIRED)
+        fs = snapshot_findings(graph, tmp_path / "absent.json")
+        assert [f.rule for f in fs] == ["FT200"]
+
+    def test_matching_snapshot_is_clean_and_drift_is_ft204(self, tmp_path):
+        graph, _ = _graph_of(tmp_path, WIRED)
+        snap = tmp_path / "snap.json"
+        snap.write_text(json.dumps(normalize_graph(graph)))
+        assert snapshot_findings(graph, snap) == []
+        drifted, _ = _graph_of(
+            tmp_path, WIRED + "\nMSG_TYPE_EXTRA = 99\n", name="mod2.py")
+        fs = snapshot_findings(drifted, snap)
+        assert [f.rule for f in fs] == ["FT204"]
+        assert "MSG_TYPE_EXTRA" in fs[0].message
+
+    def test_normalized_snapshot_is_line_free(self, tmp_path):
+        # an edit ABOVE the protocol code must not drift the snapshot
+        graph_a, _ = _graph_of(tmp_path, WIRED, name="a.py")
+        graph_b, _ = _graph_of(tmp_path, "# shifted\n\n" + WIRED,
+                               name="a.py")
+        assert normalize_graph(graph_a)["fingerprint"] == \
+            normalize_graph(graph_b)["fingerprint"]
+
+    def test_shipped_snapshot_matches_the_tree(self):
+        ctxs, _ = build_contexts([REPO / "fedml_tpu"], root=REPO)
+        graph = extract_protocol(ctxs)
+        fs = snapshot_findings(graph, REPO / "ci" / "protocol_graph.json")
+        assert fs == [], [f.format_text() for f in fs]
+
+    def test_runs_artifact_is_committed_and_covers_the_protocol(self):
+        artifact = json.loads(
+            (REPO / "runs" / "protocol_graph.json").read_text())
+        assert len(artifact["types"]) >= 12
+        for row in artifact["types"]:
+            assert row["senders"] and row["handlers"]
+
+
+class TestCliIntegration:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.analysis", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+
+    def test_deleted_snapshot_fails_loudly(self, tmp_path):
+        r = self._run("--no-audit", "--protocol-snapshot",
+                      str(tmp_path / "gone.json"), "--format", "json")
+        assert r.returncode == 1, r.stdout + r.stderr
+        report = json.loads(r.stdout)
+        assert {f["rule"] for f in report["findings"]} == {"FT200"}
+
+    def test_default_run_is_clean_and_emits_artifact(self):
+        r = self._run("--no-audit")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "protocol: " in r.stdout
+        assert "msg types" in r.stdout
