@@ -1,19 +1,24 @@
 #!/usr/bin/env bash
 # CI static lane: fedml_tpu.analysis over fedml_tpu/ and tests/ —
-# AST lint (FT001-FT011) + unused-pragma strictness (FT012) + the
-# whole-program protocol conformance pass (FT2xx, drift-checked against
-# ci/protocol_graph.json) + the jaxpr/collective audit of registered
-# hot entry points (FT10x, drift-checked against
-# ci/collective_baseline.json).
+# AST lint (FT001-FT015, incl. the determinism rules) + unused-pragma
+# strictness (FT012) + the whole-program protocol conformance pass
+# (FT2xx, drift-checked against ci/protocol_graph.json) + round-shape
+# conformance over the algorithms/ driver zoo (FT30x, drift-checked
+# against ci/round_engine_map.json; accept with --write-round-map) +
+# flag/env conformance (FT016, vs the README flag/env tables) + the
+# jaxpr/collective audit of registered hot entry points (FT10x,
+# drift-checked against ci/collective_baseline.json).
 # Exit non-zero on any finding that is not fixed, pragma'd
 # (# ft: allow[FTxxx]) or baselined in ci/analysis_baseline.json.
-# The JSON report lands in runs/static_analysis.json and the
-# sender->handler graph in runs/protocol_graph.json as CI artifacts.
+# CI artifacts: runs/static_analysis.json (report),
+# runs/protocol_graph.json (sender->handler graph),
+# runs/round_engine_map.json (the round-engine parity oracle).
 #
 # Fast pre-commit lane (sub-second, no jax import):
 #   ci/run_static.sh --changed-only            # lint files touched vs HEAD
 #   ci/run_static.sh --changed-only origin/main
-# (--changed-only implies --no-audit --no-protocol inside the CLI.)
+# (--changed-only implies --no-audit --no-protocol --no-roundshape
+# --no-flags inside the CLI — every whole-program pass skips.)
 #
 # Under GitHub Actions ($GITHUB_ACTIONS set) findings are emitted as
 # ::error file=...,line=...:: annotations.

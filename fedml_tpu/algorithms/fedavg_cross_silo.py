@@ -1179,9 +1179,9 @@ class FedAvgClientManager(ClientManager):
                 idle = time.monotonic() - self._last_s2c
                 busy = self._busy
                 backoff_until = self._join_backoff_until
-            if not busy \
-                    and idle > max(self.rejoin_idle_s, self.heartbeat_s) \
-                    and time.monotonic() >= backoff_until:
+            if (not busy
+                    and idle > max(self.rejoin_idle_s, self.heartbeat_s)  # ft: allow[FT015] eviction detection + JOIN backoff are wall-clock contracts: server silence and the advertised retry window are real seconds
+                    and time.monotonic() >= backoff_until):
                 self._send_join()
                 continue
             try:

@@ -67,6 +67,7 @@ class FedAvgRobustAPI(FedAvgAPI):
             rule = functools.partial(ROBUST_AGGREGATORS[defense_type],
                                      **rule_kwargs)
 
+            # ft: allow[FT303] deliberately UNWEIGHTED: a Byzantine client can lie about n_i, so rule defenses (median/trimmed/krum) treat clients uniformly
             def defended_mean(variables, stacked, weights, key):
                 return rule(stacked)
         else:

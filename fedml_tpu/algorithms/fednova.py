@@ -219,6 +219,7 @@ class FedNovaAPI:
                               cfg.client_num_per_round)
         n_pad = (self.dataset.cohort_padded_len(idxs, cfg.train.batch_size)
                  if cfg.pack == "cohort" else self._n_pad)
+        # ft: allow[FT302] KNOWN serial-pack divergence (see FedNovaConfig.prefetch_depth note): the normalized-gradient loop predates the shared _host_round_inputs path — the unification refactor absorbs it; keep this finding visible in the round map, not silently fixed here
         x, y, mask = self.dataset.pack_clients(idxs, cfg.train.batch_size,
                                                n_pad=n_pad)
         counts = self.dataset.client_weights(idxs)

@@ -113,6 +113,7 @@ class HierarchicalFedAvgAPI:
         n_pad = (self.dataset.cohort_padded_len(padded,
                                                 cfg.train.batch_size)
                  if cfg.pack == "cohort" else self._n_pad)
+        # ft: allow[FT302] two-tier structure: each GLOBAL round fans out into per-group sequential round loops whose membership depends on the group map — the flat single-cohort prefetch pipeline does not apply; unification will express this as a nested round engine
         x, y, mask = self.dataset.pack_clients(padded, cfg.train.batch_size,
                                                n_pad=n_pad)
         mask = mask * alive[:, None].astype(np.float32)

@@ -2,19 +2,25 @@
 
 Default paths are ``fedml_tpu/`` and ``tests/`` under the repo root
 (auto-detected: the cwd if it contains ``fedml_tpu/``, else the
-package's parent). Three passes share one parse of the tree:
+package's parent). Four passes share one parse of the tree:
 
-1. AST lint (FT001–FT011) + unused-pragma detection (FT012 under
+1. AST lint (FT001–FT015) + unused-pragma detection (FT012 under
    ``--strict-pragmas``; a warning otherwise);
 2. whole-program protocol conformance (FT2xx) with the sender→handler
    graph emitted to ``runs/protocol_graph.json`` and drift-checked
    against the ``ci/protocol_graph.json`` snapshot;
-3. jaxpr audit of registered hot entry points (FT10x) incl. the
+3. round-shape conformance over the ``algorithms/`` driver zoo (FT30x)
+   plus flag/env conformance (FT016): the round-engine map lands in
+   ``runs/round_engine_map.json`` and is drift-checked against the
+   ``ci/round_engine_map.json`` snapshot (accept with
+   ``--write-round-map``);
+4. jaxpr audit of registered hot entry points (FT10x) incl. the
    collective-signature check against ``ci/collective_baseline.json``.
 
 ``--changed-only [REF]`` lints only files touched vs a git ref
 (default HEAD) — the sub-second pre-commit lane; the whole-program
-protocol pass and the jaxpr audit are skipped there by construction.
+protocol/round-shape/flag passes and the jaxpr audit are skipped there
+by construction.
 
 Exit codes: 0 clean (all findings fixed, pragma'd or baselined), 1
 active findings, 2 internal error. Human output goes to stdout in
@@ -133,6 +139,11 @@ def main(argv: List[str] | None = None) -> int:
                         help="jaxpr audit only (no lint, no protocol)")
     parser.add_argument("--no-protocol", action="store_true",
                         help="skip the whole-program protocol pass")
+    parser.add_argument("--no-roundshape", action="store_true",
+                        help="skip the round-shape conformance pass "
+                             "(FT30x)")
+    parser.add_argument("--no-flags", action="store_true",
+                        help="skip the flag/env conformance pass (FT016)")
     parser.add_argument("--changed-only", nargs="?", const="HEAD",
                         default=None, metavar="GITREF",
                         help="lint only python files changed vs GITREF "
@@ -150,6 +161,13 @@ def main(argv: List[str] | None = None) -> int:
                         help="refresh ci/collective_baseline.json from "
                              "the current audit (accept a collective "
                              "change)")
+    parser.add_argument("--write-round-map", action="store_true",
+                        help="refresh ci/round_engine_map.json from the "
+                             "current tree (the deliberate way to accept "
+                             "a round-shape change)")
+    parser.add_argument("--round-map-snapshot", type=Path, default=None,
+                        help="round-shape snapshot path (default: "
+                             "ci/round_engine_map.json under the root)")
     parser.add_argument("--protocol-snapshot", type=Path, default=None,
                         help="protocol snapshot path (default: "
                              "ci/protocol_graph.json under the root)")
@@ -180,6 +198,8 @@ def main(argv: List[str] | None = None) -> int:
                          or root / "ci" / "protocol_graph.json")
     collective_baseline = (args.collective_baseline
                            or root / "ci" / "collective_baseline.json")
+    round_map_snapshot = (args.round_map_snapshot
+                          or root / "ci" / "round_engine_map.json")
 
     changed_only = args.changed_only is not None
     if changed_only:
@@ -194,6 +214,10 @@ def main(argv: List[str] | None = None) -> int:
     run_lint = not args.audit_only
     run_protocol = (not args.audit_only and not args.no_protocol
                     and not changed_only)
+    run_roundshape = (not args.audit_only and not args.no_roundshape
+                      and not changed_only)
+    run_flags = (not args.audit_only and not args.no_flags
+                 and not changed_only)
     run_audit_pass = not args.no_audit and not changed_only
 
     # the snapshot-refresh flags must apply or fail loudly — a silently
@@ -207,6 +231,11 @@ def main(argv: List[str] | None = None) -> int:
     if args.write_collective_baseline and not run_audit_pass:
         print("--write-collective-baseline needs the audit pass (drop "
               "--no-audit / --changed-only)", file=sys.stderr)
+        return 2
+    if args.write_round_map and (not run_roundshape or args.paths):
+        print("--write-round-map needs the default whole-tree "
+              "round-shape pass (no explicit paths, no --changed-only / "
+              "--no-roundshape / --audit-only)", file=sys.stderr)
         return 2
 
     findings = []
@@ -241,6 +270,39 @@ def main(argv: List[str] | None = None) -> int:
             proto_findings = conformance_findings(graph, lib_ctxs)
         findings.extend(proto_findings)
         active_rule_ids |= {"FT201", "FT202", "FT203"}
+
+    round_map = None
+    if run_roundshape:
+        from fedml_tpu.analysis import roundshape as rs
+        if full_walk:
+            # artifact + snapshot only make sense for the default walk
+            # (a partial map would always "drift")
+            rs_findings, round_map = rs.check_round_shapes(
+                ctxs, round_map_snapshot,
+                artifact_path=root / "runs" / "round_engine_map.json",
+                write_snapshot=args.write_round_map)
+            if args.write_round_map:
+                print(f"wrote round-engine map snapshot "
+                      f"({len(round_map['drivers'])} drivers) to "
+                      f"{round_map_snapshot}")
+        else:
+            analysis = rs.analyze(ctxs)
+            rs_findings = rs.conformance_findings(ctxs, analysis=analysis)
+            round_map = rs.extract_round_shapes(ctxs, analysis=analysis)
+        findings.extend(rs_findings)
+        active_rule_ids |= {"FT301", "FT302", "FT303", "FT304"}
+
+    flags_summary = None
+    if run_flags:
+        from fedml_tpu.analysis import flagsconf
+        from fedml_tpu.analysis.lint import is_test_path
+        lib_ctxs = [c for c in ctxs if not is_test_path(c.relpath)]
+        extraction = flagsconf.extract_flags(lib_ctxs)
+        findings.extend(flagsconf.conformance_findings(
+            lib_ctxs, root=root, extraction=extraction))
+        flags_summary = flagsconf.flags_report(lib_ctxs,
+                                               extraction=extraction)
+        active_rule_ids |= {"FT016"}
 
     audit_reports: List[dict] = []
     collective_stale: List[str] = []
@@ -322,6 +384,13 @@ def main(argv: List[str] | None = None) -> int:
                       "handlers": sum(len(t["handlers"])
                                       for t in graph["types"])}
                      if graph is not None else None),
+        "roundshape": ({"drivers": len(round_map["drivers"]),
+                        "kinds": {k: sum(1 for d in round_map["drivers"]
+                                         if d["kind"] == k)
+                                  for k in sorted({d["kind"] for d in
+                                                   round_map["drivers"]})}}
+                       if round_map is not None else None),
+        "flags": flags_summary,
         "counts": {"active": len(findings), "suppressed": len(suppressed),
                    "stale_baseline": len(stale),
                    "unused_pragmas": len(pragma_warnings)},
@@ -358,6 +427,19 @@ def main(argv: List[str] | None = None) -> int:
             print(f"protocol: {report['protocol']['types']} msg types, "
                   f"{report['protocol']['senders']} send site(s), "
                   f"{report['protocol']['handlers']} handler(s){dest}")
+        if round_map is not None:
+            dest = (" -> runs/round_engine_map.json" if full_walk
+                    else " (partial walk: no artifact/snapshot check)")
+            kinds = report["roundshape"]["kinds"]
+            print(f"round-shape: {report['roundshape']['drivers']} "
+                  f"driver(s) ("
+                  + ", ".join(f"{v} {k}" for k, v in kinds.items())
+                  + f"){dest}")
+        if flags_summary is not None:
+            print(f"flags: {flags_summary['flags_defined']} defined "
+                  f"({flags_summary['flags_shared']} shared), "
+                  f"{len(flags_summary['env_reads'])} documented-env "
+                  "knob(s)")
         for rep in audit_reports:
             coll = ", ".join(
                 f"{c['op']}{tuple(c['axes'])}x{c['count']}"

@@ -2,9 +2,10 @@
 
 The CLI (``__main__``) orchestrates the same pieces with snapshot and
 baseline I/O; this module is the snapshot-free core used by the corpus
-tests (and any embedder): parse once, lint, run the protocol
-conformance pass over the same contexts, then the unused-pragma pass —
-the full finding stream a file set produces on its own merits.
+tests (and any embedder): parse once, lint, run the protocol,
+round-shape, and flag/env conformance passes over the same contexts,
+then the unused-pragma pass — the full finding stream a file set
+produces on its own merits.
 """
 
 from __future__ import annotations
@@ -17,26 +18,39 @@ from fedml_tpu.analysis.lint import (build_contexts, lint_contexts,
                                      unused_pragmas)
 
 PROTOCOL_RULE_IDS = ("FT201", "FT202", "FT203")
+ROUNDSHAPE_RULE_IDS = ("FT301", "FT302", "FT303", "FT304")
+FLAGS_RULE_IDS = ("FT016",)
 
 
 def analyze_files(paths: Sequence[Path], root: Optional[Path] = None,
                   strict_pragmas: bool = False,
-                  protocol: bool = True) -> List[Finding]:
-    """Every finding the lint + protocol(+pragma) passes produce over
-    ``paths`` — no snapshots, no baselines (the CLI's job)."""
+                  protocol: bool = True,
+                  roundshape: bool = True,
+                  flags: bool = True) -> List[Finding]:
+    """Every finding the lint + protocol + round-shape + flag/env
+    (+pragma) passes produce over ``paths`` — no snapshots, no
+    baselines (the CLI's job)."""
     from fedml_tpu.analysis.rules import all_rules
     ctxs, findings = build_contexts(paths, root=root)
     rules = all_rules()
     findings.extend(lint_contexts(ctxs, rules=rules))
     active = {r.id for r in rules}
+    from fedml_tpu.analysis.lint import is_test_path
+    lib_ctxs = [c for c in ctxs if not is_test_path(c.relpath)]
     if protocol:
         from fedml_tpu.analysis.protocol import (conformance_findings,
                                                  extract_protocol)
-        from fedml_tpu.analysis.lint import is_test_path
-        lib_ctxs = [c for c in ctxs if not is_test_path(c.relpath)]
         findings.extend(conformance_findings(extract_protocol(lib_ctxs),
                                              lib_ctxs))
         active |= set(PROTOCOL_RULE_IDS)
+    if roundshape:
+        from fedml_tpu.analysis import roundshape as rs
+        findings.extend(rs.conformance_findings(ctxs))
+        active |= set(ROUNDSHAPE_RULE_IDS)
+    if flags:
+        from fedml_tpu.analysis import flagsconf
+        findings.extend(flagsconf.conformance_findings(lib_ctxs, root=root))
+        active |= set(FLAGS_RULE_IDS)
     _, pragma_findings = unused_pragmas(ctxs, active,
                                         strict=strict_pragmas)
     findings.extend(pragma_findings)

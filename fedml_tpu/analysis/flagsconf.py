@@ -1,0 +1,210 @@
+"""FT016 — flag/env conformance over the whole tree.
+
+The shared arg set (``experiments/args.py``) is the launchers' single
+config surface, and ``$FEDML_TPU_*`` env vars are its out-of-band
+overrides — but nothing checked that surface against reality: a flag
+nobody reads is dead weight that silently no-ops a launch, an env knob
+nobody documents is tribal knowledge, and a shared-arg-set flag missing
+from the README table is invisible to users. This pass extracts, from
+ONE parse of the tree (the same contexts every other pass shares):
+
+- **flag definitions**: every ``parser.add_argument("--name", ...)``
+  call with a literal flag string, tagged with whether it lives in the
+  SHARED arg set (a module named ``args.py``) or is launcher-local;
+- **flag reads**: every attribute access ``<expr>.name`` and every
+  ``getattr(x, "name")`` with a literal string — line breaks and
+  default-carrying getattr chains resolve naturally through the AST
+  (a regex would miss ``getattr(\\n    args, "name", None)``);
+- **env reads**: ``os.environ.get(X)`` / ``os.environ[X]`` /
+  ``os.getenv(X)`` where X is a string literal or a module-level
+  string constant (the tree's ``ENV_VAR = "FEDML_TPU_..."`` idiom).
+
+Findings (all FT016, pragma-able at the definition/read line):
+
+- a flag defined anywhere but read nowhere in the tree (dead flag);
+- a SHARED-arg-set flag absent from the README flag table
+  (``--name`` must appear literally in ``README.md``);
+- a ``FEDML_TPU_*`` env read whose variable name does not appear in
+  ``README.md`` (undocumented knob).
+
+README-dependent checks are skipped when the analysis root has no
+``README.md`` (throwaway test dirs); the dead-flag check always runs.
+Whole-program by construction: skipped under ``--changed-only``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, dotted_name
+
+RULE_ID = "FT016"
+
+_HINT = ("delete the dead flag (or wire it into a launcher/driver), add "
+         "the --flag row to the README flag table, or document the "
+         "$FEDML_TPU_* variable in README.md; deliberate exceptions "
+         "carry # ft: allow[FT016] why")
+
+ENV_PREFIX = "FEDML_TPU_"
+
+
+class _FlagDef:
+    __slots__ = ("name", "ctx", "line", "shared")
+
+    def __init__(self, name: str, ctx: FileContext, line: int,
+                 shared: bool):
+        self.name = name
+        self.ctx = ctx
+        self.line = line
+        self.shared = shared
+
+
+class _EnvRead:
+    __slots__ = ("var", "ctx", "line")
+
+    def __init__(self, var: str, ctx: FileContext, line: int):
+        self.var = var
+        self.ctx = ctx
+        self.line = line
+
+
+def _is_shared_argset(relpath: str) -> bool:
+    return Path(relpath).name == "args.py"
+
+
+def _module_str_consts(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _env_key(node: ast.expr, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def extract_flags(ctxs: Sequence[FileContext]
+                  ) -> Tuple[List[_FlagDef], Set[str], List[_EnvRead]]:
+    """-> (flag definitions, attribute/getattr read names, env reads)."""
+    defs: List[_FlagDef] = []
+    reads: Set[str] = set()
+    env_reads: List[_EnvRead] = []
+    for ctx in ctxs:
+        consts = _module_str_consts(ctx.tree)
+        shared = _is_shared_argset(ctx.relpath)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                # Load contexts only: a STORE (``self.name = ...``) is
+                # not evidence anyone consumes the flag — a dead flag
+                # mirrored into a config field must still be caught
+                if isinstance(node.ctx, ast.Load):
+                    reads.add(node.attr)
+                continue
+            if isinstance(node, ast.Subscript) \
+                    and dotted_name(node.value) == "os.environ":
+                var = _env_key(node.slice, consts)
+                if var:
+                    env_reads.append(_EnvRead(var, ctx, node.lineno))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            last = name.split(".")[-1]
+            if last == "add_argument" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and node.args[0].value.startswith("--"):
+                flag = node.args[0].value.lstrip("-").replace("-", "_")
+                # an explicit dest= overrides the derived attribute name
+                for kw in node.keywords:
+                    if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                        flag = str(kw.value.value)
+                defs.append(_FlagDef(flag, ctx, node.lineno, shared))
+            elif last == "getattr" and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                reads.add(node.args[1].value)
+            elif name in ("os.environ.get", "os.getenv") and node.args:
+                var = _env_key(node.args[0], consts)
+                if var:
+                    env_reads.append(_EnvRead(var, ctx, node.lineno))
+    return defs, reads, env_reads
+
+
+def flags_report(ctxs: Sequence[FileContext],
+                 extraction: Optional[Tuple] = None) -> Dict:
+    """Summary dict for the JSON report / runs artifact."""
+    defs, reads, env_reads = extraction or extract_flags(ctxs)
+    return {
+        "flags_defined": len(defs),
+        "flags_shared": sum(1 for d in defs if d.shared),
+        "env_reads": sorted({e.var for e in env_reads
+                             if e.var.startswith(ENV_PREFIX)}),
+    }
+
+
+def conformance_findings(ctxs: Sequence[FileContext],
+                         root: Optional[Path] = None,
+                         extraction: Optional[Tuple] = None
+                         ) -> List[Finding]:
+    """FT016 findings over the shared contexts (pragma suppression via
+    each originating context, like every pass). ``extraction`` shares
+    one :func:`extract_flags` result with :func:`flags_report`."""
+    defs, reads, env_reads = extraction or extract_flags(ctxs)
+    readme_text: Optional[str] = None
+    if root is not None:
+        readme = Path(root) / "README.md"
+        if readme.is_file():
+            readme_text = readme.read_text()
+
+    findings: List[Finding] = []
+
+    def emit(ctx: FileContext, line: int, message: str) -> None:
+        if ctx.allowed(RULE_ID, line):
+            return
+        snippet = (ctx.lines[line - 1].strip()
+                   if 0 < line <= len(ctx.lines) else "")
+        findings.append(Finding(rule=RULE_ID, path=ctx.relpath, line=line,
+                                message=message, hint=_HINT,
+                                snippet=snippet))
+
+    for d in defs:
+        if d.name not in reads:
+            where = "shared arg set" if d.shared else "launcher"
+            emit(d.ctx, d.line,
+                 f"flag --{d.name} is defined in the {where} but read "
+                 "nowhere in the tree — a dead flag silently no-ops the "
+                 "launch that passes it")
+        elif d.shared and readme_text is not None \
+                and f"--{d.name}" not in readme_text:
+            emit(d.ctx, d.line,
+                 f"shared-arg-set flag --{d.name} is missing from the "
+                 "README flag table — undocumented config surface")
+    if readme_text is not None:
+        documented_lines: Set[Tuple[str, int]] = set()
+        for e in env_reads:
+            if not e.var.startswith(ENV_PREFIX):
+                continue
+            if e.var in readme_text:
+                continue
+            key = (e.ctx.relpath, e.line)
+            if key in documented_lines:
+                continue
+            documented_lines.add(key)
+            emit(e.ctx, e.line,
+                 f"${e.var} is read here but never documented in "
+                 "README.md — an undocumented env knob is tribal "
+                 "knowledge")
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

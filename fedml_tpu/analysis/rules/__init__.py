@@ -1,17 +1,20 @@
 """Rule registry: one module per project-specific rule.
 
-Each rule carries an id (FT001..FT011), a docstring explaining the
+Each rule carries an id (FT001..FT015), a docstring explaining the
 hazard in THIS codebase's terms, and a fix hint. ``all_rules()`` is the
 canonical ordered instantiation the engine and the CLI share.
 
-Beyond the per-file AST rules live three engine/whole-program families
+Beyond the per-file AST rules live the engine/whole-program families
 (listed in ``rule_table()`` so ``--list-rules`` and the README show the
 complete surface):
 
 - FT012 — unused-pragma detection (engine pass in ``analysis/lint.py``)
+- FT016 — flag/env conformance (``analysis/flagsconf.py``)
 - FT10x — jaxpr audit of registered hot entry points
   (``analysis/jaxpr_audit.py``)
 - FT2xx — whole-program protocol conformance (``analysis/protocol.py``)
+- FT30x — round-shape conformance over the ``algorithms/`` driver zoo
+  (``analysis/roundshape.py``)
 
 ``CORPUS_RULE_IDS`` names every rule that must ship a
 ``tests/analysis_corpus/<id>_pos.py`` / ``_neg.py`` pair — the
@@ -29,6 +32,9 @@ from fedml_tpu.analysis.rules.broad_except import BroadExceptRule
 from fedml_tpu.analysis.rules.comm_timeouts import CommTimeoutRule
 from fedml_tpu.analysis.rules.concurrency import (LockOrderRule,
                                                   SharedStateLockRule)
+from fedml_tpu.analysis.rules.determinism import (FsEnumOrderRule,
+                                                  SetIterationOrderRule,
+                                                  WallClockControlFlowRule)
 from fedml_tpu.analysis.rules.donation import DonatedReuseRule
 from fedml_tpu.analysis.rules.float64 import Float64Rule
 from fedml_tpu.analysis.rules.host_sync import HostSyncRule
@@ -40,7 +46,9 @@ from fedml_tpu.analysis.rules.server_state import ServerStateRule
 _RULES = (GlobalRngRule, DonatedReuseRule, HostSyncRule,
           JitScalarArgRule, BroadExceptRule, Float64Rule,
           CommTimeoutRule, PopulationGrowthRule, ServerStateRule,
-          SharedStateLockRule, LockOrderRule)
+          SharedStateLockRule, LockOrderRule,
+          FsEnumOrderRule, SetIterationOrderRule,
+          WallClockControlFlowRule)
 
 #: engine / whole-program / audit checks that are not per-file Rule
 #: instances but are part of the rule surface
@@ -96,11 +104,47 @@ _EXTRA_RULE_ROWS = (
      "title": "protocol audit: sender->handler graph drifted from the "
               "snapshot",
      "hint": "review the protocol change, then --write-protocol-graph"},
+    {"id": "FT016",
+     "title": "flag/env conformance: dead flag (defined, read nowhere), "
+              "shared-arg-set flag missing from the README table, or "
+              "undocumented $FEDML_TPU_* env read",
+     "hint": "wire or delete the flag; document the knob in README.md"},
+    {"id": "FT300",
+     "title": "round-shape audit: ci/round_engine_map.json snapshot "
+              "missing or unreadable",
+     "hint": "--write-round-map"},
+    {"id": "FT301",
+     "title": "round-shape audit: driver re-implements a shared skeleton "
+              "helper locally",
+     "hint": "import the shared helper (core.sampling / core.pytree / "
+             "data.base / trainer.functional) instead of forking it"},
+    {"id": "FT302",
+     "title": "round-shape audit: per-round sample+pack with no prefetch "
+              "binding (skeleton wiring absent in this driver)",
+     "hint": "route through FedAvgAPI._host_round_inputs or pragma with "
+             "the structural rationale"},
+    {"id": "FT303",
+     "title": "round-shape audit: aggregation hook ignores the reported "
+              "client weights",
+     "hint": "weight by sample counts, or pragma a deliberately "
+             "unweighted robust rule"},
+    {"id": "FT304",
+     "title": "round-shape audit: driver-local env read bypassing the "
+              "shared arg set",
+     "hint": "read config through the shared arg set / Config dataclass"},
+    {"id": "FT305",
+     "title": "round-shape audit: extracted map drifted from the "
+              "snapshot",
+     "hint": "review the round-shape change, then --write-round-map"},
 )
 
-#: every rule id that must have a pos/neg corpus pair (meta-tested)
+#: every rule id that must have a pos/neg corpus pair (meta-tested);
+#: snapshot-level checks (FT200/FT204, FT300/FT305) are exercised by
+#: planted in-process specs instead of corpus files
 CORPUS_RULE_IDS = tuple(sorted(
-    [cls.id for cls in _RULES] + ["FT012", "FT201", "FT202", "FT203"]))
+    [cls.id for cls in _RULES]
+    + ["FT012", "FT201", "FT202", "FT203",
+       "FT016", "FT301", "FT302", "FT303", "FT304"]))
 
 
 def all_rules() -> List[Rule]:

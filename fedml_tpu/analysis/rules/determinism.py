@@ -1,0 +1,361 @@
+"""FT013/FT014/FT015 — run-order determinism lints.
+
+Every acceptance gate in this repo is a bit-exact parity test (fused vs
+host-loop trajectories, failover resume vs unkilled reference,
+compression `none` vs raw), yet nothing statically forbade the three
+classic parity-killers Bonawitz et al. (*Towards Federated Learning at
+Scale*) name as the dominant source of irreproducible federated
+schedules:
+
+- **FT013** — unsorted filesystem enumeration (``os.listdir`` /
+  ``os.scandir`` / ``glob.glob`` / ``Path.iterdir`` / ``.glob`` /
+  ``.rglob``) whose order leaks into whatever consumes it. The
+  client-state store, checkpoint GC, and failover restore all enumerate
+  directories; an unsorted listing makes shard selection, GC deletion
+  order, and restore choice depend on the filesystem — ext4 and tmpfs
+  disagree, and so do two runs on one machine. Wrapping the call in
+  ``sorted(...)`` fixes it; wrapping in ``set(...)``/``frozenset(...)``
+  (explicit order erasure: membership semantics) is also accepted —
+  iterating that set for order-sensitive work is then FT014's domain.
+- **FT014** — iteration over a ``set`` feeding order-sensitive work
+  (numeric accumulation, list building, message emission). Python set
+  order depends on hash seeding and insertion history: a float sum, a
+  send sequence, or a cohort list built from raw set iteration differs
+  run to run (floating-point addition does not commute bitwise).
+  ``sorted(the_set)`` restores a stable order.
+- **FT015** — ``time.time()``/``time.monotonic()`` reaching a
+  CONTROL-FLOW decision (a comparison, directly or through a local
+  variable). Wall clock in telemetry is fine (``wall_s`` records);
+  wall clock deciding *what the schedule does next* makes the run
+  unreproducible. The sanctioned real-time sites — liveness/deadline
+  eviction, watchdog stalls, chaos-harness windows, retry backoff —
+  carry a pragma with the rationale; everything else is a bug.
+
+Scope: library code only (tests are single-run by construction; corpus
+paths are linted as library code, like every rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_test_path)
+
+#: os/glob module functions whose result order is filesystem-dependent
+_FS_ENUM_FUNCS = frozenset({
+    "os.listdir", "listdir", "os.scandir", "scandir",
+    "glob.glob", "glob.iglob",
+})
+#: method names whose receiver is (duck-typed) a Path — same hazard
+_FS_ENUM_METHODS = frozenset({"iterdir", "glob", "rglob"})
+#: wrappers that neutralize enumeration order: sorted() imposes one,
+#: set()/frozenset() erase it explicitly (membership semantics)
+_ORDER_SAFE_WRAPPERS = frozenset({"sorted", "set", "frozenset"})
+
+#: receiver modules of wall-clock reads (``import time as _time`` idiom)
+_CLOCK_MODULES = frozenset({"time", "_time"})
+_CLOCK_ATTRS = frozenset({"time", "monotonic", "perf_counter"})
+
+#: in-place growth calls that make a loop body order-sensitive
+_ORDER_SENSITIVE_CALLS = frozenset({
+    "append", "appendleft", "extend", "send", "send_message", "put",
+    "write", "writelines", "add_local_trained_result",
+})
+
+
+def _parent_map(tree: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _scope_walk(root: ast.AST):
+    """ast.walk that does NOT descend into nested function defs — each
+    def is its own scope (a nested def's clock locals / set names must
+    not taint the enclosing function's analysis, and vice versa).
+    Lambdas stay in the enclosing scope (they hold no statements)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+def _is_fs_enum_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name and (name in _FS_ENUM_FUNCS):
+        return True
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _FS_ENUM_METHODS:
+        # x.glob("*") / p.iterdir() — but NOT glob.glob (handled above;
+        # a bare module attr would double-report)
+        recv = dotted_name(node.func.value)
+        return recv != "glob"
+    return False
+
+
+def _safely_wrapped(node: ast.AST, parents: Dict[int, ast.AST]) -> bool:
+    """The enumeration is an argument (any depth within the expression)
+    of a ``sorted``/``set``/``frozenset`` call."""
+    cur = node
+    while True:
+        parent = parents.get(id(cur))
+        if parent is None or isinstance(parent, ast.stmt):
+            return False
+        if isinstance(parent, ast.Call):
+            name = dotted_name(parent.func)
+            if name in _ORDER_SAFE_WRAPPERS:
+                return True
+        cur = parent
+
+
+class FsEnumOrderRule(Rule):
+    id = "FT013"
+    title = ("unsorted filesystem enumeration (os.listdir/glob/iterdir) — "
+             "shard/checkpoint selection order becomes "
+             "filesystem-dependent")
+    hint = ("wrap the enumeration in sorted(...) (or set(...) when only "
+            "membership matters), or pragma a genuinely order-insensitive "
+            "site: # ft: allow[FT013] why order cannot matter")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # textual pre-gate: parent-map construction is the expensive
+        # part and almost no file enumerates the filesystem
+        if not any(tok in ctx.source for tok in
+                   ("listdir", "scandir", "glob", "iterdir")):
+            return
+        parents = _parent_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_fs_enum_call(node)):
+                continue
+            if _safely_wrapped(node, parents):
+                continue
+            what = dotted_name(node.func) or (
+                f".{node.func.attr}" if isinstance(node.func, ast.Attribute)
+                else "<enum>")
+            yield ctx.finding(
+                self, node,
+                f"{what}(...) result is consumed in filesystem order — "
+                "two hosts (or two runs) enumerate differently, so "
+                "checkpoint GC, shard selection, and restore choice "
+                "diverge where every gate expects bit-exact parity")
+
+
+def _is_set_expr(node: ast.expr) -> bool:
+    """An expression that is literally a set at this site."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in ("set", "frozenset"):
+            return True
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "intersection", "union", "difference",
+                "symmetric_difference"):
+            # set-algebra results are sets when the receiver is — only
+            # treat known set receivers as evidence (handled by caller
+            # through the assignment tables); a bare method call alone
+            # is too ambiguous to flag
+            return False
+    return False
+
+
+def _collect_set_names(fn: ast.AST) -> Set[str]:
+    """Local names assigned a set-typed value in ``fn``'s own scope
+    (nested defs excluded — their locals are separate scopes)."""
+    names: Set[str] = set()
+    for node in _scope_walk(fn):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _collect_set_self_attrs(cls: ast.ClassDef) -> Set[str]:
+    """``self.<attr>`` names assigned a set-typed value in any method."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    attrs.add(tgt.attr)
+    return attrs
+
+
+def _body_is_order_sensitive(loop: ast.For) -> bool:
+    """Numeric accumulation, ordered-container growth, or message
+    emission inside the loop body."""
+    for node in ast.walk(loop):
+        if node is loop:
+            continue
+        if isinstance(node, ast.AugAssign):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.split(".")[-1] in _ORDER_SENSITIVE_CALLS:
+                return True
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            return True
+    return False
+
+
+class SetIterationOrderRule(Rule):
+    id = "FT014"
+    title = ("iteration over a set feeding numeric accumulation / "
+             "message emission / cohort construction (run-order "
+             "nondeterminism)")
+    hint = ("iterate sorted(the_set) — float accumulation and send order "
+            "must not depend on hash-seed iteration order; or pragma an "
+            "order-insensitive body: # ft: allow[FT014] why")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # textual pre-gate: set() / frozenset() calls, set
+        # comprehensions, or multi-element set literals. A missed
+        # single-element literal {x} cannot misorder anything.
+        import re as _re
+        if "set(" not in ctx.source and not _re.search(
+                r"\{[^\n{}:]+\bfor\b|\{[^\n{}:]+,", ctx.source):
+            return
+        # class-level set-typed self attrs, per class
+        self_attrs_by_cls: List[Tuple[ast.ClassDef, Set[str]]] = [
+            (cls, _collect_set_self_attrs(cls))
+            for cls in ast.walk(ctx.tree) if isinstance(cls, ast.ClassDef)]
+
+        def in_class_with_attr(loop: ast.For, attr: str) -> bool:
+            for cls, attrs in self_attrs_by_cls:
+                if attr in attrs:
+                    for node in ast.walk(cls):
+                        if node is loop:
+                            return True
+            return False
+
+        def check_loop(node: ast.For, local_sets: Set[str]) -> bool:
+            it = node.iter
+            set_like = _is_set_expr(it)
+            if not set_like and isinstance(it, ast.Name):
+                set_like = it.id in local_sets
+            if not set_like and isinstance(it, ast.Attribute) \
+                    and isinstance(it.value, ast.Name) \
+                    and it.value.id == "self":
+                set_like = in_class_with_attr(node, it.attr)
+            return set_like and _body_is_order_sensitive(node)
+
+        message = ("loop iterates a set and its body accumulates / "
+                   "emits in iteration order — set order depends on "
+                   "hash seeding and insertion history, so sums, send "
+                   "sequences, and cohort lists differ run to run "
+                   "(float addition does not commute bitwise)")
+        funcs = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        seen_lines: Set[int] = set()
+        # every def (incl. nested ones) and the module body is its own
+        # scope: a nested def's loops are checked only against ITS set
+        # names, never the enclosing function's
+        for scope in funcs + [ctx.tree]:
+            local_sets = (_collect_set_names(scope)
+                          if scope is not ctx.tree else
+                          {t.id for n in _scope_walk(ctx.tree)
+                           if isinstance(n, ast.Assign)
+                           and _is_set_expr(n.value)
+                           for t in n.targets if isinstance(t, ast.Name)})
+            for node in _scope_walk(scope):
+                if isinstance(node, ast.For) \
+                        and node.lineno not in seen_lines \
+                        and check_loop(node, local_sets):
+                    seen_lines.add(node.lineno)
+                    yield ctx.finding(self, node, message)
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    if len(parts) == 2:
+        return parts[0] in _CLOCK_MODULES and parts[1] in _CLOCK_ATTRS
+    # ``from time import monotonic`` — the bare spellings that are
+    # unambiguous (a bare ``time()`` call could be anything and stays
+    # out of scope, like FT001's aliasing limitation)
+    return len(parts) == 1 and parts[0] in ("monotonic", "perf_counter")
+
+
+def _contains_clock_call(node: ast.AST) -> bool:
+    return any(_is_clock_call(n) for n in ast.walk(node))
+
+
+class WallClockControlFlowRule(Rule):
+    id = "FT015"
+    title = ("wall-clock read (time.time/monotonic) deciding control "
+             "flow — the schedule becomes unreproducible")
+    hint = ("derive the decision from round indices / seeded state, or "
+            "pragma a sanctioned real-time site (liveness deadline, "
+            "watchdog, chaos window, retry backoff): "
+            "# ft: allow[FT015] why real time is the contract here")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # textual pre-gate: only files that read a wall clock at all
+        if not any(tok in ctx.source for tok in
+                   ("time(", ".monotonic(", "monotonic()",
+                    "perf_counter")):
+            return
+        funcs = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        reported: Set[int] = set()
+        for fn in funcs + [ctx.tree]:
+            # names assigned from expressions containing a clock read
+            # (``deadline = time.monotonic() + t``) — STRICTLY
+            # scope-local: _scope_walk stops at nested defs, which get
+            # their own pass (funcs lists every def, nested included),
+            # so one function's clock local cannot taint another's
+            # comparisons
+            clockish: Set[str] = set()
+            for node in _scope_walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and _contains_clock_call(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            clockish.add(tgt.id)
+            for node in _scope_walk(fn):
+                if not isinstance(node, ast.Compare):
+                    continue
+                if node.lineno in reported:
+                    continue
+                hit = _contains_clock_call(node)
+                if not hit and clockish:
+                    hit = any(isinstance(n, ast.Name)
+                              and isinstance(n.ctx, ast.Load)
+                              and n.id in clockish
+                              for n in ast.walk(node))
+                if not hit:
+                    continue
+                reported.add(node.lineno)
+                yield ctx.finding(
+                    self, node,
+                    "comparison on a wall-clock read controls what "
+                    "happens next — two runs of the same seed take "
+                    "different branches, so the schedule (and every "
+                    "bit-exact parity gate downstream) is "
+                    "unreproducible unless this site is a sanctioned "
+                    "real-time contract (pragma it with the rationale)")

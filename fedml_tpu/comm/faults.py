@@ -260,6 +260,7 @@ class FaultyCommManager:
     def _pick(self, msg: Message, direction: str) -> Optional[FaultRule]:
         if self.plan.empty:
             return None
+        # ft: allow[FT015] chaos outage windows are wall-clock by design; determinism comes from the seeded FaultPlan, not the clock
         if time.monotonic() < self._down_until \
                 and msg.get_sender_id() != msg.get_receiver_id():
             # inside a disconnect window: everything on the WIRE is lost,

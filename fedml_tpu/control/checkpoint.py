@@ -61,7 +61,7 @@ class ServerControlCheckpointer:
         whose sidecar never landed is a torn write — invisible)."""
         names = set(os.listdir(self.directory))
         out = []
-        for fn in names:
+        for fn in sorted(names):
             m = _STATE_RE.fullmatch(fn)
             if m and fn[:-len(".msgpack")] + ".json" in names:
                 out.append(int(m.group(1)))
@@ -119,7 +119,9 @@ class ServerControlCheckpointer:
 
     def _gc(self) -> None:
         keep = set(self._seqs()[-self.keep_last_n:])
-        for fn in os.listdir(self.directory):
+        # sorted: deletion order must not depend on the filesystem (a
+        # crash mid-GC leaves a deterministic survivor set)
+        for fn in sorted(os.listdir(self.directory)):
             if not fn.startswith("state_"):
                 continue
             stem = fn.split(".")[0]

@@ -100,7 +100,7 @@ class SiloResidualStore:
         """A migrated silo keeps writing rounds forward; its stale legacy
         files would otherwise live forever. Same retention window."""
         try:
-            names = os.listdir(self.state_dir)
+            names = sorted(os.listdir(self.state_dir))
         except FileNotFoundError:
             return
         for fn in names:
@@ -117,7 +117,7 @@ class SiloResidualStore:
     def latest_round(self) -> Optional[int]:
         rounds = set(self._store.known_ids("residual"))
         try:
-            for fn in os.listdir(self.state_dir):
+            for fn in sorted(os.listdir(self.state_dir)):
                 if fn.startswith("round_") and not fn.endswith(
                         (".json", ".tmp")):
                     stem = fn.split(".")[0]

@@ -304,7 +304,7 @@ class ClientStateStore:
                 import re
                 fdir = os.path.join(self.state_dir, field)
                 if os.path.isdir(fdir):
-                    for fn in os.listdir(fdir):
+                    for fn in sorted(os.listdir(fdir)):
                         # exact-name match so a crash's stray
                         # shard_*.npz.<pid>.tmp.npz is never parsed
                         m = re.fullmatch(r"shard_(\d+)\.npz", fn)

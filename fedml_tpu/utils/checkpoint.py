@@ -56,7 +56,7 @@ class CheckpointManager:
     def _rounds(self):
         names = set(os.listdir(self.directory))
         out = []
-        for fn in names:
+        for fn in sorted(names):
             if (fn.startswith("round_") and
                     not fn.endswith((".json", ".tmp")) and
                     fn + ".json" in names):
@@ -69,7 +69,8 @@ class CheckpointManager:
         # sweep every round_* artifact: stale .tmp files and sidecar-less
         # blobs from a crash mid-save are orphans _rounds() never reports,
         # so deleting only _rounds()[:-n] would leak them forever
-        for fn in os.listdir(self.directory):
+        # (sorted: a crash mid-GC leaves a deterministic survivor set)
+        for fn in sorted(os.listdir(self.directory)):
             if not fn.startswith("round_"):
                 continue
             stem = fn.split(".")[0]

@@ -157,6 +157,7 @@ class SiloLivenessTable:
         cutoff = time.monotonic() - timeout_s
         with self._lock:
             return {w for w in self._live
+                    # ft: allow[FT015] staleness IS a wall-clock contract: a silo is stale because real seconds passed without proof of life
                     if self._last_seen.get(w, 0.0) < cutoff}
 
     def snapshot(self) -> Dict[int, Dict[str, float]]:
@@ -243,6 +244,7 @@ class RoundWatchdog:
             with self._lock:
                 stalled = time.monotonic() - self._last_beat
                 last_round = self._last_round
+            # ft: allow[FT015] the watchdog exists to measure real elapsed time — stall detection cannot be derived from round indices
             if stalled > self.timeout_s:
                 self.stall_count += 1
                 if self.liveness is not None:

@@ -33,6 +33,7 @@ class Manager:
             with self._lock:
                 idle = time.monotonic() - self._last_seen
                 busy = self._busy
+            # ft: allow[FT015] idle-window detection is a real-time contract (mirrors the silo heartbeat's pragma)
             if not busy and idle > 30.0:
                 return idle
             time.sleep(1.0)

@@ -27,6 +27,7 @@ class Manager:
     def _watch(self):
         while True:
             idle = time.monotonic() - self._last_seen
+            # ft: allow[FT015] the planted violation here is the unguarded flag, not the idle window (which is a real-time contract like the real silo's)
             if not self._busy and idle > 30.0:
                 return idle
             time.sleep(1.0)

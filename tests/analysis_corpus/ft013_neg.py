@@ -1,0 +1,15 @@
+"""FT013 negative: enumeration order is neutralized — sorted() imposes
+one, set() erases it for membership-only use."""
+import os
+
+
+def pick_restore_candidates(directory):
+    out = []
+    for fn in sorted(os.listdir(directory)):
+        if fn.startswith("round_"):
+            out.append(fn)
+    return out
+
+
+def complete_names(directory):
+    return set(os.listdir(directory))

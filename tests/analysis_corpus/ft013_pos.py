@@ -1,0 +1,12 @@
+"""FT013 positive: checkpoint selection consumes os.listdir in raw
+filesystem order — two hosts enumerate differently, so the chosen
+restore point diverges (AST-only corpus; never imported)."""
+import os
+
+
+def pick_restore_candidates(directory):
+    out = []
+    for fn in os.listdir(directory):
+        if fn.startswith("round_"):
+            out.append(fn)
+    return out

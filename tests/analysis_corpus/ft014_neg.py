@@ -1,0 +1,12 @@
+"""FT014 negative: the set is iterated in sorted order, so the
+accumulation sequence is stable run to run."""
+
+
+def weighted_total(reported_updates):
+    pending = set()
+    for worker in reported_updates:
+        pending.add(worker)
+    total = 0.0
+    for worker in sorted(pending):
+        total += float(worker) * 0.5
+    return total

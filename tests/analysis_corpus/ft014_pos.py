@@ -1,0 +1,13 @@
+"""FT014 positive: float accumulation over raw set iteration — hash
+seeding and insertion history decide the addition order, and float
+addition does not commute bitwise (AST-only corpus)."""
+
+
+def weighted_total(reported_updates):
+    pending = set()
+    for worker in reported_updates:
+        pending.add(worker)
+    total = 0.0
+    for worker in pending:
+        total += float(worker) * 0.5
+    return total

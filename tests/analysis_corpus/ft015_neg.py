@@ -1,0 +1,19 @@
+"""FT015 negative: wall clock only feeds telemetry (no comparison), and
+the control decision derives from the round index; the one real-time
+contract carries a pragma with its rationale."""
+import time
+
+
+def close_round(round_idx, deadline_rounds, record):
+    t0 = time.time()
+    if round_idx >= deadline_rounds:
+        return "close"
+    record["wall_s"] = time.time() - t0
+    return "extend"
+
+
+def watchdog_poll(last_beat, timeout_s):
+    # ft: allow[FT015] stall detection measures real elapsed time by definition
+    if time.monotonic() - last_beat > timeout_s:
+        return "stalled"
+    return "ok"

@@ -1,0 +1,14 @@
+"""FT015 positive: a wall-clock read decides control flow (directly in
+one comparison, and through a derived local in another) — the schedule
+branches differently run to run (AST-only corpus)."""
+import time
+
+
+def close_round_if_late(round_started_at, pending):
+    if time.monotonic() - round_started_at > 30.0:
+        return "close_partial"
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not pending:
+            return "close_full"
+    return "extend"

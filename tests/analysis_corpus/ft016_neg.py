@@ -1,0 +1,13 @@
+"""FT016 negative: every defined flag is read by the launcher."""
+import argparse
+
+
+def build_parser():
+    parser = argparse.ArgumentParser("corpus launcher")
+    parser.add_argument("--live_knob", type=int, default=0)
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    return args.live_knob

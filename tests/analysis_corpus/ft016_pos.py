@@ -1,0 +1,16 @@
+"""FT016 positive: a flag is defined but read nowhere in the analyzed
+set — the launch that passes it silently no-ops (AST-only corpus)."""
+import argparse
+
+
+def build_parser():
+    parser = argparse.ArgumentParser("corpus launcher")
+    parser.add_argument("--dead_knob", type=int, default=0,
+                        help="nothing ever reads args.dead_knob")
+    return parser
+
+
+def main(argv=None):
+    parser = build_parser()
+    parser.parse_args(argv)
+    return 0

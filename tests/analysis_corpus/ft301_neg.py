@@ -1,0 +1,10 @@
+"""FT301 negative: the driver imports the shared helper instead of
+redefining it."""
+from fedml_tpu.core.pytree import tree_weighted_mean
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+class CorpusDriverAPI:
+    def run_round(self, stacked, weights):
+        return tree_weighted_mean(stacked, weights)

@@ -1,0 +1,16 @@
+"""FT301 positive: a driver redefines a shared skeleton helper locally
+— the forked copy drifts from core.pytree and the parity contract
+breaks silently (AST-only corpus; the marker constant declares this
+module a round driver to the round-shape pass)."""
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+def tree_weighted_mean(stacked, weights):
+    total = weights.sum()
+    return [(leaf * weights).sum(0) / total for leaf in stacked]
+
+
+class CorpusDriverAPI:
+    def run_round(self, stacked, weights):
+        return tree_weighted_mean(stacked, weights)

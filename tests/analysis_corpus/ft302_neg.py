@@ -1,0 +1,22 @@
+"""FT302 negative: the same per-round sample+pack, but bound to the
+skeleton's prefetch pipeline."""
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.parallel.prefetch import RoundPrefetcher
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+class CorpusPipelinedDriverAPI:
+    def __init__(self, dataset, batch_size=32):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._prefetch = RoundPrefetcher(self._pack_round, 2,
+                                         name="corpus-prefetch")
+
+    def _pack_round(self, round_idx):
+        idxs = sample_clients(round_idx, self.dataset.client_num, 8)
+        x, y, mask = self.dataset.pack_clients(idxs, self.batch_size)
+        return idxs, (x, y, mask)
+
+    def run_round(self, round_idx):
+        return self._prefetch.get(round_idx)

@@ -1,0 +1,18 @@
+"""FT302 positive: the driver samples and packs every round on the
+critical path with no prefetch binding — the skeleton's async pipeline
+(PRs 2/4/5 wired it through the FedAvg family driver by driver) is
+absent here (AST-only corpus)."""
+from fedml_tpu.core.sampling import sample_clients
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+class CorpusSerialDriverAPI:
+    def __init__(self, dataset, batch_size=32):
+        self.dataset = dataset
+        self.batch_size = batch_size
+
+    def run_round(self, round_idx):
+        idxs = sample_clients(round_idx, self.dataset.client_num, 8)
+        x, y, mask = self.dataset.pack_clients(idxs, self.batch_size)
+        return idxs, (x, y, mask)

@@ -1,0 +1,14 @@
+"""FT303 negative: the hook weights by the reported sample counts (and
+a deliberately unweighted robust rule carries the pragma)."""
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+def aggregate_hook(variables, stacked, weights, key):
+    total = weights.sum()
+    return [(leaf * weights).sum(0) / total for leaf in stacked]
+
+
+# ft: allow[FT303] robust median treats clients uniformly: a Byzantine client can lie about its sample count
+def robust_aggregate_hook(variables, stacked, weights, key):
+    return [sorted(leaf)[len(leaf) // 2] for leaf in stacked]

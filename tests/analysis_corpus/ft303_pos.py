@@ -1,0 +1,9 @@
+"""FT303 positive: the aggregation hook takes the reported client
+weights but never reads them — sample-count weighting silently drops
+(AST-only corpus)."""
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+def aggregate_hook(variables, stacked, weights, key):
+    return [leaf.mean(0) for leaf in stacked]

@@ -1,0 +1,18 @@
+"""FT304 negative: the driver takes its knob from a Config dataclass
+populated by the shared arg set."""
+import dataclasses
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusDriverConfig:
+    turbo: bool = False
+
+
+class CorpusConfigDriverAPI:
+    def __init__(self, config=None):
+        self.config = config or CorpusDriverConfig()
+
+    def run_round(self, round_idx):
+        return "turbo" if self.config.turbo else "normal"

@@ -1,0 +1,14 @@
+"""FT304 positive: a driver reads an env knob directly — invisible to
+the shared arg set, the README flag table, and the launch record
+(AST-only corpus)."""
+import os
+
+FT_ROUNDSHAPE_DRIVER = True
+
+
+class CorpusEnvDriverAPI:
+    def __init__(self):
+        self.turbo = os.environ.get("CORPUS_DRIVER_TURBO", "0") == "1"
+
+    def run_round(self, round_idx):
+        return "turbo" if self.turbo else "normal"
