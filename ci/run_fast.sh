@@ -14,4 +14,7 @@ cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m fedml_tpu.state.population \
     --population 100000 --rounds 2 --cohort 10
 JAX_PLATFORMS=cpu python -m fedml_tpu.control.failover_harness --smoke
+# slowest-20 artifact (tests/conftest.py sessionfinish hook): fast-lane
+# time creep becomes a diffable runs/ number instead of a README anecdote
+export FEDML_TPU_TEST_DURATIONS="runs/test_durations.json"
 exec python -m pytest tests/ -q -m "not slow" "$@"

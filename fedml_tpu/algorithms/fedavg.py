@@ -112,6 +112,13 @@ class FedAvgConfig:
     # participation — full participation already reuses the resident
     # _pack_cache cohort.
     prefetch_depth: int = 2
+    # observability (fedml_tpu/obs): directory for the flight recorder's
+    # per-round timeline (flight_rank0.jsonl) + anomaly-armed one-shot
+    # profiles. None (default) = off; on, it is a pure observer —
+    # trajectories stay bit-exact (test_obs.py pins this).
+    obs_dir: Optional[str] = None
+    # flight-record correlation id; defaults to "sim" for this driver
+    job_id: Optional[str] = None
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 
 
@@ -197,6 +204,16 @@ class FedAvgAPI:
         store = getattr(dataset, "store", None)
         if store is not None and hasattr(store, "bind_timer"):
             store.bind_timer(self.timer)
+        # observability (fedml_tpu/obs): flight recorder + slow-round
+        # anomaly profiling for the sim driver; config.obs_dir None
+        # (default) keeps this fully off
+        from fedml_tpu.obs import build_observability
+        self._obs = build_observability(
+            getattr(self.config, "obs_dir", None),
+            job_id=getattr(self.config, "job_id", None) or "sim",
+            rank=0, role="server")
+        if self._obs is not None:
+            self._obs.bind_timer(self.timer)
 
     # -- one round ---------------------------------------------------------
     def _pack_cohort(self, idxs, dataset=None):
@@ -313,6 +330,15 @@ class FedAvgAPI:
                 f"{type(self).__name__} cannot fuse rounds: its round has "
                 "a host-side stage (e.g. the secure share exchange) that "
                 "cannot run inside a scan")
+        if self._obs is not None:
+            # per-round boundaries don't exist inside a fused scan — say
+            # so instead of leaving an empty timeline to be discovered
+            logging.warning(
+                "observability is on but the fused multi-round driver "
+                "dispatches whole round BLOCKS — the flight log gets no "
+                "per-round records (and the slow-round detector no "
+                "durations) for fused spans; use the host round loop "
+                "for per-round timelines")
         return self._fused_driver_cls(self, device_sampling)
 
     def _host_round_inputs(self, round_idx: int):
@@ -335,6 +361,11 @@ class FedAvgAPI:
         return idxs, args
 
     def run_round(self, round_idx: int):
+        # flight-recorder round boundary (pure observer: no RNG, no
+        # schedule effect; ~2 dict copies when no recorder is bound)
+        self.timer.begin_round(round_idx)
+        if self._obs is not None:
+            self._obs.round_begin(round_idx)
         idxs, (x, y, mask, keys, weights, agg_key) = \
             self._host_round_inputs(round_idx)
         with self.timer.phase("dispatch"):
@@ -342,6 +373,11 @@ class FedAvgAPI:
                                                    mask, keys, weights,
                                                    agg_key,
                                                    jnp.uint32(round_idx))
+        rec = self.timer.end_round(
+            round_idx, extra={"cohort": [int(i) for i in idxs]})
+        if self._obs is not None:
+            self._obs.round_end(round_idx,
+                                rec["duration_s"] if rec else None)
         return idxs, stats
 
     # -- the outer loop (reference fedavg_api.py:46-95) ---------------------

@@ -94,6 +94,12 @@ MSG_ARG_KEY_BASE_FP = "base_fp"
 MSG_ARG_KEY_ROUNDS_COMPLETED = "rounds_completed"
 #: BACKPRESSURE payload: seconds until the admission token bucket refills
 MSG_ARG_KEY_RETRY_AFTER = "retry_after_s"
+#: observability piggyback (fedml_tpu/obs): a compact counter digest a
+#: silo attaches to replies/heartbeats when the flight recorder is on —
+#: the server turns it into per-silo rows in ITS flight log, so one
+#: merged timeline carries every process's view of round r. Read
+#: optionally server-side; absent in the (default) obs-off wire format.
+MSG_ARG_KEY_OBS_DIGEST = "obs_digest"
 
 #: All silo actors in one process share one physical device, which has ONE
 #: dispatch queue anyway — serializing jax compute across actor threads
@@ -266,6 +272,9 @@ class FedAvgServerManager(ServerManager):
         #: monotonic timestamp of the open round's broadcast — the origin
         #: every reply's report latency is measured from (ephemeral)
         self._bcast_at: Optional[float] = None
+        #: observability bundle (fedml_tpu/obs) — bound by the launcher
+        #: alongside round_timer; None = flight recorder off (default)
+        self.obs = None
         #: terminal latch: set (with a FINISH sweep) when the schedule
         #: cannot make progress; launch_federation re-raises it
         self.scheduling_error: Optional[Exception] = None
@@ -635,6 +644,14 @@ class FedAvgServerManager(ServerManager):
         # ledger payload + the latency origin every reply is measured from
         self._round_cohort = [int(idxs[w - 1]) for w in range(1, self.size)]
         self._bcast_at = time.monotonic()
+        # flight-recorder round boundary: snapshot the counter state so
+        # _close_round's end_round attributes deltas to THIS round, and
+        # open any anomaly-armed one-shot profile window (pure observer)
+        tm = getattr(self, "round_timer", None)
+        if tm is not None:
+            tm.begin_round(self.round_idx)
+        if self.obs is not None:
+            self.obs.round_begin(self.round_idx)
         for worker in range(1, self.size):
             if self._evict_on_deadline and (worker - 1) not in live:
                 continue
@@ -691,10 +708,24 @@ class FedAvgServerManager(ServerManager):
                 # life and a usable contribution — re-admit
                 logging.info("silo %d re-admitted on a live round-%d "
                              "reply", worker + 1, r)
+        # per-silo flight row: the server-measured report latency plus
+        # whatever compact digest the silo piggybacked — the
+        # cross-process half of the merged round timeline
+        obs_row = None
+        if self.obs is not None:
+            obs_row = {"kind": "silo", "round": int(self.round_idx),
+                       "silo_rank": int(worker + 1), "event": "reply"}
+            digest = msg.get_params().get(MSG_ARG_KEY_OBS_DIGEST)
+            if digest is not None:
+                obs_row["digest"] = digest
         if self._bcast_at is not None:
             # the report-latency distribution pace steering feeds on
-            self.liveness.observe_report_latency(
-                worker, time.monotonic() - self._bcast_at)
+            latency = time.monotonic() - self._bcast_at
+            self.liveness.observe_report_latency(worker, latency)
+            if obs_row is not None:
+                obs_row["report_latency_s"] = round(latency, 6)
+        if obs_row is not None:
+            self.obs.recorder.append(obs_row)
         try:
             with _DEVICE_LOCK:  # delta decompression is device compute
                 payload = self._decode_model_payload(
@@ -752,6 +783,24 @@ class FedAvgServerManager(ServerManager):
         if self.on_round_done is not None:
             # outside the lock: eval re-locks internally, sink I/O doesn't
             self.on_round_done(self.round_idx, self.global_model)
+        # flight-recorder round close: the snapshot-delta record carries
+        # the SAME cohort/reported/partial row the ledger will get, so
+        # the merge tool can cross-check the two; the measured duration
+        # feeds the slow-round anomaly detector
+        tm = getattr(self, "round_timer", None)
+        round_rec = None
+        if tm is not None:
+            round_rec = tm.end_round(self.round_idx, extra={
+                "cohort": self._round_cohort,
+                "reported": [int(w) for w in reported],
+                "live": sorted(int(w)
+                               for w in self.liveness.live_workers()),
+                "partial": bool(partial),
+                "evictions": int(self.liveness.evictions)})
+        if self.obs is not None:
+            self.obs.round_end(
+                self.round_idx,
+                round_rec["duration_s"] if round_rec else None)
         deadline_used = self.round_deadline_s
         self.round_idx += 1
         if self.checkpoint_mgr is not None:
@@ -841,6 +890,15 @@ class FedAvgServerManager(ServerManager):
                     f"{self._max_extensions}) — the federation cannot "
                     "make progress; final state checkpointed")
                 return
+            if self.obs is not None:
+                # a quorum extension is exactly the "round is not
+                # closing" signal the flight recorder exists for: record
+                # it and arm a one-shot profile of the next round
+                self.obs.note_anomaly(
+                    "deadline_extension", self.round_idx,
+                    {"reported": len(reported), "live": len(live),
+                     "need": int(need),
+                     "extensions": int(self._extensions_this_round)})
             logging.warning(
                 "round %d deadline passed with %d/%d reports (quorum %d) "
                 "— extending the deadline (%d/%s extensions used)",
@@ -879,6 +937,15 @@ class FedAvgServerManager(ServerManager):
         # the beat itself landed in receive_message; the handler only
         # keeps the count observable
         self.ft_counters["heartbeats"] += 1
+        if self.obs is not None:
+            digest = msg.get_params().get(MSG_ARG_KEY_OBS_DIGEST)
+            if digest is not None:
+                # idle-silo digests keep the per-silo timeline moving
+                # between replies (an evicted silo still shows up)
+                self.obs.recorder.append(
+                    {"kind": "silo", "round": int(self.round_idx),
+                     "silo_rank": int(msg.get_sender_id()),
+                     "event": "heartbeat", "digest": digest})
 
     def handle_message_join(self, msg: Message) -> None:
         """Re-admit a restarted/evicted silo: mark live, forget its stale
@@ -1026,9 +1093,15 @@ class FedAvgClientManager(ClientManager):
                  prefetch_depth: int = 2,
                  heartbeat_s: float = 0.0,
                  rejoin_idle_s: Optional[float] = None,
-                 join_on_start: bool = False):
+                 join_on_start: bool = False,
+                 obs=None):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
+        #: observability bundle (fedml_tpu/obs): when set, this silo
+        #: writes its own flight log AND piggybacks a compact counter
+        #: digest on replies/heartbeats. None (default) = the legacy
+        #: byte-identical wire format.
+        self._obs = obs
         # -- fault tolerance ------------------------------------------------
         #: periodic proof of life (0 = off, the legacy behavior); the
         #: server ALSO counts every reply as a beat, so the periodic
@@ -1156,6 +1229,38 @@ class FedAvgClientManager(ClientManager):
         finally:
             self._hb_stop.set()
 
+    def _obs_digest(self) -> Dict:
+        """The compact counter digest piggybacked on replies/heartbeats
+        when observability is on: cumulative wire bytes, transport
+        retries, rounds completed, prefetch and state-cache hit counts,
+        plus this endpoint incarnation's stream epoch (the same identity
+        the reliable transport stamps frames with) — everything the
+        server needs for its per-silo flight rows, a few dozen bytes."""
+        from fedml_tpu.obs import endpoint_epoch
+        com = self.com_manager
+        with self._hb_lock:
+            done = self.rounds_completed
+        counters = dict(com.all_counters() if hasattr(com, "all_counters")
+                        else getattr(com, "counters", {}))
+        digest = {"rounds_completed": int(done),
+                  "epoch": endpoint_epoch(com) or 0,
+                  "bytes_up": int(getattr(com, "bytes_sent", 0)),
+                  "bytes_down": int(getattr(com, "bytes_received", 0)),
+                  "retries": int(counters.get("retries", 0)),
+                  "dedup_drops": int(counters.get("dedup_drops", 0))}
+        if self._prefetch is not None:
+            st = self._prefetch.stats()
+            digest["prefetch_hits"] = int(st.get("hits", 0))
+            digest["prefetch_misses"] = int(st.get("misses", 0))
+        store = getattr(self.dataset, "store", None)
+        if store is not None and hasattr(store, "stats"):
+            st = store.stats()
+            digest["state_cache_hits"] = int(
+                st.get("state_cache_hits", 0))
+            digest["state_cache_misses"] = int(
+                st.get("state_cache_misses", 0))
+        return digest
+
     def _send_join(self) -> None:
         msg = Message(MSG_TYPE_C2S_JOIN, self.rank, 0)
         with self._hb_lock:
@@ -1185,8 +1290,10 @@ class FedAvgClientManager(ClientManager):
                 self._send_join()
                 continue
             try:
-                self.send_message(
-                    Message(MSG_TYPE_C2S_HEARTBEAT, self.rank, 0))
+                beat = Message(MSG_TYPE_C2S_HEARTBEAT, self.rank, 0)
+                if self._obs is not None:
+                    beat.add(MSG_ARG_KEY_OBS_DIGEST, self._obs_digest())
+                self.send_message(beat)
             except OSError as exc:
                 logging.debug("silo %d heartbeat failed: %r", self.rank,
                               exc)
@@ -1262,6 +1369,7 @@ class FedAvgClientManager(ClientManager):
                 self._last_s2c = time.monotonic()
 
     def _train_and_reply(self, msg: Message) -> None:
+        t0 = time.perf_counter()
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = msg.get(MSG_ARG_KEY_ROUND)
         variables = self._apply_broadcast(msg)
@@ -1327,6 +1435,17 @@ class FedAvgClientManager(ClientManager):
         from fedml_tpu.comm.compression import tree_fingerprint
         reply.add(MSG_ARG_KEY_BASE_SEQ, self._held_seq)
         reply.add(MSG_ARG_KEY_BASE_FP, tree_fingerprint(variables))
+        if self._obs is not None:
+            # piggyback the counter digest for the server's per-silo row
+            # and record this silo's own view of the round (its flight
+            # log is what the merge tool aligns with the server's) —
+            # BEFORE the send, so a mid-failover round still documents
+            # the local train that happened
+            reply.add(MSG_ARG_KEY_OBS_DIGEST, self._obs_digest())
+            self._obs.recorder.append(
+                {"kind": "round", "round": int(round_idx),
+                 "client_idx": int(client_idx),
+                 "train_s": round(time.perf_counter() - t0, 6)})
         try:
             self.send_message(reply)
         except OSError as exc:
@@ -1368,7 +1487,9 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           server_checkpoint_dir: Optional[str] = None,
                           pace_steering: bool = False,
                           join_rate_limit: float = 0.0,
-                          max_deadline_extensions: Optional[int] = 25):
+                          max_deadline_extensions: Optional[int] = 25,
+                          obs_dir: Optional[str] = None,
+                          job_id: Optional[str] = None):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -1399,6 +1520,12 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     exhausting it raises a loud SchedulingStallError after checkpointing
     the final state. All defaults off/inert -> byte-identical legacy
     behavior.
+
+    Observability (fedml_tpu/obs): ``obs_dir`` turns on the federation
+    flight recorder — per-round snapshot-delta timelines + per-silo
+    digest rows in ``flight_rank<r>.jsonl`` next to the control-plane
+    ledger, anomaly-armed one-shot profiling under ``obs_dir/profiles``.
+    Pure observer: trajectories are bit-exact vs ``obs_dir=None``.
 
     The reference's equivalent is `mpirun -np worker_num+1 main_fedavg.py`
     (FedAvgAPI.py:20-67 rank dispatch); here ranks are threads over the
@@ -1444,7 +1571,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         client_state_dir=checkpoint_dir, resume=resume,
         join_timeout_s=join_timeout_s, round_record_hook=round_record_hook,
         timer=timer, prefetch_depth=prefetch_depth,
-        heartbeat_s=heartbeat_s, fault_plan=fault_plan)
+        heartbeat_s=heartbeat_s, fault_plan=fault_plan,
+        obs_dir=obs_dir, job_id=job_id)
     return model, history
 
 
@@ -1462,7 +1590,9 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       timer=None,
                       prefetch_depth: int = 2,
                       heartbeat_s: float = 0.0,
-                      fault_plan=None):
+                      fault_plan=None,
+                      obs_dir: Optional[str] = None,
+                      job_id: Optional[str] = None):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
@@ -1523,6 +1653,18 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                             on_round_done)
     from fedml_tpu.utils.tracing import RoundTimer
     server.round_timer = timer if timer is not None else RoundTimer()
+    # observability (fedml_tpu/obs): one flight recorder per process
+    # role — the server gets the anomaly detector + one-shot profiler,
+    # each silo records its own log and piggybacks digests. obs_dir
+    # None (default) keeps the wire format byte-identical.
+    from fedml_tpu.obs import build_observability, endpoint_epoch
+    job = job_id or "fed"
+    obs_server = build_observability(obs_dir, job_id=job, rank=0,
+                                     role="server")
+    if obs_server is not None:
+        obs_server.recorder.set_epoch(endpoint_epoch(server_com))
+        obs_server.bind_timer(server.round_timer)
+        server.obs = obs_server
     clients = []
     client_coms = []
     for rank in range(1, size):
@@ -1531,6 +1673,10 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                                   token=token, fault_plan=plan)
         # ft: allow[FT008] one endpoint per SILO at launch — bounded by worker_num (tens), not the client population
         client_coms.append(com)
+        silo_obs = build_observability(obs_dir, job_id=job, rank=rank,
+                                       role="silo")
+        if silo_obs is not None:
+            silo_obs.recorder.set_epoch(endpoint_epoch(com))
         # ft: allow[FT008] one manager per SILO at launch — silo count is the federation's process count, not its population
         clients.append(FedAvgClientManager(
             rank, size, com, dataset, module, task, train_cfg, seed=seed,
@@ -1538,7 +1684,7 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
             state_dir=(os.path.join(client_state_dir, f"silo_{rank}")
                        if client_state_dir else None),
             resume=resume, prefetch_depth=prefetch_depth,
-            heartbeat_s=heartbeat_s))
+            heartbeat_s=heartbeat_s, obs=silo_obs))
 
     # Warm the two heavyweight programs ON THE MAIN THREAD before any
     # actor thread starts: one local_train at the padded shape and one
@@ -1653,6 +1799,9 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     if getattr(server, "_pace", None) is not None \
             and getattr(server, "round_deadline_s", None):
         tmr.gauge("cp_steered_deadline_s", float(server.round_deadline_s))
+    if obs_server is not None:
+        # stop any profile window an aborted schedule left open
+        obs_server.close()
     err = getattr(server, "scheduling_error", None)
     if err is not None:
         # the server already checkpointed final state and FINISHed the

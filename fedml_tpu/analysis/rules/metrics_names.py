@@ -1,0 +1,214 @@
+"""FT017 — metric-name conformance against the documented registry.
+
+``RoundTimer``'s phase/counter/gauge maps are ``defaultdict``s, so a
+typo'd name at a ``timer.count("ft_retrys")`` call site silently creates
+a NEW key: the intended series stops moving, every evidence row still
+looks healthy, and nothing fails. The documented metric registry
+(``fedml_tpu/obs/registry.py``) is the single source of truth; this rule
+closes the loop in both directions — the same conformance pattern FT016
+applies to launcher flags:
+
+- a ``timer.count/add/gauge/phase`` call whose FIRST argument is a
+  string literal (conditional ``a if c else b`` literals included) not
+  registered in ``METRICS`` is a finding at the call site;
+- inside the registry module itself, a registered metric name that does
+  not appear (backticked) in the repo ``README.md`` is a finding — the
+  registry doubles as the machine-checked README metrics table.
+
+Receiver scoping: only calls whose receiver *names a RoundTimer by this
+codebase's conventions* (``timer`` / ``self.timer`` / ``round_timer`` /
+``tmr`` / ``tm`` / ``self._timer``-style tails) are checked — a
+``set.add("x")`` or ``threading.Timer`` call never matches the
+method+literal+receiver triple. Non-literal names (f-strings, loop
+variables) are out of scope, like every AST rule's aliasing limit.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator, List, Optional
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import FileContext, Rule, dotted_name
+
+#: receiver tails that name a RoundTimer in this tree's idiom
+_TIMER_NAMES = frozenset({"timer", "_timer", "round_timer",
+                          "_round_timer", "tmr", "tm"})
+_METHODS = frozenset({"count", "add", "gauge", "phase"})
+
+#: the registry module's repo-relative path (the README-table check
+#: anchors here)
+_REGISTRY_RELPATH = "fedml_tpu/obs/registry.py"
+
+#: registry-path -> (mtime, names) — one parse per registry per run
+_REGISTRY_CACHE: dict = {}
+
+
+def _metric_keys_from_tree(tree: ast.AST) -> Optional[frozenset]:
+    """The METRICS dict's literal string keys out of a registry module's
+    AST — the oracle stays inside the tree under analysis (an external
+    checkout's registry is ITS registry, not this process's import)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign):
+            tgt, val = node.target, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+        else:
+            continue
+        if isinstance(tgt, ast.Name) and tgt.id == "METRICS" \
+                and isinstance(val, ast.Dict):
+            return frozenset(k.value for k in val.keys
+                             if isinstance(k, ast.Constant)
+                             and isinstance(k.value, str))
+    return None
+
+
+def _known_metrics(ctx: FileContext) -> frozenset:
+    """The allow set for ``ctx``'s tree: the ANALYZED tree's registry
+    (located via ctx's root = path minus relpath) when present, the
+    imported package registry as the fallback (throwaway test dirs and
+    corpus files have no registry of their own)."""
+    registry = _registry_path_for(ctx)
+    if registry is not None:
+        try:
+            mtime = registry.stat().st_mtime_ns
+        except OSError:
+            mtime = None
+        key = str(registry)
+        cached = _REGISTRY_CACHE.get(key)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+        names = None
+        try:
+            names = _metric_keys_from_tree(
+                ast.parse(registry.read_text()))
+        except (OSError, SyntaxError):
+            names = None
+        if names is not None:
+            _REGISTRY_CACHE[key] = (mtime, names)
+            return names
+    from fedml_tpu.obs.registry import metric_names
+    return metric_names()
+
+
+def _registry_path_for(ctx: FileContext) -> Optional[Path]:
+    """<analyzed tree root>/fedml_tpu/obs/registry.py, derived by
+    stripping ``relpath`` off the context's absolute path; None when the
+    analyzed set isn't rooted in a tree that ships a registry."""
+    try:
+        path = Path(ctx.path).resolve()
+        rel = Path(ctx.relpath)
+        if path.parts[-len(rel.parts):] != rel.parts:
+            return None
+        root = Path(*path.parts[:-len(rel.parts)])
+    except (ValueError, OSError):
+        return None
+    registry = root / _REGISTRY_RELPATH
+    return registry if registry.is_file() else None
+
+
+def _literal_names(node: ast.expr) -> List[str]:
+    """String literals an argument can evaluate to: a plain constant, or
+    both arms of a conditional (``"hit" if ok else "miss"``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _literal_names(node.body) + _literal_names(node.orelse)
+    return []
+
+
+def _is_timer_receiver(func: ast.expr) -> bool:
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = dotted_name(func.value)
+    if not recv:
+        return False
+    return recv.split(".")[-1] in _TIMER_NAMES
+
+
+class MetricNameRule(Rule):
+    id = "FT017"
+    title = ("timer.count/add/gauge/phase with a literal metric name "
+             "absent from the documented registry (defaultdict: a typo "
+             "silently creates a dead series)")
+    hint = ("register the metric in fedml_tpu/obs/registry.py (and add "
+            "its README table row), fix the typo, or pragma a "
+            "deliberately unregistered name: # ft: allow[FT017] why")
+
+    def applies(self, relpath: str) -> bool:
+        from fedml_tpu.analysis.lint import is_test_path
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.relpath == _REGISTRY_RELPATH:
+            yield from self._check_registry_vs_readme(ctx)
+        # textual pre-gate: almost no file talks to a timer
+        if not any(tok in ctx.source for tok in
+                   (".count(", ".gauge(", ".phase(", ".add(")):
+            return
+        known = None
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS and node.args):
+                continue
+            if not _is_timer_receiver(node.func):
+                continue
+            names = _literal_names(node.args[0])
+            if not names:
+                continue  # non-literal: out of scope (aliasing limit)
+            if known is None:
+                known = _known_metrics(ctx)
+            for name in names:
+                if name not in known:
+                    yield ctx.finding(
+                        self, node,
+                        f"timer.{node.func.attr}({name!r}, ...) uses a "
+                        "metric name absent from the documented registry "
+                        "(fedml_tpu/obs/registry.py) — the defaultdict "
+                        "silently creates a new key, so a typo here "
+                        "kills the intended series without any failure")
+
+    def _check_registry_vs_readme(self,
+                                  ctx: FileContext) -> Iterator[Finding]:
+        """The registry IS the README metrics table's oracle: every
+        registered name must appear backticked in the repo README —
+        both read from the ANALYZED tree (pragma suppression is the
+        engine's central pass, like every rule)."""
+        readme = self._find_readme(ctx.path)
+        if readme is None:
+            return
+        text = readme.read_text()
+        names = _metric_keys_from_tree(ctx.tree) or frozenset()
+        # anchor findings at the METRICS dict assignment
+        line = 1
+        for node in ast.iter_child_nodes(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                tgt = (node.targets[0] if isinstance(node, ast.Assign)
+                       else node.target)
+                if isinstance(tgt, ast.Name) and tgt.id == "METRICS":
+                    line = node.lineno
+                    break
+        for name in sorted(names):
+            if f"`{name}`" not in text:
+                yield Finding(
+                    rule=self.id, path=ctx.relpath, line=line,
+                    message=f"registered metric {name!r} is missing from "
+                            "the README \"Observability\" metric table — "
+                            "the registry and the table are one "
+                            "machine-checked surface",
+                    hint=self.hint,
+                    snippet=(ctx.lines[line - 1].strip()
+                             if 0 < line <= len(ctx.lines) else ""))
+
+    @staticmethod
+    def _find_readme(registry_path: Path) -> Optional[Path]:
+        """README.md at the analyzed tree's root: registry.py lives at
+        <root>/fedml_tpu/obs/registry.py."""
+        try:
+            root = Path(registry_path).resolve().parents[2]
+        except IndexError:
+            return None
+        readme = root / "README.md"
+        return readme if readme.is_file() else None

@@ -112,7 +112,8 @@ def start_silos(backend: str, workers: int, *, router=None, addresses=None,
 def _build_server(com, workers: int, rounds: int, ckpt_dir: str, *,
                   deadline_s: Optional[float], min_quorum_frac: float,
                   pace: bool, join_rate_limit: float,
-                  max_deadline_extensions: int, server_cls=None):
+                  max_deadline_extensions: int, server_cls=None,
+                  obs_dir: Optional[str] = None):
     from fedml_tpu.algorithms.fedavg_cross_silo import (FedAvgAggregator,
                                                         FedAvgServerManager)
     from fedml_tpu.control import build_control_plane
@@ -134,6 +135,16 @@ def _build_server(com, workers: int, rounds: int, ckpt_dir: str, *,
                  round_deadline_s=deadline_s,
                  min_quorum_frac=min_quorum_frac, **control)
     server.round_timer = RoundTimer()
+    if obs_dir:
+        # flight recorder next to the checkpoints/ledger: a restarted
+        # server APPENDS to the same flight log under a new transport
+        # epoch, so the merged timeline shows both lives
+        from fedml_tpu.obs import build_observability, endpoint_epoch
+        obs = build_observability(obs_dir, job_id="failover", rank=0,
+                                  role="server")
+        obs.recorder.set_epoch(endpoint_epoch(com))
+        obs.bind_timer(server.round_timer)
+        server.obs = obs
     return server
 
 
@@ -141,7 +152,8 @@ def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
           deadline_s: float, min_quorum_frac: float = 0.5,
           pace: bool = False, join_rate_limit: float = 0.0,
           max_deadline_extensions: int = 25,
-          join_timeout_s: float = 600.0) -> int:
+          join_timeout_s: float = 600.0,
+          obs_dir: Optional[str] = None) -> int:
     """Subprocess entry: run ONE server incarnation over TCP until the
     schedule completes (or this process is killed mid-flight — the point
     of the exercise). Writes ``server_summary.json`` next to the
@@ -153,7 +165,8 @@ def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
                            deadline_s=deadline_s,
                            min_quorum_frac=min_quorum_frac, pace=pace,
                            join_rate_limit=join_rate_limit,
-                           max_deadline_extensions=max_deadline_extensions)
+                           max_deadline_extensions=max_deadline_extensions,
+                           obs_dir=obs_dir)
     thread = threading.Thread(target=server.run, daemon=True)
     thread.start()
     server.send_init_msg()
@@ -212,7 +225,8 @@ def run_simulated_failover(ckpt_dir: str, *, rounds: int = 6,
                            deadline_s: Optional[float] = None,
                            min_quorum_frac: float = 0.5,
                            pace: bool = False,
-                           join_timeout_s: float = 180.0):
+                           join_timeout_s: float = 180.0,
+                           obs_dir: Optional[str] = None):
     """Kill-and-restart without subprocesses. Returns
     ``(final_model_numpy, ledger, server2)`` — server2 carries the
     restored counters and the bound RoundTimer."""
@@ -229,7 +243,7 @@ def run_simulated_failover(ckpt_dir: str, *, rounds: int = 6,
                                           addresses=addresses)
     common = dict(deadline_s=deadline_s, min_quorum_frac=min_quorum_frac,
                   pace=pace, join_rate_limit=0.0,
-                  max_deadline_extensions=25)
+                  max_deadline_extensions=25, obs_dir=obs_dir)
 
     # phase 1: runs to crash_at_round, then goes dark mid-schedule
     # (crash_at_round >= rounds never crashes: the unkilled reference leg)
@@ -285,7 +299,8 @@ def run_simulated_failover(ckpt_dir: str, *, rounds: int = 6,
 # ---------------------------------------------------------------------------
 def _spawn_server(port_base: int, rounds: int, workers: int, ckpt_dir: str,
                   deadline_s: float, pace: bool, join_rate_limit: float,
-                  log_path: str) -> subprocess.Popen:
+                  log_path: str,
+                  obs_dir: Optional[str] = None) -> subprocess.Popen:
     cmd = [sys.executable, "-m", "fedml_tpu.control.failover_harness",
            "--role", "server", "--rounds", str(rounds),
            "--workers", str(workers), "--port_base", str(port_base),
@@ -293,6 +308,8 @@ def _spawn_server(port_base: int, rounds: int, workers: int, ckpt_dir: str,
            "--join_rate_limit", str(join_rate_limit)]
     if pace:
         cmd.append("--pace")
+    if obs_dir:
+        cmd.extend(["--obs_dir", obs_dir])
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     logf = open(log_path, "ab")
     try:
@@ -327,7 +344,8 @@ def run_failover_scenario(ckpt_dir: str, *, rounds: int = DEFAULT_ROUNDS,
                           pace: bool = False,
                           join_rate_limit: float = 0.0,
                           silo_fault_plan=None,
-                          timeout_s: float = 300.0) -> Dict:
+                          timeout_s: float = 300.0,
+                          obs_dir: Optional[str] = None) -> Dict:
     """SIGKILL the server subprocess mid-schedule, restart it, and wait
     for the full schedule. ``silo_fault_plan`` (e.g. a 30% flap) wraps
     the SILO endpoints only — the chaos rides the fleet while the kill
@@ -340,7 +358,7 @@ def run_failover_scenario(ckpt_dir: str, *, rounds: int = DEFAULT_ROUNDS,
         "TCP", workers, addresses=make_addresses(port_base, workers + 1),
         fault_plan=silo_fault_plan)
     proc = _spawn_server(port_base, rounds, workers, ckpt_dir, deadline_s,
-                         pace, join_rate_limit, log_path)
+                         pace, join_rate_limit, log_path, obs_dir=obs_dir)
     killed_at = None
     try:
         _wait_for_round(ckpt_dir, kill_after_round, proc, timeout_s / 2)
@@ -348,7 +366,8 @@ def run_failover_scenario(ckpt_dir: str, *, rounds: int = DEFAULT_ROUNDS,
         proc.wait(timeout=30)
         killed_at = kill_after_round
         proc = _spawn_server(port_base, rounds, workers, ckpt_dir,
-                             deadline_s, pace, join_rate_limit, log_path)
+                             deadline_s, pace, join_rate_limit, log_path,
+                             obs_dir=obs_dir)
         rc = proc.wait(timeout=timeout_s)
     finally:
         if proc.poll() is None:
@@ -420,6 +439,9 @@ def main(argv=None) -> int:
     p.add_argument("--min_quorum_frac", type=float, default=0.5)
     p.add_argument("--pace", action="store_true")
     p.add_argument("--join_rate_limit", type=float, default=0.0)
+    p.add_argument("--obs_dir", type=str, default=None,
+                   help="flight-recorder directory (fedml_tpu/obs) for "
+                        "the server incarnation(s)")
     args = p.parse_args(argv)
     if args.smoke:
         args.role = "smoke"  # the documented invocation wins over --role
@@ -429,7 +451,8 @@ def main(argv=None) -> int:
         return serve(args.rounds, args.workers, args.port_base,
                      args.ckpt_dir, deadline_s=args.deadline_s,
                      min_quorum_frac=args.min_quorum_frac, pace=args.pace,
-                     join_rate_limit=args.join_rate_limit)
+                     join_rate_limit=args.join_rate_limit,
+                     obs_dir=args.obs_dir)
     return _smoke(args.ckpt_dir)
 
 

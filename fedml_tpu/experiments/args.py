@@ -79,6 +79,21 @@ def add_federated_args(parser: argparse.ArgumentParser):
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="write a TensorBoard-loadable jax.profiler "
                              "trace of the training loop here")
+    parser.add_argument("--obs_dir", type=str, default=None,
+                        help="federation flight recorder (fedml_tpu/obs): "
+                             "per-round telemetry timelines to "
+                             "flight_rank<r>.jsonl under this directory, "
+                             "per-silo digest rows, and anomaly-armed "
+                             "one-shot jax.profiler windows under "
+                             "<obs_dir>/profiles. Merge N logs with "
+                             "`python -m fedml_tpu.obs merge <obs_dir>`. "
+                             "Pure observer: trajectories are bit-exact "
+                             "vs unset (the default: off)")
+    parser.add_argument("--job_id", type=str, default=None,
+                        help="flight-record correlation id stamped on "
+                             "every telemetry record (default: a "
+                             "per-driver constant) — lets one obs_dir "
+                             "hold several jobs' logs")
     parser.add_argument("--compile_cache_dir", type=str, default=None,
                         help="persistent XLA compilation cache dir "
                              "(default: $FEDML_TPU_COMPILE_CACHE; unset = "

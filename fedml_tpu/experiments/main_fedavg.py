@@ -43,6 +43,8 @@ def run_simulation(args, ds, model, task, sink):
                        eval_train_subsample=getattr(
                            args, "eval_train_subsample", None),
                        prefetch_depth=getattr(args, "prefetch_depth", 2),
+                       obs_dir=getattr(args, "obs_dir", None),
+                       job_id=getattr(args, "job_id", None),
                        train=make_train_config(args))
     api = FedAvgAPI(ds, model, task=task, config=cfg)
     if getattr(args, "fused_rounds", 0):
@@ -90,6 +92,8 @@ def run_spmd(args, ds, model, task, sink):
         model_parallel=getattr(args, "model_parallel", None),
         mp_size=getattr(args, "mp_size", 1),
         prefetch_depth=getattr(args, "prefetch_depth", 2),
+        obs_dir=getattr(args, "obs_dir", None),
+        job_id=getattr(args, "job_id", None),
         train=make_train_config(args))
     api = DistributedFedAvgAPI(ds, model, task=task, config=cfg)
     if getattr(args, "fused_rounds", 0) and cfg.model_parallel:
@@ -138,6 +142,9 @@ def run_cross_silo(args, ds, model, task, sink):
         pace_steering=getattr(args, "pace_steering", False),
         join_rate_limit=getattr(args, "join_rate_limit", 0.0),
         max_deadline_extensions=resolve_max_extensions(args),
+        # federation flight recorder (fedml_tpu/obs)
+        obs_dir=getattr(args, "obs_dir", None),
+        job_id=getattr(args, "job_id", None),
         # fedopt-style server step when the launcher passes the fedopt flags
         server_optimizer=getattr(args, "cross_silo_server_optimizer", None),
         server_lr=getattr(args, "server_lr", 1e-3))

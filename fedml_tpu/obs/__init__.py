@@ -1,0 +1,137 @@
+"""fedml_tpu.obs — the federation flight recorder.
+
+Three layers (see ISSUE/README "Observability"):
+
+1. **Per-round telemetry timeline** — ``RoundTimer.begin_round`` /
+   ``end_round`` snapshot-delta semantics (``utils/tracing.py``) give
+   every phase/counter/gauge a per-round series in a bounded ring
+   buffer, flushed through a :class:`FlightRecorder` into an
+   append-only, crash-tolerant ``flight_rank<r>.jsonl``.
+2. **Cross-process span correlation** — every record carries
+   ``(job_id, round, rank, epoch)``; silos piggyback a compact counter
+   digest on replies/heartbeats so the server's log holds per-silo
+   rows; :func:`merge_flight_logs` reconstructs one global timeline
+   from N logs, cross-checkable against the control-plane ledger.
+3. **Anomaly-triggered profiling** — watchdog/pace/slow-round signals
+   write ``anomaly`` records and arm a one-shot ``jax.profiler`` window
+   for the next round (:class:`AnomalyProfiler`).
+
+Observability is a PURE OBSERVER: with it on, trajectories are
+bit-exact vs off (tested the same way as control-plane checkpointing);
+every write path degrades to a logged warning, never an exception.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from fedml_tpu.obs.anomaly import AnomalyProfiler, RoundAnomalyDetector
+from fedml_tpu.obs.flight import (FLIGHT_FORMAT, FlightRecorder,
+                                  flight_log_paths, read_flight_log)
+from fedml_tpu.obs.merge import check_against_ledger, merge_flight_logs
+from fedml_tpu.obs.registry import METRICS, metric_names
+
+__all__ = [
+    "AnomalyProfiler", "FlightRecorder", "Observability",
+    "RoundAnomalyDetector", "FLIGHT_FORMAT", "METRICS",
+    "build_observability", "check_against_ledger", "endpoint_epoch",
+    "flight_log_paths", "merge_flight_logs", "metric_names",
+    "read_flight_log",
+]
+
+
+def endpoint_epoch(com) -> Optional[int]:
+    """The reliable transport's per-incarnation stream epoch for a comm
+    endpoint — the identity flight records reuse. Unwraps the chaos
+    harness (``FaultyCommManager`` holds the real backend at ``.inner``;
+    byte accounting and seq stamping live there too)."""
+    inner = getattr(com, "inner", com)
+    epoch = getattr(inner, "_seq_epoch", None)
+    return int(epoch) if epoch is not None else None
+
+
+class Observability:
+    """One process's observability bundle: the flight recorder plus (on
+    the server) the slow-round detector and the one-shot profiler. The
+    ``timer`` binding mirrors anomaly/profile events into the
+    ``obs_*`` counters so they land on the same evidence rows as
+    everything else."""
+
+    def __init__(self, recorder: FlightRecorder,
+                 detector: Optional[RoundAnomalyDetector] = None,
+                 profiler: Optional[AnomalyProfiler] = None):
+        self.recorder = recorder
+        self.detector = detector
+        self.profiler = profiler
+        self._timer = None
+
+    def bind_timer(self, timer) -> None:
+        self._timer = timer
+        if timer is not None:
+            timer.bind_flight(self.recorder)
+
+    def note_anomaly(self, reason: str, round_idx: int,
+                     detail: Optional[Dict[str, Any]] = None) -> None:
+        """Record an anomaly in the flight log and arm the one-shot
+        profiler window for the next round."""
+        rec = {"kind": "anomaly", "round": int(round_idx),
+               "reason": str(reason)}
+        if detail:
+            rec["detail"] = detail
+        self.recorder.append(rec)
+        if self._timer is not None:
+            self._timer.count("obs_anomalies")
+        if self.profiler is not None and self.profiler.arm(reason):
+            logging.info("observability: %s at round %d armed a one-shot "
+                         "profile window", reason, round_idx)
+
+    def round_begin(self, round_idx: int) -> None:
+        """Open the armed profiler window (if any) at a round start."""
+        if self.profiler is not None:
+            self.profiler.maybe_start(round_idx)
+
+    def round_end(self, round_idx: int,
+                  duration_s: Optional[float]) -> None:
+        """Close an open profile window and feed the slow-round
+        detector with this round's measured duration."""
+        if self.profiler is not None:
+            if self.profiler.maybe_stop(round_idx) \
+                    and self._timer is not None:
+                self._timer.count("obs_profiled_rounds")
+        if self.detector is not None and duration_s is not None:
+            threshold = self.detector.observe(duration_s)
+            if threshold is not None:
+                self.note_anomaly("slow_round", round_idx,
+                                  {"duration_s": round(duration_s, 6),
+                                   "threshold_s": round(threshold, 6)})
+
+    def close(self) -> None:
+        if self.profiler is not None:
+            self.profiler.close()
+        self.recorder.close()
+
+
+def build_observability(obs_dir: Optional[str], *,
+                        job_id: str = "job", rank: int = 0,
+                        role: str = "server",
+                        epoch: Optional[int] = None,
+                        anomaly_factor: float = 3.0,
+                        profile_on_anomaly: bool = True
+                        ) -> Optional[Observability]:
+    """The single constructor every launcher shares. ``obs_dir`` None
+    (the default everywhere) returns None — observability fully off,
+    byte-identical legacy behavior. Servers (``role="server"``) get the
+    detector + profiler; silos only record."""
+    if not obs_dir:
+        return None
+    recorder = FlightRecorder(obs_dir, job_id=job_id, rank=rank,
+                              epoch=epoch)
+    detector = profiler = None
+    if role == "server":
+        detector = RoundAnomalyDetector(factor=anomaly_factor)
+        import os
+        profiler = AnomalyProfiler(
+            os.path.join(obs_dir, "profiles") if profile_on_anomaly
+            else None)
+    return Observability(recorder, detector=detector, profiler=profiler)

@@ -1,0 +1,114 @@
+"""CLI: ``python -m fedml_tpu.obs <command>``.
+
+``merge`` — reconstruct one global round timeline from N flight logs::
+
+    python -m fedml_tpu.obs merge <dir-or-flight.jsonl ...> \
+        [--ledger ledger.jsonl] [--output merged.json] [--job_id JOB]
+
+Directories expand to every ``flight_rank*.jsonl`` inside (rotated
+segments are folded in automatically). ``--ledger`` cross-checks the
+merged per-round rows (cohort, reported set, partial flag) against the
+control-plane ledger and exits 1 on any mismatch — the acceptance
+oracle the chaos tests script. ``--output`` writes the merged timeline
+as JSON; without it a compact per-round summary prints to stdout.
+
+``registry`` — print the documented metric table (markdown) so the
+README "Observability" section can be regenerated instead of hand-kept.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _cmd_merge(args) -> int:
+    from fedml_tpu.obs.merge import check_against_ledger, merge_flight_logs
+    merged = merge_flight_logs(args.inputs, job_id=args.job_id)
+    problems: List[str] = []
+    if args.ledger:
+        rows = _read_ledger_file(args.ledger)
+        problems = check_against_ledger(merged, rows)
+        merged["ledger_check"] = {"ledger": args.ledger,
+                                  "rounds_checked": len(rows),
+                                  "mismatches": problems}
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(merged, f, indent=2)
+        print(f"wrote merged timeline ({len(merged['rounds'])} rounds, "
+              f"{len(merged['anomalies'])} anomalies) to {args.output}")
+    else:
+        for row in merged["rounds"]:
+            srv = row["server"] or {}
+            print(json.dumps({
+                "round": row["round"],
+                "cohort": srv.get("cohort"),
+                "reported": srv.get("reported"),
+                "partial": srv.get("partial"),
+                "duration_s": srv.get("duration_s"),
+                "silo_reports": len(row["silo_reports"]),
+                "silo_rounds": sorted(row["silo_rounds"]),
+                "anomalies": [a.get("reason") for a in row["anomalies"]],
+            }))
+    for p in problems:
+        print(f"LEDGER MISMATCH: {p}", file=sys.stderr)
+    if args.ledger:
+        print(f"ledger check: {len(problems)} mismatch(es) over "
+              f"{merged['ledger_check']['rounds_checked']} ledger rounds")
+    return 1 if problems else 0
+
+
+def _read_ledger_file(path: str):
+    """Ledger rows with the standard dedup (last occurrence per round
+    wins) and torn-line skip, without requiring the checkpoint dir."""
+    import logging
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                logging.warning("ledger %s: skipping torn line %r", path,
+                                line[:80])
+    by_round = {int(r["round"]): r for r in rows}
+    return [by_round[r] for r in sorted(by_round)]
+
+
+def _cmd_registry(_args) -> int:
+    from fedml_tpu.obs.registry import markdown_table
+    print(markdown_table())
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fedml_tpu.obs",
+        description="federation flight recorder tools")
+    sub = parser.add_subparsers(dest="command", required=True)
+    m = sub.add_parser("merge", help="merge N flight logs into one "
+                                     "global round timeline")
+    m.add_argument("inputs", nargs="+",
+                   help="flight log files and/or directories holding "
+                        "flight_rank*.jsonl")
+    m.add_argument("--ledger", type=str, default=None,
+                   help="cross-check cohort/reported/partial against "
+                        "this ledger.jsonl; exit 1 on mismatch")
+    m.add_argument("--output", type=str, default=None,
+                   help="write the merged timeline JSON here")
+    m.add_argument("--job_id", type=str, default=None,
+                   help="restrict the merge to one job id")
+    m.set_defaults(fn=_cmd_merge)
+    r = sub.add_parser("registry", help="print the documented metric "
+                                        "table (markdown)")
+    r.set_defaults(fn=_cmd_registry)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

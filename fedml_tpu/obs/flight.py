@@ -1,0 +1,236 @@
+"""The federation flight recorder — an append-only, crash-tolerant
+per-process telemetry log.
+
+Every process in a federation (server rank 0, each silo rank) writes one
+``flight_rank<rank>.jsonl`` next to the control-plane ledger: one JSON
+line per record, stamped with the cross-process correlation identity
+``(job_id, rank, epoch, seq)``. ``epoch`` reuses the reliable
+transport's per-endpoint-incarnation stream epoch (``comm/base.py``
+``WIRE_SEQ_KEY``): a restarted silo's flight records carry a NEW epoch,
+so the merge tool can tell its two lives apart exactly as the dedup
+layer tells their frames apart.
+
+Durability discipline (the same family as the control-plane ledger and
+the state store):
+
+- **atomic line writes** — a record is one ``write()`` of a complete
+  line, flushed; ``round``/``anomaly`` records (the crash oracle's
+  input) are additionally fsynced, while high-rate silo digest rows
+  ride the page cache so the receive thread never pays a disk sync per
+  heartbeat. A kill mid-write leaves at most one torn FINAL line,
+  which the reader skips exactly like the ledger reader;
+- **keep_last_n rotation** — when the live file reaches
+  ``rotate_lines`` records it is sealed via ``os.replace`` into a
+  numbered segment (``flight_rank0.000001.jsonl``) and segments beyond
+  ``keep_last_n`` are swept in sorted order, so the recorder is bounded
+  on disk no matter how long the schedule runs;
+- **never load-bearing** — every write path swallows ``OSError`` with a
+  logged warning: observability must be a pure observer, a full disk
+  cannot kill a round loop.
+
+Record kinds written by the wiring (unknown kinds round-trip freely):
+
+- ``round``   — a per-round snapshot-delta from ``RoundTimer.end_round``
+  (phases/counters/gauges for exactly that round, plus driver extras:
+  the cross-silo server adds cohort/reported/partial/evictions);
+- ``silo``    — the server's per-silo row for a round, built from the
+  compact counter digest piggybacked on replies/heartbeats plus the
+  server-measured report latency;
+- ``anomaly`` — a watchdog stall, slow round, or deadline extension
+  (``obs/anomaly.py``), written when the one-shot profiler arms.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+#: bumped when the record layout changes incompatibly
+FLIGHT_FORMAT = 1
+
+_SEGMENT_RE = re.compile(r"^(?P<stem>flight_rank\d+)\.(?P<seq>\d{6})\.jsonl$")
+
+
+class FlightRecorder:
+    """One process's append-only flight log (thread-safe)."""
+
+    def __init__(self, directory: str, *, job_id: str = "job",
+                 rank: int = 0, epoch: Optional[int] = None,
+                 rotate_lines: int = 20000, keep_last_n: int = 4):
+        import threading
+        self.directory = str(directory)
+        self.job_id = str(job_id)
+        self.rank = int(rank)
+        self.epoch = int(epoch) if epoch is not None else None
+        self.rotate_lines = max(1, int(rotate_lines))
+        self.keep_last_n = max(1, int(keep_last_n))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._lines = 0
+        self._disabled = False
+        #: persistent append handle — re-opening per record costs more
+        #: than the record on the server's receive thread
+        self._fh = None
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            # resume the live file's line count (a restarted server keeps
+            # appending to its previous life's log — the epoch stamp is
+            # what separates the two lives for readers)
+            if os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    self._lines = sum(1 for _ in f)
+        except OSError:
+            logging.warning("flight recorder disabled: cannot open %s",
+                            self.directory, exc_info=True)
+            self._disabled = True
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, f"flight_rank{self.rank}.jsonl")
+
+    def set_epoch(self, epoch: Optional[int]) -> None:
+        """Bind the transport endpoint's stream epoch once it exists
+        (the comm manager is constructed after the recorder)."""
+        if epoch is not None:
+            self.epoch = int(epoch)
+
+    # -- writing ------------------------------------------------------------
+    def append(self, record: Dict[str, Any]) -> None:
+        """Stamp and durably append one record. Never raises: a failed
+        write warns and drops the record (pure-observer contract)."""
+        if self._disabled:
+            return
+        with self._lock:
+            self._seq += 1
+            rec = {"format": FLIGHT_FORMAT, "job_id": self.job_id,
+                   "rank": self.rank, "epoch": self.epoch,
+                   "seq": self._seq,
+                   "t_wall": round(time.time(), 3), **record}
+            try:
+                line = json.dumps(rec, default=_json_default)
+            except (TypeError, ValueError):
+                logging.warning("flight record not serializable — dropped",
+                                exc_info=True)
+                return
+            try:
+                # one write() of a complete line + flush: a kill
+                # mid-write tears at most THIS line, never an earlier
+                # one. fsync is reserved for the records the crash
+                # oracle reads (round closes, anomalies) — the
+                # high-rate silo digest rows ride the page cache, so
+                # the server's receive thread never pays a disk sync
+                # per heartbeat.
+                if self._fh is None:
+                    self._fh = open(self.path, "a")
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                if record.get("kind") in ("round", "anomaly"):
+                    os.fsync(self._fh.fileno())
+                self._lines += 1
+                if self._lines >= self.rotate_lines:
+                    self._rotate_locked()
+            except OSError:
+                logging.warning("flight append to %s failed — record "
+                                "dropped", self.path, exc_info=True)
+
+    def close(self) -> None:
+        """Release the append handle (tests and short-lived tools; the
+        long-running recorders just hold it for the process lifetime)."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def _rotate_locked(self) -> None:
+        """Seal the live file into the next numbered segment
+        (``os.replace`` — atomic) and sweep segments beyond
+        ``keep_last_n`` in sorted order."""
+        if self._fh is not None:
+            # the handle points at the file being sealed; the next
+            # append reopens a fresh live file
+            self._fh.close()
+            self._fh = None
+        stem = f"flight_rank{self.rank}"
+        seqs = [int(m.group("seq"))
+                for m in (_SEGMENT_RE.match(fn)
+                          for fn in sorted(os.listdir(self.directory)))
+                if m and m.group("stem") == stem]
+        nxt = (max(seqs) + 1) if seqs else 1
+        sealed = os.path.join(self.directory,
+                              f"{stem}.{nxt:06d}.jsonl")
+        os.replace(self.path, sealed)
+        self._lines = 0
+        keep = set(sorted(seqs + [nxt])[-self.keep_last_n:])
+        for s in sorted(seqs):
+            if s not in keep:
+                try:
+                    os.remove(os.path.join(self.directory,
+                                           f"{stem}.{s:06d}.jsonl"))
+                except FileNotFoundError:
+                    pass
+
+
+def _json_default(v):
+    """Numpy scalars/arrays out of counter digests -> plain JSON."""
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        return v.item()
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    raise TypeError(f"not JSON serializable: {type(v).__name__}")
+
+
+# -- reading ----------------------------------------------------------------
+def read_flight_log(path: str) -> List[Dict[str, Any]]:
+    """Records of ONE rank's flight log, rotated segments first (oldest
+    to newest), then the live file. A torn final line — a kill mid-write
+    — is skipped with a warning, exactly like the ledger reader."""
+    live = Path(path)
+    stem = live.name[:-len(".jsonl")]
+    segs = []
+    if live.parent.is_dir():
+        for fn in sorted(os.listdir(live.parent)):
+            m = _SEGMENT_RE.match(fn)
+            if m and m.group("stem") == stem:
+                segs.append(live.parent / fn)
+    rows: List[Dict[str, Any]] = []
+    for p in [*segs, live]:
+        if not p.is_file():
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    logging.warning("flight log %s: skipping torn line %r",
+                                    p, line[:80])
+    return rows
+
+
+def flight_log_paths(directory: str) -> List[str]:
+    """One path per RANK under ``directory`` (sorted) — the merge
+    tool's default input when handed a directory. A rank whose live
+    file was rotated away (only sealed ``.NNNNNN.jsonl`` segments left,
+    e.g. the final append landed exactly on a rotation boundary) is
+    still listed by its live-file name: :func:`read_flight_log` folds
+    the segments in whether or not the live file exists."""
+    stems = set()
+    for fn in sorted(os.listdir(directory)):
+        if re.fullmatch(r"flight_rank\d+\.jsonl", fn):
+            stems.add(fn[:-len(".jsonl")])
+        else:
+            m = _SEGMENT_RE.match(fn)
+            if m:
+                stems.add(m.group("stem"))
+    return [os.path.join(directory, f"{stem}.jsonl")
+            for stem in sorted(stems)]

@@ -1,0 +1,122 @@
+"""Merge N per-process flight logs into one global round timeline.
+
+Each federation process records its own view: the server's flight log
+has the authoritative per-round rows (cohort, reported set, partial
+flag, counter deltas) plus per-silo digest rows; every silo's log has
+its local-train timings. The merge aligns them on ``(job_id, round)``
+— the cross-process span identity all records carry — into one
+timeline, and can cross-check the result against the control-plane
+``ledger.jsonl`` (the durable schedule trace): for every round both
+sides know, cohort / reported set / partial flag must agree exactly.
+
+``python -m fedml_tpu.obs merge <dir-or-logs...>`` is the CLI wrapper.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from fedml_tpu.obs.flight import (flight_log_paths, read_flight_log)
+
+
+def _resolve_paths(inputs: Sequence[str]) -> List[str]:
+    paths: List[str] = []
+    for p in inputs:
+        if os.path.isdir(p):
+            paths.extend(flight_log_paths(p))
+        else:
+            paths.append(p)
+    return sorted(set(paths))
+
+
+def merge_flight_logs(inputs: Sequence[str],
+                      job_id: Optional[str] = None) -> Dict[str, Any]:
+    """One global timeline from N flight logs (paths or directories).
+
+    Returns ``{"job_ids": [...], "rounds": [...], "anomalies": [...],
+    "unmatched": [...]}`` where each round row carries the server's
+    ``round`` record (``server``), every silo's own ``round`` record
+    (``silo_rounds``, keyed by rank), and the server-side per-silo
+    digest rows (``silo_reports``). ``job_id`` restricts the merge to
+    one job when several share a directory."""
+    records: List[Dict[str, Any]] = []
+    for path in _resolve_paths(inputs):
+        records.extend(read_flight_log(path))
+    if job_id is not None:
+        records = [r for r in records if r.get("job_id") == job_id]
+    job_ids = sorted({str(r.get("job_id")) for r in records})
+
+    rounds: Dict[int, Dict[str, Any]] = {}
+    anomalies: List[Dict[str, Any]] = []
+    unmatched: List[Dict[str, Any]] = []
+
+    def row(r: int) -> Dict[str, Any]:
+        return rounds.setdefault(int(r), {
+            "round": int(r), "server": None, "silo_rounds": {},
+            "silo_reports": [], "anomalies": []})
+
+    for rec in records:
+        kind = rec.get("kind")
+        r = rec.get("round")
+        if r is None:
+            unmatched.append(rec)
+            continue
+        if kind == "round":
+            if rec.get("rank") == 0:
+                prev = row(r)["server"]
+                # a failover re-close re-records the round: keep the
+                # LAST occurrence, the same dedup rule the ledger
+                # reader applies
+                if prev is None or (rec.get("t_wall", 0)
+                                    >= prev.get("t_wall", 0)):
+                    row(r)["server"] = rec
+            else:
+                row(r)["silo_rounds"][int(rec["rank"])] = rec
+        elif kind == "silo":
+            row(r)["silo_reports"].append(rec)
+        elif kind == "anomaly":
+            row(r)["anomalies"].append(rec)
+            anomalies.append(rec)
+        else:
+            unmatched.append(rec)
+
+    timeline = [rounds[r] for r in sorted(rounds)]
+    return {"job_ids": job_ids, "rounds": timeline,
+            "anomalies": anomalies, "unmatched": unmatched}
+
+
+def check_against_ledger(merged: Dict[str, Any],
+                         ledger_rows: Iterable[Dict[str, Any]]
+                         ) -> List[str]:
+    """Mismatch descriptions (empty = the merged timeline agrees with
+    the ledger). For every round present in BOTH, the server flight
+    row's cohort, reported set, and partial flag must equal the
+    ledger's; a ledger round with no server flight row is a gap (the
+    flight log rotated past it, or observability was off for part of
+    the run) and is reported as such."""
+    by_round = {int(r["round"]): r for r in ledger_rows}
+    flight_by_round = {row["round"]: row["server"]
+                       for row in merged["rounds"]
+                       if row.get("server") is not None}
+    problems: List[str] = []
+    for r in sorted(by_round):
+        led = by_round[r]
+        srv = flight_by_round.get(r)
+        if srv is None:
+            problems.append(f"round {r}: in ledger but no server flight "
+                            "row")
+            continue
+        for key in ("cohort", "reported", "partial"):
+            lv, fv = led.get(key), srv.get(key)
+            if key == "partial":
+                lv, fv = bool(lv), bool(fv)
+            if lv != fv:
+                problems.append(
+                    f"round {r}: {key} mismatch — ledger {lv!r} vs "
+                    f"flight {fv!r}")
+    for r in sorted(flight_by_round):
+        if r not in by_round:
+            problems.append(f"round {r}: server flight row with no "
+                            "ledger row")
+    return problems

@@ -1,0 +1,158 @@
+"""The documented metric registry — every RoundTimer name, machine-checked.
+
+``RoundTimer``'s phase/counter/gauge maps are ``defaultdict``s: a typo'd
+name at a ``timer.count(...)`` call site silently creates a NEW key and
+the intended series simply stops moving — the evidence rows look healthy
+while measuring nothing. This registry is the single source of truth for
+every metric name the tree may emit:
+
+- lint rule FT017 (``analysis/rules/metrics_names.py``) rejects any
+  ``timer.count/add/gauge/phase`` call whose LITERAL name is not
+  registered here, and rejects a registered name missing from the README
+  "Observability" metric table — the registry doubles as the
+  machine-checked README table, the same conformance pattern FT016 uses
+  for launcher flags;
+- the flight recorder and the merge tool treat these names as the
+  per-round timeline's schema (unknown keys still round-trip — the
+  registry constrains what the TREE emits, not what a log may carry).
+
+Adding a metric is a two-line change: one row here, one row in the
+README table. FT017 fails CI until both exist.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: metric kinds: how RoundTimer aggregates the series
+KIND_PHASE = "phase"      # wall-clock totals + call counts (timer.phase/add)
+KIND_COUNTER = "counter"  # monotone event counts (timer.count)
+KIND_GAUGE = "gauge"      # high-water marks, max-aggregated (timer.gauge)
+
+
+def _m(kind: str, subsystem: str, meaning: str) -> Dict[str, str]:
+    return {"kind": kind, "subsystem": subsystem, "meaning": meaning}
+
+
+#: name -> {kind, subsystem, meaning}. Sorted by family, then name.
+METRICS: Dict[str, Dict[str, str]] = {
+    # -- round phases (drivers: fedavg sim, mesh/SPMD, fused) --------------
+    "pack": _m(KIND_PHASE, "round pipeline",
+               "host-side cohort pack (pad-and-mask shard assembly)"),
+    "upload": _m(KIND_PHASE, "round pipeline",
+                 "H2D transfer of the packed cohort"),
+    "dispatch": _m(KIND_PHASE, "round pipeline",
+                   "device round dispatch (async enqueue of the jitted "
+                   "round program)"),
+    "device_wait": _m(KIND_PHASE, "round pipeline",
+                      "eval-boundary drain of pending device compute"),
+    "eval": _m(KIND_PHASE, "round pipeline",
+               "global train/test union evaluation"),
+    "prefetch_wait": _m(KIND_PHASE, "prefetch",
+                        "caller time blocked on an in-flight prefetch "
+                        "slot (pack latency NOT hidden by the pipeline)"),
+    # -- prefetch counters (parallel/prefetch.py) --------------------------
+    "prefetch_hit": _m(KIND_COUNTER, "prefetch",
+                       "round consumed a speculatively packed cohort"),
+    "prefetch_miss": _m(KIND_COUNTER, "prefetch",
+                        "round packed inline (cold start / misprediction "
+                        "/ dataset swap)"),
+    # -- wire accounting (comm backends via launch_federation) -------------
+    "comm_bytes_up": _m(KIND_COUNTER, "comm",
+                        "client->server wire bytes, actual encoded frame "
+                        "lengths"),
+    "comm_bytes_down": _m(KIND_COUNTER, "comm",
+                          "server->client wire bytes, actual encoded "
+                          "frame lengths"),
+    # -- fault tolerance (PR-5 layer; rolled up by launch_federation) ------
+    "ft_retries": _m(KIND_COUNTER, "fault tolerance",
+                     "transport send retries across every endpoint"),
+    "ft_dedup_drops": _m(KIND_COUNTER, "fault tolerance",
+                         "duplicate frames shed by receive-side "
+                         "[epoch, seq] dedup"),
+    "ft_conn_errors": _m(KIND_COUNTER, "fault tolerance",
+                         "connection-level errors observed by the "
+                         "transports"),
+    "ft_faults_injected": _m(KIND_COUNTER, "fault tolerance",
+                             "chaos-harness faults injected "
+                             "(comm/faults.py)"),
+    "ft_evictions": _m(KIND_COUNTER, "fault tolerance",
+                       "silos evicted from the live set (deadline miss "
+                       "or send failure)"),
+    "ft_rejoins": _m(KIND_COUNTER, "fault tolerance",
+                     "silos re-admitted to the live set (JOIN or a live "
+                     "reply)"),
+    "ft_partial_rounds": _m(KIND_COUNTER, "fault tolerance",
+                            "rounds closed with a weighted partial "
+                            "aggregate"),
+    "ft_stale_replies": _m(KIND_COUNTER, "fault tolerance",
+                           "replies for an already-closed round, "
+                           "discarded"),
+    "ft_corrupt_frames": _m(KIND_COUNTER, "fault tolerance",
+                            "replies that failed payload decode and were "
+                            "dropped"),
+    "ft_join_resyncs": _m(KIND_COUNTER, "fault tolerance",
+                          "full-precision mirror resyncs sent to "
+                          "rejoining silos"),
+    "ft_heartbeats": _m(KIND_COUNTER, "fault tolerance",
+                        "heartbeat messages the server processed"),
+    "ft_deadline_extensions": _m(KIND_COUNTER, "fault tolerance",
+                                 "below-quorum deadline extensions"),
+    # -- elastic control plane (PR-7 layer) --------------------------------
+    "cp_checkpoints": _m(KIND_COUNTER, "control plane",
+                         "server control-state snapshots saved"),
+    "cp_restores": _m(KIND_COUNTER, "control plane",
+                      "server control-state restores (failover resumes)"),
+    "cp_deadline_adjustments": _m(KIND_COUNTER, "control plane",
+                                  "pace-steering deadline/quorum changes"),
+    "cp_joins_throttled": _m(KIND_COUNTER, "control plane",
+                             "JOINs rejected with BACKPRESSURE by "
+                             "admission control"),
+    "cp_steered_deadline_s": _m(KIND_GAUGE, "control plane",
+                                "largest pace-steered round deadline"),
+    # -- tiered client-state store (state/store.py) ------------------------
+    "state_cache_hits": _m(KIND_COUNTER, "state store",
+                           "shard reads served from the resident LRU"),
+    "state_cache_misses": _m(KIND_COUNTER, "state store",
+                             "shard reads that faulted in from disk / "
+                             "the generator"),
+    "state_evictions": _m(KIND_COUNTER, "state store",
+                          "shards evicted from the resident LRU"),
+    "state_bytes_read": _m(KIND_COUNTER, "state store",
+                           "bytes faulted in from disk shards"),
+    "state_bytes_written": _m(KIND_COUNTER, "state store",
+                              "bytes spilled to disk shards"),
+    # -- host ---------------------------------------------------------------
+    "host_rss_peak_mb": _m(KIND_GAUGE, "host",
+                           "peak resident set size of this process (MB)"),
+    # -- observability (fedml_tpu/obs/) -------------------------------------
+    "obs_anomalies": _m(KIND_COUNTER, "observability",
+                        "anomaly records written to the flight log "
+                        "(slow round / stall / deadline extension); "
+                        "per-round attribution rides the anomaly "
+                        "record's own round field — a slow-round bump "
+                        "lands after end_round, i.e. in the next "
+                        "round's counter delta"),
+    "obs_profiled_rounds": _m(KIND_COUNTER, "observability",
+                              "rounds captured by an anomaly-armed "
+                              "one-shot jax.profiler window (bumped at "
+                              "the window's close, so the delta lands "
+                              "in the following round's record)"),
+}
+
+
+def metric_names() -> frozenset:
+    """Every registered metric name — the FT017 allow set."""
+    return frozenset(METRICS)
+
+
+def markdown_table() -> str:
+    """The registry as a GitHub markdown table (the README section's
+    generator — regenerate with ``python -m fedml_tpu.obs registry``)."""
+    rows = ["| metric | kind | subsystem | meaning |",
+            "|---|---|---|---|"]
+    for name in sorted(METRICS):
+        m = METRICS[name]
+        rows.append(f"| `{name}` | {m['kind']} | {m['subsystem']} | "
+                    f"{m['meaning']} |")
+    return "\n".join(rows)

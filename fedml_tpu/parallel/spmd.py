@@ -340,6 +340,10 @@ class DistributedFedAvgConfig:
     # exact round index. Engages only for partial participation (full
     # participation keeps the resident _pack_cache cohort).
     prefetch_depth: int = 2
+    # federation flight recorder (fedml_tpu/obs) — mirrors
+    # FedAvgConfig.obs_dir/job_id; None = off, pure observer when on
+    obs_dir: Optional[str] = None
+    job_id: Optional[str] = None
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     # model parallelism INSIDE each client slot: shard the model over a
     # second mesh axis — "tp" (Megatron, transformer models) or "fsdp"
@@ -430,6 +434,15 @@ class DistributedFedAvgAPI:
         self.history: List[Dict] = []
         from fedml_tpu.utils.tracing import RoundTimer
         self.timer = RoundTimer()  # pack/dispatch means, as FedAvgAPI
+        # observability (fedml_tpu/obs): per-round flight timeline +
+        # slow-round anomaly profiling; config.obs_dir None = off
+        from fedml_tpu.obs import build_observability
+        self._obs = build_observability(
+            getattr(self.config, "obs_dir", None),
+            job_id=getattr(self.config, "job_id", None) or "spmd",
+            rank=0, role="server")
+        if self._obs is not None:
+            self._obs.bind_timer(self.timer)
         # same-cohort device cache as FedAvgAPI._pack_cache: full
         # participation re-samples the identical set each round, so the
         # sharded x/y/mask/weights can stay resident across rounds
@@ -560,6 +573,11 @@ class DistributedFedAvgAPI:
                 pf[0].invalidate()
 
     def run_round(self, round_idx: int):
+        # flight-recorder round boundary (fedml_tpu/obs) — same pure-
+        # observer wiring as FedAvgAPI.run_round
+        self.timer.begin_round(round_idx)
+        if self._obs is not None:
+            self._obs.round_begin(round_idx)
         pf = self._round_prefetcher()
         if pf is not None:
             from fedml_tpu.parallel.prefetch import consume
@@ -596,6 +614,11 @@ class DistributedFedAvgAPI:
             else:
                 self.variables, stats = self._round_fn(
                     self.variables, xd, yd, maskd, keysd, wd)
+        rec = self.timer.end_round(
+            round_idx, extra={"cohort": [int(i) for i in idxs]})
+        if self._obs is not None:
+            self._obs.round_end(round_idx,
+                                rec["duration_s"] if rec else None)
         return idxs, stats
 
     def run_rounds_fused(self, r0: int, rounds: int, next_window=None):
@@ -746,6 +769,14 @@ class DistributedFedAvgAPI:
         of FusedRounds.train)."""
         from fedml_tpu.algorithms.fedavg import _normalized
         cfg = self.config
+        if self._obs is not None:
+            import logging
+            # same caveat as FedAvgAPI.fused_rounds: fused scans have no
+            # per-round host boundary to record
+            logging.warning(
+                "observability is on but train_fused dispatches whole "
+                "round blocks — no per-round flight records for fused "
+                "spans; use train() for per-round timelines")
         if cfg.comm_round <= 0:
             return self.history[-1] if self.history else {}
         freq = cfg.frequency_of_the_test

@@ -9,6 +9,19 @@ The reference's only tracing is wall-clock log lines
   its worker thread while the main thread times ``dispatch`` — overlapped
   phases record where time went, not critical-path wall-clock. Event
   counters (``count``) track prefetch hits/misses next to the phase means.
+  Every literal metric name must be registered in
+  ``fedml_tpu/obs/registry.py`` (lint rule FT017): the maps are
+  defaultdicts, so a typo'd name silently creates a new key.
+- **Per-round timeline** (the flight-recorder substrate): drivers call
+  ``begin_round(r)`` / ``end_round(r)`` around each round; end_round
+  computes the SNAPSHOT DELTA of every phase/counter since begin_round
+  (plus current gauge high-waters) into a per-round record held in a
+  bounded ring buffer (``round_records()``) and flushed to a bound
+  :class:`~fedml_tpu.obs.flight.FlightRecorder` when observability is
+  on. Counters bumped by OTHER threads mid-round (prefetch worker,
+  heartbeats) are charged to the round that was open — same overlap
+  semantics as the phase means. Begin/end never touch RNG, schedules,
+  or device state: timelines are a pure observer.
 - ``profile`` — context manager around ``jax.profiler.trace`` emitting a
   TensorBoard-loadable trace directory when enabled, a no-op otherwise.
 """
@@ -18,12 +31,12 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from collections import defaultdict
-from typing import Dict, Iterator, Optional
+from collections import defaultdict, deque
+from typing import Dict, Iterator, List, Optional
 
 
 class RoundTimer:
-    def __init__(self) -> None:
+    def __init__(self, ring_capacity: int = 512) -> None:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
         self.counters: Dict[str, int] = defaultdict(int)
@@ -31,6 +44,14 @@ class RoundTimer:
         #: ``host_rss_peak_mb`` and friends
         self.gauges: Dict[str, float] = {}
         self._lock = threading.Lock()
+        #: per-round records, newest last, bounded (multi-thousand-round
+        #: schedules must not grow host memory; the flight log is the
+        #: durable copy)
+        self._rounds: deque = deque(maxlen=max(1, int(ring_capacity)))
+        #: (round_idx, t0, phase-totals snapshot, phase-counts snapshot,
+        #: counter snapshot) for the open round
+        self._open_round = None
+        self._flight = None
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -91,6 +112,70 @@ class RoundTimer:
         """Server->client wire bytes (actual encoded frame lengths)."""
         with self._lock:
             return self.counters["comm_bytes_down"]
+
+    # -- the per-round timeline (fedml_tpu/obs flight-recorder substrate) --
+    def bind_flight(self, recorder) -> None:
+        """Flush every future ``end_round`` record through ``recorder``
+        (a :class:`~fedml_tpu.obs.flight.FlightRecorder`); None unbinds."""
+        with self._lock:
+            self._flight = recorder
+
+    def begin_round(self, round_idx: int) -> None:
+        """Open round ``round_idx``: snapshot every phase/counter so
+        ``end_round`` can attribute the deltas to this round. An
+        already-open round is silently superseded (a crashed server's
+        unfinished round must not poison its successor's record)."""
+        with self._lock:
+            self._open_round = (int(round_idx), time.perf_counter(),
+                                dict(self.totals), dict(self.counts),
+                                dict(self.counters))
+
+    def end_round(self, round_idx: int,
+                  extra: Optional[Dict] = None) -> Optional[Dict]:
+        """Close round ``round_idx``: the phase/counter deltas since
+        ``begin_round`` (and current gauge high-waters) become one
+        per-round record — appended to the ring buffer, flushed to the
+        bound flight recorder, and returned. Returns None (and resets)
+        on a round mismatch or when no round is open, so resumed /
+        partially-wired drivers degrade to no record instead of a wrong
+        one. ``extra`` keys (cohort, reported, partial, ...) are merged
+        into the record."""
+        with self._lock:
+            if self._open_round is None or self._open_round[0] != int(
+                    round_idx):
+                self._open_round = None
+                return None
+            _, t0, tot0, cnt0, ctr0 = self._open_round
+            self._open_round = None
+            duration = time.perf_counter() - t0
+            phases = {}
+            for k in sorted(self.totals):
+                ds = self.totals[k] - tot0.get(k, 0.0)
+                dn = self.counts[k] - cnt0.get(k, 0)
+                if dn or ds:
+                    phases[k] = {"s": round(ds, 6), "n": dn}
+            counters = {}
+            for k in sorted(self.counters):
+                d = self.counters[k] - ctr0.get(k, 0)
+                if d:
+                    counters[k] = d
+            rec = {"kind": "round", "round": int(round_idx),
+                   "duration_s": round(duration, 6), "phases": phases,
+                   "counters": counters,
+                   "gauges": {k: self.gauges[k]
+                              for k in sorted(self.gauges)}}
+            if extra:
+                rec.update(extra)
+            self._rounds.append(rec)
+            flight = self._flight
+        if flight is not None:
+            flight.append(rec)  # file I/O outside the timer lock
+        return rec
+
+    def round_records(self) -> List[Dict]:
+        """The ring buffer's per-round records, oldest first."""
+        with self._lock:
+            return list(self._rounds)
 
     def means(self) -> Dict[str, float]:
         with self._lock:

@@ -181,10 +181,15 @@ class RoundWatchdog:
     def __init__(self, timeout_s: float,
                  on_stall: Optional[Callable[[int, float], None]] = None,
                  poll_s: Optional[float] = None,
-                 liveness: Optional[SiloLivenessTable] = None):
+                 liveness: Optional[SiloLivenessTable] = None,
+                 obs=None):
         self.timeout_s = timeout_s
         self.on_stall = on_stall or self._log_stall
         self.liveness = liveness
+        #: observability bundle (fedml_tpu/obs): a stall writes an
+        #: ``anomaly`` flight record and arms the one-shot profiler for
+        #: the next round — "the federation stalled" self-documents
+        self.obs = obs
         self._poll_s = poll_s if poll_s is not None else max(
             0.05, timeout_s / 4)
         self._last_beat = time.monotonic()
@@ -247,6 +252,13 @@ class RoundWatchdog:
             # ft: allow[FT015] the watchdog exists to measure real elapsed time — stall detection cannot be derived from round indices
             if stalled > self.timeout_s:
                 self.stall_count += 1
+                if self.obs is not None:
+                    try:
+                        self.obs.note_anomaly(
+                            "stall", last_round,
+                            {"stalled_s": round(stalled, 3)})
+                    except Exception:  # noqa: BLE001 — watchdog must survive
+                        logging.exception("watchdog anomaly record failed")
                 if self.liveness is not None:
                     # per-silo breakdown turns "stalled" into "stalled
                     # BECAUSE silo k went dark at t"

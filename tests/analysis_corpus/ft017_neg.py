@@ -1,0 +1,13 @@
+"""FT017 negative: every literal metric name is registered; non-timer
+receivers and non-literal names are out of scope."""
+
+
+def roll_up(timer, hit, name, seen):
+    timer.count("ft_retries")
+    timer.count("prefetch_hit" if hit else "prefetch_miss")
+    timer.gauge("host_rss_peak_mb", 12.0)
+    timer.add("prefetch_wait", 0.25)
+    with timer.phase("dispatch"):
+        pass
+    timer.count(name)  # non-literal: aliasing limit, not checked
+    seen.add("not_a_metric_name")  # a set, not a timer receiver

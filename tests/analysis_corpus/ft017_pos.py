@@ -1,0 +1,9 @@
+"""FT017 positive: a typo'd metric name at a timer call site — the
+defaultdict silently creates a dead series instead of failing."""
+
+
+def roll_up(timer):
+    timer.count("ft_retrys")  # typo: the registry knows "ft_retries"
+    timer.gauge("host_rss_peek_mb", 12.0)
+    with timer.phase("dispach"):
+        pass

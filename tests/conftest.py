@@ -171,6 +171,44 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+# -- test-duration artifact -------------------------------------------------
+# ci/run_fast.sh sets $FEDML_TPU_TEST_DURATIONS=runs/test_durations.json:
+# the slowest-20 table becomes a DIFFABLE artifact instead of a ci/README
+# anecdote, so fast-lane time creep shows up in review as a number.
+_TEST_DURATIONS = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _TEST_DURATIONS.append((report.nodeid, report.duration))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    out = os.environ.get("FEDML_TPU_TEST_DURATIONS")
+    if not out or not _TEST_DURATIONS:
+        return
+    import json
+    import time
+    top = sorted(_TEST_DURATIONS, key=lambda kv: kv[1],
+                 reverse=True)[:20]
+    payload = {
+        "schema_version": 1,
+        "generated_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()),
+        "total_tests": len(_TEST_DURATIONS),
+        "total_call_s": round(sum(d for _, d in _TEST_DURATIONS), 3),
+        "slowest": [{"test": n, "duration_s": round(d, 3)}
+                    for n, d in top],
+    }
+    d = os.path.dirname(out)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{out}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, out)
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

@@ -1,0 +1,495 @@
+"""Federation flight recorder (fedml_tpu/obs) — per-round timelines,
+cross-process merge, anomaly-triggered profiling.
+
+Oracle strategy mirrors test_control_plane.py:
+
+- RoundTimer timeline mechanics under fire: concurrent phase/counter/
+  gauge bumps from three threads with EXACT totals, ring-buffer bounds,
+  begin/end mismatch degradation;
+- flight-log durability: torn final line skipped (the ledger reader's
+  rule), keep_last_n rotation, restart-append under a new epoch;
+- merge-tool alignment against a KNOWN synthetic chaos schedule, and
+  the ledger cross-check catching a planted divergence;
+- the acceptance core: a chaos-harness cross-silo run with
+  observability ON yields a merged timeline whose per-round rows agree
+  with ledger.jsonl, with the trajectory BIT-EXACT vs observability
+  OFF — the same pure-observer rule (and test pattern) as PR-7
+  checkpointing;
+- anomaly detector p90·k semantics + the profiler's one-shot arm/
+  cooldown contract (injected start/stop fns — no real jax traces).
+"""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg_cross_silo import run_fedavg_cross_silo
+from fedml_tpu.control import ServerControlCheckpointer
+from fedml_tpu.control.failover_harness import build_fixture
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.obs import (AnomalyProfiler, FlightRecorder, Observability,
+                           RoundAnomalyDetector, build_observability,
+                           check_against_ledger, merge_flight_logs,
+                           read_flight_log)
+from fedml_tpu.utils.tracing import RoundTimer
+
+
+def tree_equal(a, b):
+    fa, da = jax.tree.flatten(a)
+    fb, db = jax.tree.flatten(b)
+    assert da == db
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+class TestRoundTimerTimeline:
+    def test_concurrent_bumps_totals_exact(self):
+        """Prefetcher + heartbeat + main threads bump one timer; the
+        run-lifetime totals AND the per-round delta sum are exact."""
+        timer = RoundTimer()
+        n, per = 4, 500
+        timer.begin_round(0)
+
+        def worker(tid):
+            for i in range(per):
+                timer.count("prefetch_hit")
+                timer.add("prefetch_wait", 0.001)
+                timer.gauge("host_rss_peak_mb", float(tid * per + i))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rec = timer.end_round(0)
+        assert timer.counters["prefetch_hit"] == n * per
+        assert timer.counts["prefetch_wait"] == n * per
+        np.testing.assert_allclose(timer.totals["prefetch_wait"],
+                                   n * per * 0.001, rtol=1e-9)
+        # the gauge keeps the max across every thread
+        assert timer.gauges["host_rss_peak_mb"] == float(n * per - 1)
+        # the round delta charged everything to the open round
+        assert rec["counters"]["prefetch_hit"] == n * per
+        assert rec["phases"]["prefetch_wait"]["n"] == n * per
+
+    def test_snapshot_delta_is_per_round(self):
+        timer = RoundTimer()
+        timer.begin_round(0)
+        timer.count("ft_retries", 3)
+        r0 = timer.end_round(0)
+        timer.begin_round(1)
+        timer.count("ft_retries", 2)
+        r1 = timer.end_round(1)
+        assert r0["counters"]["ft_retries"] == 3
+        assert r1["counters"]["ft_retries"] == 2
+        assert timer.counters["ft_retries"] == 5
+        # zero-delta keys stay out of the record (compactness)
+        assert "prefetch_hit" not in r1["counters"]
+
+    def test_ring_buffer_bounded(self):
+        timer = RoundTimer(ring_capacity=8)
+        for r in range(50):
+            timer.begin_round(r)
+            timer.end_round(r)
+        recs = timer.round_records()
+        assert len(recs) == 8
+        assert [r["round"] for r in recs] == list(range(42, 50))
+
+    def test_mismatched_end_returns_none(self):
+        timer = RoundTimer()
+        assert timer.end_round(0) is None  # nothing open
+        timer.begin_round(3)
+        assert timer.end_round(4) is None  # wrong round: reset, no record
+        assert timer.round_records() == []
+        # a superseding begin wins over an unfinished round
+        timer.begin_round(5)
+        timer.begin_round(6)
+        assert timer.end_round(6) is not None
+
+
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_records_stamped_and_read_back(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), job_id="j1", rank=2,
+                             epoch=77)
+        rec.append({"kind": "round", "round": 0})
+        rec.append({"kind": "anomaly", "round": 1, "reason": "stall"})
+        rows = read_flight_log(rec.path)
+        assert [r["seq"] for r in rows] == [1, 2]
+        assert all(r["job_id"] == "j1" and r["rank"] == 2
+                   and r["epoch"] == 77 for r in rows)
+
+    def test_torn_final_line_skipped(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), rank=0)
+        rec.append({"kind": "round", "round": 0})
+        rec.append({"kind": "round", "round": 1})
+        with open(rec.path, "a") as f:
+            f.write('{"kind": "round", "round": 2, "trunc')  # kill mid-write
+        rows = read_flight_log(rec.path)
+        assert [r["round"] for r in rows] == [0, 1]
+
+    def test_rotation_keeps_last_n_and_reads_in_order(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), rank=0, rotate_lines=5,
+                             keep_last_n=2)
+        for r in range(23):
+            rec.append({"kind": "round", "round": r})
+        segs = [fn for fn in sorted(os.listdir(tmp_path))
+                if fn.startswith("flight_rank0.") and fn != "flight_rank0.jsonl"]
+        assert len(segs) == 2  # keep_last_n sweeps the older segments
+        rows = read_flight_log(rec.path)
+        # the retained window is contiguous and ends at the newest record
+        got = [r["round"] for r in rows]
+        assert got == list(range(got[0], 23))
+        assert len(got) >= 10  # two sealed segments + the live file
+
+    def test_rotated_away_live_file_still_merges(self, tmp_path):
+        """The final append landing exactly on a rotation boundary
+        leaves NO live file — only sealed segments. The rank must still
+        be discoverable and readable (a vanished server timeline is
+        exactly the failure the recorder exists to prevent)."""
+        from fedml_tpu.obs import flight_log_paths
+        rec = FlightRecorder(str(tmp_path), rank=0, rotate_lines=2,
+                             keep_last_n=4)
+        rec.append({"kind": "round", "round": 0})
+        rec.append({"kind": "round", "round": 1})  # seals; live file gone
+        assert not os.path.exists(rec.path)
+        paths = flight_log_paths(str(tmp_path))
+        assert paths == [rec.path]
+        assert [r["round"] for r in read_flight_log(rec.path)] == [0, 1]
+        merged = merge_flight_logs([str(tmp_path)])
+        assert [r["round"] for r in merged["rounds"]] == [0, 1]
+
+    def test_restart_appends_under_new_epoch(self, tmp_path):
+        a = FlightRecorder(str(tmp_path), rank=0, epoch=1)
+        a.append({"kind": "round", "round": 0})
+        b = FlightRecorder(str(tmp_path), rank=0, epoch=2)  # restart
+        b.append({"kind": "round", "round": 0})  # re-closed after restore
+        rows = read_flight_log(a.path)
+        assert [r["epoch"] for r in rows] == [1, 2]
+
+    def test_append_never_raises(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), rank=0)
+        rec.append({"bad": object()})  # unserializable: dropped, no raise
+        assert read_flight_log(rec.path) == []
+
+
+# ---------------------------------------------------------------------------
+def _plant_flight_logs(tmp_path, schedule):
+    """Synthesize server + 2 silo flight logs for a KNOWN chaos
+    schedule: ``schedule`` is [(round, cohort, reported, partial)]."""
+    srv = FlightRecorder(str(tmp_path), job_id="chaos", rank=0, epoch=9)
+    silos = {r: FlightRecorder(str(tmp_path), job_id="chaos", rank=r,
+                               epoch=100 + r) for r in (1, 2)}
+    for rnd, cohort, reported, partial in schedule:
+        for w in reported:
+            srv.append({"kind": "silo", "round": rnd,
+                        "silo_rank": w + 1, "event": "reply",
+                        "report_latency_s": 0.01,
+                        "digest": {"rounds_completed": rnd}})
+            silos[w + 1].append({"kind": "round", "round": rnd,
+                                 "client_idx": cohort[w],
+                                 "train_s": 0.02})
+        srv.append({"kind": "round", "round": rnd, "duration_s": 0.05,
+                    "phases": {}, "counters": {}, "gauges": {},
+                    "cohort": cohort, "reported": reported,
+                    "partial": partial, "evictions": 0})
+    return srv
+
+
+class TestMergeTool:
+    SCHEDULE = [
+        (0, [0, 1], [0, 1], False),
+        (1, [2, 3], [0], True),     # silo 2 missed the deadline
+        (2, [4, 5], [0, 1], False),  # rejoined
+    ]
+
+    def _ledger(self, tmp_path):
+        ckp = ServerControlCheckpointer(str(tmp_path / "ck"))
+        for rnd, cohort, reported, partial in self.SCHEDULE:
+            ckp.append_ledger({"round": rnd, "cohort": cohort,
+                               "reported": reported, "partial": partial,
+                               "deadline_s": 1.0})
+        return ckp
+
+    def test_merge_aligns_known_chaos_schedule(self, tmp_path):
+        _plant_flight_logs(tmp_path, self.SCHEDULE)
+        merged = merge_flight_logs([str(tmp_path)])
+        assert [r["round"] for r in merged["rounds"]] == [0, 1, 2]
+        r1 = merged["rounds"][1]
+        assert r1["server"]["partial"] is True
+        assert r1["server"]["reported"] == [0]
+        assert len(r1["silo_reports"]) == 1  # only silo 1 replied
+        assert sorted(r1["silo_rounds"]) == [1]
+        r2 = merged["rounds"][2]
+        assert sorted(r2["silo_rounds"]) == [1, 2]
+
+    def test_ledger_cross_check_clean_and_planted_divergence(
+            self, tmp_path):
+        _plant_flight_logs(tmp_path, self.SCHEDULE)
+        ckp = self._ledger(tmp_path)
+        merged = merge_flight_logs([str(tmp_path)])
+        assert check_against_ledger(merged, ckp.read_ledger()) == []
+        # plant a divergence: the ledger claims round 1 closed full
+        bad = [dict(r) for r in ckp.read_ledger()]
+        bad[1]["partial"] = False
+        bad[1]["reported"] = [0, 1]
+        problems = check_against_ledger(merged, bad)
+        assert len(problems) == 2
+        assert any("partial" in p for p in problems)
+        assert any("reported" in p for p in problems)
+
+    def test_failover_reclose_keeps_last_occurrence(self, tmp_path):
+        srv = _plant_flight_logs(tmp_path, self.SCHEDULE[:1])
+        # a restored server re-closes round 0 with a different reported
+        # set — the merge keeps the LAST row, like the ledger reader
+        srv.append({"kind": "round", "round": 0, "duration_s": 0.07,
+                    "cohort": [0, 1], "reported": [1],
+                    "partial": True, "evictions": 1})
+        merged = merge_flight_logs([str(tmp_path)])
+        assert merged["rounds"][0]["server"]["reported"] == [1]
+
+    def test_cli_merge_and_exit_codes(self, tmp_path):
+        import subprocess
+        import sys
+        _plant_flight_logs(tmp_path, self.SCHEDULE)
+        ckp = self._ledger(tmp_path)
+        out = tmp_path / "merged.json"
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "merge",
+             str(tmp_path), "--ledger", ckp.ledger_path,
+             "--output", str(out)],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert rc.returncode == 0, rc.stderr
+        merged = json.loads(out.read_text())
+        assert merged["ledger_check"]["mismatches"] == []
+        assert len(merged["rounds"]) == 3
+        # a mismatching ledger exits non-zero
+        with open(ckp.ledger_path, "a") as f:
+            f.write(json.dumps({"round": 9, "cohort": [1],
+                                "reported": [0], "partial": False}) + "\n")
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "merge",
+             str(tmp_path), "--ledger", ckp.ledger_path],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert rc.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+def _run_federation(ds, tcfg, **kw):
+    timer = RoundTimer()
+    model, history = run_fedavg_cross_silo(
+        ds, LogisticRegression(num_classes=3), worker_num=3, comm_round=3,
+        train_cfg=tcfg, timer=timer, **kw)
+    return jax.tree.map(np.asarray, model), history, timer
+
+
+class TestObservabilityIsAPureObserver:
+    """The acceptance core: chaos run with observability ON — merged
+    timeline agrees with ledger.jsonl, trajectory bit-exact vs OFF."""
+
+    #: seeded chaos: every silo reply frame is duplicated (the dedup
+    #: layer sheds the copies — deterministic, unlike timing-dependent
+    #: drop plans) — the flight log must still record every round once
+    CHAOS = "seed=4;duplicate:p=1.0,msg_type=4"
+
+    def test_chaos_run_obs_on_matches_ledger_and_off_trajectory(
+            self, tmp_path):
+        ds, _, tcfg = build_fixture(3)
+        clean, hist_c, _ = _run_federation(ds, tcfg,
+                                           fault_plan=self.CHAOS)
+        obs_dir = str(tmp_path / "obs")
+        ck_dir = str(tmp_path / "ck")
+        observed, hist_o, timer = _run_federation(
+            ds, tcfg, fault_plan=self.CHAOS, obs_dir=obs_dir,
+            server_checkpoint_dir=ck_dir, heartbeat_s=0.05)
+        # 1) pure observer: bit-exact trajectory + identical history
+        tree_equal(clean, observed)
+        assert hist_c == hist_o
+        # 2) every process wrote a flight log (server + 3 silos)
+        logs = sorted(fn for fn in os.listdir(obs_dir)
+                      if fn.endswith(".jsonl"))
+        assert logs == [f"flight_rank{r}.jsonl" for r in range(4)]
+        # 3) merged timeline rows agree with the control-plane ledger
+        merged = merge_flight_logs([obs_dir])
+        ledger = ServerControlCheckpointer(ck_dir).read_ledger()
+        assert len(ledger) == 3
+        assert check_against_ledger(merged, ledger) == []
+        # 4) per-silo correlation: every round has all 3 silo views,
+        #    each stamped with ITS endpoint epoch and a latency + digest
+        for row in merged["rounds"]:
+            assert sorted(row["silo_rounds"]) == [1, 2, 3]
+            replies = [s for s in row["silo_reports"]
+                       if s["event"] == "reply"]
+            assert {s["silo_rank"] for s in replies} == {1, 2, 3}
+            for s in replies:
+                assert s["report_latency_s"] >= 0
+                assert s["digest"]["epoch"] == next(
+                    r["epoch"] for r in row["silo_rounds"].values()
+                    if r["rank"] == s["silo_rank"])
+        # 5) the ring buffer carries the same 3 rounds
+        assert [r["round"] for r in timer.round_records()] == [0, 1, 2]
+
+    def test_sim_driver_timeline_and_parity(self, tmp_path):
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+        from fedml_tpu.data.synthetic import make_blob_federated
+        ds = make_blob_federated(client_num=4, dim=8, class_num=3,
+                                 n_samples=120, seed=3)
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        def run(obs_dir=None):
+            api = FedAvgAPI(ds, LogisticRegression(num_classes=3),
+                            config=FedAvgConfig(
+                                comm_round=3, client_num_per_round=2,
+                                seed=0, obs_dir=obs_dir,
+                                train=TrainConfig(epochs=1, batch_size=8,
+                                                  lr=0.3)))
+            for r in range(3):
+                api.run_round(r)
+            jax.block_until_ready(api.variables)
+            return jax.tree.map(np.asarray, api.variables), api
+
+        clean, _ = run()
+        obs_dir = str(tmp_path / "sim_obs")
+        observed, api = run(obs_dir=obs_dir)
+        tree_equal(clean, observed)
+        rows = read_flight_log(os.path.join(obs_dir,
+                                            "flight_rank0.jsonl"))
+        rounds = [r for r in rows if r["kind"] == "round"]
+        assert [r["round"] for r in rounds] == [0, 1, 2]
+        # cohorts recorded per round, and the dispatch phase has deltas
+        assert all(len(r["cohort"]) == 2 for r in rounds)
+        assert all(r["phases"].get("dispatch", {}).get("n") == 1
+                   for r in rounds)
+        assert len(api.timer.round_records()) == 3
+
+
+# ---------------------------------------------------------------------------
+class TestAnomalyDetection:
+    def test_detector_flags_beyond_factor_p90(self):
+        det = RoundAnomalyDetector(factor=3.0, min_rounds=8)
+        for _ in range(10):
+            assert det.observe(1.0) is None
+        assert det.observe(2.9) is None  # under 3x p90
+        thr = det.observe(30.0)
+        assert thr is not None and abs(thr - 3.0) < 0.2
+
+    def test_detector_quiet_before_min_rounds(self):
+        det = RoundAnomalyDetector(factor=3.0, min_rounds=8)
+        for _ in range(7):
+            det.observe(0.001)
+        assert det.observe(100.0) is None  # 8th observation: still warming
+
+    def test_profiler_one_shot_arm_and_cooldown(self, tmp_path):
+        started, stopped = [], []
+        prof = AnomalyProfiler(str(tmp_path), cooldown_rounds=5,
+                               start_fn=started.append,
+                               stop_fn=lambda: stopped.append(True))
+        assert not prof.maybe_start(0)  # not armed: no trace
+        assert prof.arm("slow_round")
+        assert not prof.arm("stall")    # already armed: one-shot latch
+        assert prof.maybe_start(1)
+        assert not prof.maybe_start(2)  # already tracing round 1
+        assert not prof.maybe_stop(2)   # wrong round
+        assert prof.maybe_stop(1)
+        assert prof.profiled_rounds == 1
+        # within the cooldown the next arm is dropped at start time
+        assert prof.arm("slow_round")
+        assert not prof.maybe_start(3)
+        # past the cooldown it fires again
+        assert prof.arm("slow_round")
+        assert prof.maybe_start(12)
+        assert prof.maybe_stop(12)
+        assert started == [os.path.join(str(tmp_path), "round_000001"),
+                           os.path.join(str(tmp_path), "round_000012")]
+        assert len(stopped) == 2
+
+    def test_observability_anomaly_records_and_counters(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), job_id="a", rank=0)
+        started = []
+        obs = Observability(
+            rec, detector=RoundAnomalyDetector(factor=3.0, min_rounds=4),
+            profiler=AnomalyProfiler(str(tmp_path / "prof"),
+                                     start_fn=started.append,
+                                     stop_fn=lambda: None))
+        timer = RoundTimer()
+        obs.bind_timer(timer)
+        for r in range(6):
+            obs.round_begin(r)
+            obs.round_end(r, 0.01)
+        obs.round_begin(6)
+        obs.round_end(6, 5.0)  # >3x p90: anomaly + arm
+        obs.round_begin(7)     # the armed window opens HERE
+        obs.round_end(7, 0.01)
+        rows = read_flight_log(rec.path)
+        anomalies = [r for r in rows if r["kind"] == "anomaly"]
+        assert len(anomalies) == 1
+        assert anomalies[0]["reason"] == "slow_round"
+        assert anomalies[0]["round"] == 6
+        assert timer.counters["obs_anomalies"] == 1
+        assert timer.counters["obs_profiled_rounds"] == 1
+        assert started and started[0].endswith("round_000007")
+
+    def test_watchdog_stall_writes_anomaly(self, tmp_path):
+        from fedml_tpu.utils.watchdog import RoundWatchdog
+        rec = FlightRecorder(str(tmp_path), job_id="w", rank=0)
+        obs = Observability(rec)
+        with RoundWatchdog(timeout_s=0.1, poll_s=0.05, obs=obs) as dog:
+            dog.heartbeat(4)
+            import time
+            deadline = time.monotonic() + 5.0
+            while dog.stall_count == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        rows = [r for r in read_flight_log(rec.path)
+                if r["kind"] == "anomaly"]
+        assert rows and rows[0]["reason"] == "stall"
+        assert rows[0]["round"] == 4
+        assert rows[0]["detail"]["stalled_s"] >= 0.1
+
+
+# ---------------------------------------------------------------------------
+class TestFailoverFlightLog:
+    def test_two_server_lives_one_log_distinct_epochs(self, tmp_path):
+        """The SIGKILL-shaped simulated failover with the flight
+        recorder on: both server incarnations append to ONE
+        flight_rank0.jsonl under DISTINCT transport epochs, and the
+        merged timeline still agrees with the (re-close-deduped)
+        ledger."""
+        from fedml_tpu.control.failover_harness import (
+            run_simulated_failover)
+        obs_dir = str(tmp_path / "obs")
+        _, ledger, _ = run_simulated_failover(
+            str(tmp_path / "ck"), rounds=5, crash_at_round=2,
+            obs_dir=obs_dir)
+        rows = read_flight_log(os.path.join(obs_dir,
+                                            "flight_rank0.jsonl"))
+        round_rows = [r for r in rows if r["kind"] == "round"]
+        assert sorted({r["round"] for r in round_rows}) == list(range(5))
+        epochs = {r["epoch"] for r in round_rows}
+        assert len(epochs) == 2  # phase-1 life + restored life
+        merged = merge_flight_logs([obs_dir])
+        assert len(ledger) == 5
+        assert check_against_ledger(merged, ledger) == []
+
+
+# ---------------------------------------------------------------------------
+class TestBuildObservability:
+    def test_none_dir_is_fully_off(self):
+        assert build_observability(None) is None
+        assert build_observability("") is None
+
+    def test_server_gets_detector_and_profiler(self, tmp_path):
+        obs = build_observability(str(tmp_path), job_id="j", rank=0,
+                                  role="server")
+        assert obs.detector is not None and obs.profiler is not None
+        silo = build_observability(str(tmp_path), job_id="j", rank=2,
+                                   role="silo")
+        assert silo.detector is None and silo.profiler is None
+        assert silo.recorder.rank == 2
