@@ -1552,6 +1552,64 @@ def _persist_partial(partial: dict) -> None:
     _write_artifact("bench_partial.json", partial)
 
 
+#: the append-only performance trajectory (fedml_tpu/obs/trend.py):
+#: one compact row per measured stage, keyed (stage, host_fingerprint),
+#: checked against the trailing median under --check-trend
+_TREND_LEDGER = os.path.join("runs", "trends.jsonl")
+
+
+def _trend_metrics(row: dict) -> "dict | None":
+    """The gated figures of one stage row (rounds/sec + bytes/round),
+    or None when the stage measured neither — error/skipped rows and
+    rows carried from a previous invocation (resumed / rerun_failed)
+    never enter the trajectory as fresh evidence."""
+    if not isinstance(row, dict) or "error" in row or "skipped" in row \
+            or "rerun_failed" in row or row.get("resumed"):
+        return None
+    rps = row.get("rounds_per_sec")
+    bpr = row.get("bytes_per_round_total")
+    if rps is None:
+        # leg-structured stages: gate on the leg whose regression
+        # matters (the compressed wire / the chaos-or-kill recovery leg)
+        for leg in ("policy_topk_ef_int8", "chaos", "kill"):
+            sub = row.get(leg)
+            if isinstance(sub, dict) \
+                    and sub.get("rounds_per_sec") is not None:
+                rps = sub["rounds_per_sec"]
+                if bpr is None:
+                    bpr = sub.get("bytes_per_round_total")
+                break
+    if rps is None and bpr is None:
+        return None
+    out = {}
+    if rps is not None:
+        out["rounds_per_sec"] = rps
+    if bpr is not None:
+        out["bytes_per_round"] = bpr
+    return out
+
+
+def _append_trend_row(stage_key: str, row: dict,
+                      host_tag: str) -> "list[str]":
+    """Append one stage's trend row and return its regression verdicts
+    (vs the ledger BEFORE the append — the new row must not feed its
+    own median). No-measurement stages are logged, not silently
+    skipped."""
+    from fedml_tpu.obs import trend
+    metrics = _trend_metrics(row)
+    if metrics is None:
+        _log(f"trend ledger: no gated metrics for {stage_key} — "
+             "no trajectory row")
+        return []
+    trow = trend.make_row(stage_key, metrics, host_tag=host_tag,
+                          run_id=_bench_run_id())
+    problems = trend.check_row(trend.load_rows(_TREND_LEDGER), trow)
+    trend.append_row(_TREND_LEDGER, trow)
+    for p in problems:
+        _log("TREND REGRESSION: " + p)
+    return problems
+
+
 #: the REAL stdout, captured before main() re-points sys.stdout at stderr
 #: so stray library prints can't corrupt the driver's parse (BENCH_r04 and
 #: r05 both landed `parsed: null`, VERDICT r5 #5): the contract line is
@@ -1757,6 +1815,8 @@ def _main_framed():
     smoke_only = "--smoke-chip" in sys.argv
     selected = _parse_stage_selection(sys.argv)
     resume = "--resume-partial" in sys.argv
+    check_trend = "--check-trend" in sys.argv
+    trend_problems: list = []
     timeout_s = int(os.environ.get("FEDML_BENCH_PROBE_TIMEOUT_S", 180))
     info = _probe_device(timeout_s)
     if "error" in info:
@@ -1856,6 +1916,10 @@ def _main_framed():
         ran_now.add(key)
         partial[key] = out
         _persist_partial(partial)
+        # trend trajectory: every freshly measured stage appends a
+        # compact row; regressions vs the trailing median are collected
+        # and (under --check-trend) turn the exit code non-zero
+        trend_problems.extend(_append_trend_row(key, out, host_tag))
         return partial[key]
 
     def tunnel_died(out) -> bool:
@@ -1888,7 +1952,7 @@ def _main_framed():
             "vs_baseline": None,
             "extra": {"smoke_chip": smoke, "mode": "--smoke-chip"},
         })
-        return 0
+        return _trend_verdict(check_trend, trend_problems)
 
     for key, name, fn, _aliases in _STAGES:
         if selected is not None and key not in selected:
@@ -1990,7 +2054,21 @@ def _main_framed():
         **_headline_provenance(flagship, ran_now),
         "extra": extra,
     }
+    if trend_problems:
+        extra["trend_regressions"] = trend_problems
     _emit(line)
+    return _trend_verdict(check_trend, trend_problems)
+
+
+def _trend_verdict(check_trend: bool, problems: "list[str]") -> int:
+    """--check-trend turns collected regressions into a non-zero exit;
+    without the flag they already traveled in the emit's extra (and the
+    ledger holds the row either way)."""
+    if not check_trend or not problems:
+        return 0
+    _log(f"--check-trend: {len(problems)} regression(s) vs the trend "
+         "ledger — failing")
+    return 1
 
 
 if __name__ == "__main__":

@@ -5,16 +5,35 @@
 # through the tiered client-state store; the 1M leg lives in the slow
 # lane + the population_scale bench stage), then the server-failover
 # smoke (~25 s — a real TCP server subprocess SIGKILLed mid-schedule,
-# restarted, and required to finish with cp_restores >= 1 and a
-# ledger matching the unkilled reference), then unit + integration
-# tests on 8 virtual CPU devices, ~7 min.
+# restarted, and required to finish with cp_restores >= 1 and a ledger
+# matching the unkilled reference) now recording a flight log that
+# `obs merge --ledger` must rebuild cleanly (a real two-epoch SIGKILL
+# log, artifact under runs/obs_smoke/), then unit + integration tests
+# on 8 virtual CPU devices, ~7 min, followed by the SOFT-FAIL trend
+# lane: the session's trend-ledger rows (bench stages + the pytest
+# tests/sec row this run just appended) are checked against their
+# trailing medians — regressions WARN while the trajectory builds;
+# flip to a hard gate once runs/trends.jsonl has history.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./ci/run_static.sh
 JAX_PLATFORMS=cpu python -m fedml_tpu.state.population \
     --population 100000 --rounds 2 --cohort 10
-JAX_PLATFORMS=cpu python -m fedml_tpu.control.failover_harness --smoke
+rm -rf runs/obs_smoke && mkdir -p runs/obs_smoke
+JAX_PLATFORMS=cpu python -m fedml_tpu.control.failover_harness --smoke \
+    --ckpt_dir runs/obs_smoke --obs_dir runs/obs_smoke/flight
+JAX_PLATFORMS=cpu python -m fedml_tpu.obs merge runs/obs_smoke/flight \
+    --ledger runs/obs_smoke/killed/ledger.jsonl \
+    --output runs/obs_smoke/merged.json
 # slowest-20 artifact (tests/conftest.py sessionfinish hook): fast-lane
-# time creep becomes a diffable runs/ number instead of a README anecdote
+# time creep becomes a diffable runs/ number instead of a README
+# anecdote — AND a trend-ledger row, so creep regresses like a bench
 export FEDML_TPU_TEST_DURATIONS="runs/test_durations.json"
-exec python -m pytest tests/ -q -m "not slow" "$@"
+export FEDML_TPU_TREND_LEDGER="runs/trends.jsonl"
+rc=0
+python -m pytest tests/ -q -m "not slow" "$@" || rc=$?
+JAX_PLATFORMS=cpu python -m fedml_tpu.obs trend runs/trends.jsonl \
+    --check-latest \
+    || echo "WARNING: performance trend regression (soft-fail lane;" \
+            "see runs/trends.jsonl)" >&2
+exit "$rc"

@@ -368,6 +368,18 @@ class FedAvgAPI:
             self._obs.round_begin(round_idx)
         idxs, (x, y, mask, keys, weights, agg_key) = \
             self._host_round_inputs(round_idx)
+        if self._obs is not None:
+            # one-shot roofline probe (obs/perf.py): the analytic FLOP
+            # count of THE round program about to dispatch, traced from
+            # the live inputs BEFORE any donation invalidates them.
+            # Tracing touches no RNG/device state — a pure observer.
+            from fedml_tpu.utils.flops import analytic_flops
+            fn = getattr(self, "_round_fn_py", None) or self._round_fn
+            self._obs.probe_round_flops(
+                lambda: analytic_flops(fn, self.variables, x, y, mask,
+                                       keys, weights, agg_key,
+                                       jnp.uint32(round_idx)),
+                source="analytic_conv_gn_jaxpr")
         with self.timer.phase("dispatch"):
             self.variables, stats = self._round_fn(self.variables, x, y,
                                                    mask, keys, weights,
@@ -377,7 +389,8 @@ class FedAvgAPI:
             round_idx, extra={"cohort": [int(i) for i in idxs]})
         if self._obs is not None:
             self._obs.round_end(round_idx,
-                                rec["duration_s"] if rec else None)
+                                rec["duration_s"] if rec else None,
+                                record=rec)
         return idxs, stats
 
     # -- the outer loop (reference fedavg_api.py:46-95) ---------------------

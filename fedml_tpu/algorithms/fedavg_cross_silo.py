@@ -275,6 +275,12 @@ class FedAvgServerManager(ServerManager):
         #: observability bundle (fedml_tpu/obs) — bound by the launcher
         #: alongside round_timer; None = flight recorder off (default)
         self.obs = None
+        #: cumulative transport bytes already credited into the round
+        #: timer (pure-observer accounting, NOT schedule state: a
+        #: restored server starts a fresh endpoint whose counters reset,
+        #: so these deliberately stay out of the checkpoint manifest)
+        self._wire_credited_up = 0
+        self._wire_credited_down = 0
         #: terminal latch: set (with a FINISH sweep) when the schedule
         #: cannot make progress; launch_federation re-raises it
         self.scheduling_error: Optional[Exception] = None
@@ -756,6 +762,26 @@ class FedAvgServerManager(ServerManager):
         if self.aggregator.check_whether_all_receive():
             self._close_round()
 
+    def _credit_wire_bytes(self) -> None:
+        """Credit the transport endpoint's CUMULATIVE byte counters into
+        the round timer as deltas since the last credit. Called at every
+        round close (per-round wire accounting for the flight deck) and
+        once more by the launcher after FINISH (the remainder), so the
+        run totals stay exactly the endpoint's totals."""
+        tm = getattr(self, "round_timer", None)
+        if tm is None:
+            return
+        sent = int(getattr(self.com_manager, "bytes_sent", 0))
+        recv = int(getattr(self.com_manager, "bytes_received", 0))
+        d_down, self._wire_credited_down = (sent - self._wire_credited_down,
+                                            sent)
+        d_up, self._wire_credited_up = (recv - self._wire_credited_up,
+                                        recv)
+        if d_down:
+            tm.count("comm_bytes_down", d_down)
+        if d_up:
+            tm.count("comm_bytes_up", d_up)
+
     def _close_round(self, partial: bool = False) -> None:
         """Aggregate (full or weighted-partial), advance, broadcast the
         next round or FINISH. Shared by the strict barrier, the
@@ -786,7 +812,11 @@ class FedAvgServerManager(ServerManager):
         # flight-recorder round close: the snapshot-delta record carries
         # the SAME cohort/reported/partial row the ledger will get, so
         # the merge tool can cross-check the two; the measured duration
-        # feeds the slow-round anomaly detector
+        # feeds the slow-round anomaly detector. Wire bytes are credited
+        # as deltas-since-last-close FIRST, so the record's counter
+        # delta is this round's real wire traffic (obs/perf.py derives
+        # wire_bytes_per_sec from exactly this).
+        self._credit_wire_bytes()
         tm = getattr(self, "round_timer", None)
         round_rec = None
         if tm is not None:
@@ -798,9 +828,13 @@ class FedAvgServerManager(ServerManager):
                 "partial": bool(partial),
                 "evictions": int(self.liveness.evictions)})
         if self.obs is not None:
+            # the record pass feeds the perf accountant (obs/perf.py):
+            # the server derives wire bytes/s + memory watermarks per
+            # round (MFU stays silo-side — the server only aggregates)
             self.obs.round_end(
                 self.round_idx,
-                round_rec["duration_s"] if round_rec else None)
+                round_rec["duration_s"] if round_rec else None,
+                record=round_rec)
         deadline_used = self.round_deadline_s
         self.round_idx += 1
         if self.checkpoint_mgr is not None:
@@ -1761,11 +1795,19 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     # ACTUAL encoded frame lengths, not array-size estimates. (Quorum's
     # self-addressed TIMEOUT ticks ride the same endpoint; they are tens
     # of bytes against multi-KB..MB model frames.) Backends without a
-    # wire (inproc with wire_codec=False) report 0.
-    server.round_timer.count("comm_bytes_down",
-                             int(getattr(server_com, "bytes_sent", 0)))
-    server.round_timer.count("comm_bytes_up",
-                             int(getattr(server_com, "bytes_received", 0)))
+    # wire (inproc with wire_codec=False) report 0. Round-based servers
+    # credit per-round deltas at every close (_credit_wire_bytes — the
+    # flight deck's per-round wire rates); this final credit picks up
+    # only the remainder (FINISH sweep, last replies), so the run total
+    # equals the endpoint total either way.
+    if hasattr(server, "_credit_wire_bytes"):
+        server._credit_wire_bytes()
+    else:
+        server.round_timer.count("comm_bytes_down",
+                                 int(getattr(server_com, "bytes_sent", 0)))
+        server.round_timer.count("comm_bytes_up",
+                                 int(getattr(server_com,
+                                             "bytes_received", 0)))
     # fault-tolerance roll-up: transport counters (retries, dedup drops,
     # injected faults) summed over EVERY endpoint, protocol counters
     # (evictions, rejoins, corrupt frames, partial closes) from the

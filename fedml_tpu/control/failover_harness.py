@@ -396,7 +396,8 @@ def ledger_schedule(ledger: List[Dict]) -> List[Tuple[int, Tuple[int, ...]]]:
 
 
 # ---------------------------------------------------------------------------
-def _smoke(tmp_root: Optional[str]) -> int:
+def _smoke(tmp_root: Optional[str],
+           obs_dir: Optional[str] = None) -> int:
     import tempfile
     root = tmp_root or tempfile.mkdtemp(prefix="fedml_failover_smoke_")
     ref_dir = os.path.join(root, "reference")
@@ -406,8 +407,12 @@ def _smoke(tmp_root: Optional[str]) -> int:
     ref_model, ref_ledger, _ = run_simulated_failover(
         ref_dir, rounds=6, crash_at_round=10**9, backend="TCP",
         port_base=40210, deadline_s=5.0)
+    # the kill leg records a flight log when asked (--obs_dir): both
+    # SIGKILL server lives append under distinct epochs — the CI lane
+    # then runs `obs merge --ledger` against exactly this log
     res = run_failover_scenario(kill_dir, rounds=6, kill_after_round=2,
-                                port_base=40230, deadline_s=2.0)
+                                port_base=40230, deadline_s=2.0,
+                                obs_dir=obs_dir)
     ok = (res["summary"].get("done") is True
           and res["summary"].get("cp_counters", {}).get("restores", 0) >= 1
           and ledger_schedule(res["ledger"]) == ledger_schedule(ref_ledger))
@@ -453,7 +458,7 @@ def main(argv=None) -> int:
                      min_quorum_frac=args.min_quorum_frac, pace=args.pace,
                      join_rate_limit=args.join_rate_limit,
                      obs_dir=args.obs_dir)
-    return _smoke(args.ckpt_dir)
+    return _smoke(args.ckpt_dir, obs_dir=args.obs_dir)
 
 
 if __name__ == "__main__":

@@ -15,6 +15,10 @@ Three layers (see ISSUE/README "Observability"):
 3. **Anomaly-triggered profiling** — watchdog/pace/slow-round signals
    write ``anomaly`` records and arm a one-shot ``jax.profiler`` window
    for the next round (:class:`AnomalyProfiler`).
+4. **Roofline/MFU accounting** — every closed round additionally
+   derives a ``perf`` record (:mod:`fedml_tpu.obs.perf`): MFU against
+   the documented device-peak table, comm/compute overlap fraction,
+   wire bytes/s, and best-effort device memory watermarks.
 
 Observability is a PURE OBSERVER: with it on, trajectories are
 bit-exact vs off (tested the same way as control-plane checkpointing);
@@ -30,14 +34,16 @@ from fedml_tpu.obs.anomaly import AnomalyProfiler, RoundAnomalyDetector
 from fedml_tpu.obs.flight import (FLIGHT_FORMAT, FlightRecorder,
                                   flight_log_paths, read_flight_log)
 from fedml_tpu.obs.merge import check_against_ledger, merge_flight_logs
+from fedml_tpu.obs.perf import (PerfAccountant, derive_perf_record,
+                                device_peak_flops)
 from fedml_tpu.obs.registry import METRICS, metric_names
 
 __all__ = [
     "AnomalyProfiler", "FlightRecorder", "Observability",
-    "RoundAnomalyDetector", "FLIGHT_FORMAT", "METRICS",
-    "build_observability", "check_against_ledger", "endpoint_epoch",
-    "flight_log_paths", "merge_flight_logs", "metric_names",
-    "read_flight_log",
+    "PerfAccountant", "RoundAnomalyDetector", "FLIGHT_FORMAT", "METRICS",
+    "build_observability", "check_against_ledger", "derive_perf_record",
+    "device_peak_flops", "endpoint_epoch", "flight_log_paths",
+    "merge_flight_logs", "metric_names", "read_flight_log",
 ]
 
 
@@ -60,11 +66,21 @@ class Observability:
 
     def __init__(self, recorder: FlightRecorder,
                  detector: Optional[RoundAnomalyDetector] = None,
-                 profiler: Optional[AnomalyProfiler] = None):
+                 profiler: Optional[AnomalyProfiler] = None,
+                 perf: Optional[PerfAccountant] = None):
         self.recorder = recorder
         self.detector = detector
         self.profiler = profiler
+        self.perf = perf
         self._timer = None
+
+    def probe_round_flops(self, thunk, source: str = "analytic_flops"
+                          ) -> None:
+        """Hand the perf accountant its one-shot round-FLOP probe (the
+        driver builds the thunk over its real round program + inputs;
+        a no-op when perf accounting is off or already probed)."""
+        if self.perf is not None:
+            self.perf.probe_flops_once(thunk, source)
 
     def bind_timer(self, timer) -> None:
         self._timer = timer
@@ -92,13 +108,26 @@ class Observability:
             self.profiler.maybe_start(round_idx)
 
     def round_end(self, round_idx: int,
-                  duration_s: Optional[float]) -> None:
-        """Close an open profile window and feed the slow-round
-        detector with this round's measured duration."""
+                  duration_s: Optional[float],
+                  record: Optional[Dict[str, Any]] = None) -> None:
+        """Close an open profile window, derive+flush the round's
+        ``perf`` record from the closed round record (when perf
+        accounting is on and the driver passed one), and feed the
+        slow-round detector with the measured duration."""
         if self.profiler is not None:
             if self.profiler.maybe_stop(round_idx) \
                     and self._timer is not None:
                 self._timer.count("obs_profiled_rounds")
+        if self.perf is not None and record is not None:
+            perf_rec = self.perf.derive(record)
+            if perf_rec is not None:
+                self.recorder.append(perf_rec)
+                if self._timer is not None \
+                        and "device_mem_peak_mb" in perf_rec:
+                    # the HBM watermark is a real gauge: keep its
+                    # high-water on the same evidence rows as host RSS
+                    self._timer.gauge("device_mem_peak_mb",
+                                      perf_rec["device_mem_peak_mb"])
         if self.detector is not None and duration_s is not None:
             threshold = self.detector.observe(duration_s)
             if threshold is not None:
@@ -117,21 +146,28 @@ def build_observability(obs_dir: Optional[str], *,
                         role: str = "server",
                         epoch: Optional[int] = None,
                         anomaly_factor: float = 3.0,
-                        profile_on_anomaly: bool = True
+                        profile_on_anomaly: bool = True,
+                        perf_accounting: bool = True,
+                        perf_device_count: int = 1
                         ) -> Optional[Observability]:
     """The single constructor every launcher shares. ``obs_dir`` None
     (the default everywhere) returns None — observability fully off,
     byte-identical legacy behavior. Servers (``role="server"``) get the
-    detector + profiler; silos only record."""
+    detector + profiler plus the roofline/MFU accountant
+    (``obs/perf.py``; ``perf_device_count`` scales the per-device peak
+    to the mesh the round program spans); silos only record."""
     if not obs_dir:
         return None
     recorder = FlightRecorder(obs_dir, job_id=job_id, rank=rank,
                               epoch=epoch)
-    detector = profiler = None
+    detector = profiler = perf = None
     if role == "server":
         detector = RoundAnomalyDetector(factor=anomaly_factor)
         import os
         profiler = AnomalyProfiler(
             os.path.join(obs_dir, "profiles") if profile_on_anomaly
             else None)
-    return Observability(recorder, detector=detector, profiler=profiler)
+        if perf_accounting:
+            perf = PerfAccountant(device_count=perf_device_count)
+    return Observability(recorder, detector=detector, profiler=profiler,
+                         perf=perf)

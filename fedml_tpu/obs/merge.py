@@ -30,19 +30,12 @@ def _resolve_paths(inputs: Sequence[str]) -> List[str]:
     return sorted(set(paths))
 
 
-def merge_flight_logs(inputs: Sequence[str],
-                      job_id: Optional[str] = None) -> Dict[str, Any]:
-    """One global timeline from N flight logs (paths or directories).
-
-    Returns ``{"job_ids": [...], "rounds": [...], "anomalies": [...],
-    "unmatched": [...]}`` where each round row carries the server's
-    ``round`` record (``server``), every silo's own ``round`` record
-    (``silo_rounds``, keyed by rank), and the server-side per-silo
-    digest rows (``silo_reports``). ``job_id`` restricts the merge to
-    one job when several share a directory."""
-    records: List[Dict[str, Any]] = []
-    for path in _resolve_paths(inputs):
-        records.extend(read_flight_log(path))
+def fold_records(records: Sequence[Dict[str, Any]],
+                 job_id: Optional[str] = None) -> Dict[str, Any]:
+    """The merge fold: N flight-log record streams (already read, in
+    per-rank file order) -> one global timeline. Shared verbatim by the
+    offline merge and the live tail (``obs/tail.py``), so the tail's
+    reconstructed table IS the merge ground truth by construction."""
     if job_id is not None:
         records = [r for r in records if r.get("job_id") == job_id]
     job_ids = sorted({str(r.get("job_id")) for r in records})
@@ -53,8 +46,8 @@ def merge_flight_logs(inputs: Sequence[str],
 
     def row(r: int) -> Dict[str, Any]:
         return rounds.setdefault(int(r), {
-            "round": int(r), "server": None, "silo_rounds": {},
-            "silo_reports": [], "anomalies": []})
+            "round": int(r), "server": None, "perf": None,
+            "silo_rounds": {}, "silo_reports": [], "anomalies": []})
 
     for rec in records:
         kind = rec.get("kind")
@@ -73,6 +66,13 @@ def merge_flight_logs(inputs: Sequence[str],
                     row(r)["server"] = rec
             else:
                 row(r)["silo_rounds"][int(rec["rank"])] = rec
+        elif kind == "perf":
+            # the round's derived roofline record (obs/perf.py) — same
+            # keep-last rule as the server round row it derives from
+            prev = row(r)["perf"]
+            if prev is None or (rec.get("t_wall", 0)
+                                >= prev.get("t_wall", 0)):
+                row(r)["perf"] = rec
         elif kind == "silo":
             row(r)["silo_reports"].append(rec)
         elif kind == "anomaly":
@@ -84,6 +84,23 @@ def merge_flight_logs(inputs: Sequence[str],
     timeline = [rounds[r] for r in sorted(rounds)]
     return {"job_ids": job_ids, "rounds": timeline,
             "anomalies": anomalies, "unmatched": unmatched}
+
+
+def merge_flight_logs(inputs: Sequence[str],
+                      job_id: Optional[str] = None) -> Dict[str, Any]:
+    """One global timeline from N flight logs (paths or directories).
+
+    Returns ``{"job_ids": [...], "rounds": [...], "anomalies": [...],
+    "unmatched": [...]}`` where each round row carries the server's
+    ``round`` record (``server``), its derived roofline record
+    (``perf``), every silo's own ``round`` record (``silo_rounds``,
+    keyed by rank), and the server-side per-silo digest rows
+    (``silo_reports``). ``job_id`` restricts the merge to one job when
+    several share a directory."""
+    records: List[Dict[str, Any]] = []
+    for path in _resolve_paths(inputs):
+        records.extend(read_flight_log(path))
+    return fold_records(records, job_id=job_id)
 
 
 def check_against_ledger(merged: Dict[str, Any],

@@ -1,0 +1,232 @@
+"""Roofline/MFU accounting — the flight recorder's DERIVED performance leg.
+
+PR 10's ``round`` records carry raw phase/counter deltas; this module
+turns each closed round into the evidence the ROADMAP items actually
+ask for (measured MFU for the multi-chip SPMD item, per-job wire rates
+for the tenancy item):
+
+- **MFU** — achieved FLOP/s (the round program's analytic FLOP count,
+  ``utils/flops.analytic_flops`` — PR 2's conv/GroupNorm jaxpr cost
+  model — divided by the measured round duration) over the device
+  fleet's peak. Peak resolves per ``device_kind`` from the documented
+  table below (bf16 peak, the same convention bench.py reports against
+  — conservative for f32 programs), times the local device count;
+  ``$FEDML_TPU_PEAK_FLOPS`` overrides the PER-DEVICE figure. CPU or
+  unknown device: no peak, MFU omitted — never a guess.
+- **comm/compute overlap** — the fraction of host pack+upload work the
+  round pipeline hid behind device compute: with a prefetch hit the
+  caller pays only ``prefetch_wait``, so
+  ``hidden = pack + upload − prefetch_wait`` and the frac is
+  ``hidden / (pack + upload)``; a serial round (no ``prefetch_hit``
+  delta) hides nothing and reads 0.0.
+- **wire rates** — ``comm_bytes_up``/``comm_bytes_down`` counter deltas
+  over the round duration (bytes/s, actual encoded frame lengths).
+- **device memory watermarks** — best-effort
+  ``jax.local_devices()[i].memory_stats()`` high-waters in MB. The CPU
+  backend exposes no memory_stats: the gauges are simply omitted,
+  never an exception (the same degrade rule as every obs write path).
+
+Every derived field name is registered in ``obs/registry.py`` (kind
+``derived``) so FT017 pins the names to the documented table; the
+record flushes as ``kind="perf"`` per round, right after the ``round``
+record it derives from. Derivation reads ONLY the closed round record
+plus static facts (flops, peak) — a pure observer by construction, and
+:func:`derive_perf_record` is a pure function tested against a
+hand-computed oracle.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, Optional
+
+#: bf16 peak FLOP/s PER CHIP by device_kind substring (public specs;
+#: the same table bench.py reports MFU against, in FLOP/s not TFLOP/s).
+#: First substring match wins, so v5p must precede v5.
+PEAK_FLOPS_TABLE = [
+    ("v6", 918.0e12),
+    ("v5p", 459.0e12),
+    ("v5", 197.0e12),
+    ("v4", 275.0e12),
+    ("v3", 61.4e12),
+    ("v2", 23.0e12),
+]
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Peak FLOP/s of ONE device: the ``$FEDML_TPU_PEAK_FLOPS`` override
+    when set (per-device figure), else the documented table keyed by
+    ``device_kind`` substring. None for CPU/unknown kinds — MFU against
+    a made-up peak is worse than no MFU."""
+    env = os.environ.get("FEDML_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            logging.warning("ignoring unparseable $FEDML_TPU_PEAK_FLOPS=%r",
+                            env)
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        kind = device.device_kind.lower()
+    except Exception:  # ft: allow[FT005] best-effort probe: no backend -> no peak, never a crash
+        return None
+    for key, peak in PEAK_FLOPS_TABLE:
+        if key in kind:
+            return peak
+    return None
+
+
+def device_memory_gauges() -> Optional[Dict[str, float]]:
+    """HBM watermarks in MB across the local devices, or None when the
+    backend exposes no ``memory_stats`` (the CPU backend returns None /
+    raises) — the gauge is omitted, never an exception."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # ft: allow[FT005] best-effort probe: no backend -> no gauges
+        return None
+    in_use = peak = None
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # ft: allow[FT005] per-device degrade: one dead device must not kill the gauge
+            stats = None
+        if not stats:
+            continue
+        biu = stats.get("bytes_in_use")
+        pbiu = stats.get("peak_bytes_in_use", biu)
+        if biu is not None:
+            in_use = max(in_use or 0.0, float(biu))
+        if pbiu is not None:
+            peak = max(peak or 0.0, float(pbiu))
+    if in_use is None and peak is None:
+        return None
+    out: Dict[str, float] = {}
+    if peak is not None:
+        out["device_mem_peak_mb"] = round(peak / (1024.0 * 1024.0), 3)
+    if in_use is not None:
+        out["device_mem_in_use_mb"] = round(in_use / (1024.0 * 1024.0), 3)
+    return out
+
+
+def derive_perf_record(round_rec: Dict[str, Any], *,
+                       round_flops: Optional[float] = None,
+                       flops_source: Optional[str] = None,
+                       peak_flops: Optional[float] = None,
+                       memory: Optional[Dict[str, float]] = None
+                       ) -> Optional[Dict[str, Any]]:
+    """One ``perf`` record from one closed ``round`` record — a PURE
+    function of its inputs (the oracle test hand-computes every field).
+
+    ``round_flops`` is the whole round program's FLOP count (all
+    clients' local trains + aggregation); ``peak_flops`` is the fleet
+    peak (per-device peak × device count). Fields whose inputs are
+    missing are omitted, never guessed."""
+    duration = round_rec.get("duration_s")
+    if not duration or duration <= 0:
+        return None
+    rec: Dict[str, Any] = {"kind": "perf",
+                           "round": round_rec.get("round"),
+                           "duration_s": duration}
+    phases = round_rec.get("phases") or {}
+    counters = round_rec.get("counters") or {}
+    # -- MFU / achieved FLOP/s --------------------------------------------
+    if round_flops:
+        achieved = round_flops / duration
+        rec["round_flops"] = float(round_flops)
+        rec["achieved_flops_per_s"] = round(achieved, 3)
+        if flops_source:
+            rec["flops_source"] = flops_source
+        if peak_flops:
+            rec["peak_flops"] = float(peak_flops)
+            # significant digits, not decimal places: a CPU-smoke MFU of
+            # 3e-7 must serialize as 3e-07, not round to a healthy-looking
+            # 0.0
+            rec["mfu"] = float(f"{achieved / peak_flops:.6g}")
+    # -- comm/compute overlap ---------------------------------------------
+    def _psec(name: str) -> float:
+        return float((phases.get(name) or {}).get("s", 0.0))
+
+    pack_s = _psec("pack") + _psec("upload")
+    if pack_s > 0.0:
+        if counters.get("prefetch_hit", 0) > 0:
+            hidden = max(0.0, pack_s - _psec("prefetch_wait"))
+            rec["comm_compute_overlap_frac"] = round(hidden / pack_s, 6)
+        else:
+            # serial round: the pack ran inline, nothing was hidden
+            rec["comm_compute_overlap_frac"] = 0.0
+    # -- wire rates ---------------------------------------------------------
+    up = counters.get("comm_bytes_up")
+    down = counters.get("comm_bytes_down")
+    if up is not None:
+        rec["wire_bytes_per_sec_up"] = round(up / duration, 3)
+    if down is not None:
+        rec["wire_bytes_per_sec_down"] = round(down / duration, 3)
+    if memory:
+        rec.update(memory)
+    return rec
+
+
+class PerfAccountant:
+    """Per-process roofline state: the (lazily probed) round FLOP count
+    plus the resolved fleet peak; :meth:`derive` turns each closed round
+    record into a ``perf`` record.
+
+    ``device_count`` scales the per-device peak to the fleet the round
+    program actually spans (the mesh driver passes its mesh size; the
+    single-device sim drivers pass 1)."""
+
+    def __init__(self, *, peak_flops: Optional[float] = None,
+                 device_count: int = 1,
+                 memory_fn: Optional[Callable[[], Optional[Dict]]]
+                 = device_memory_gauges):
+        per_dev = (peak_flops if peak_flops is not None
+                   else device_peak_flops())
+        self.peak_flops = (per_dev * max(1, int(device_count))
+                           if per_dev else None)
+        self.round_flops: Optional[float] = None
+        self.flops_source: Optional[str] = None
+        self._memory_fn = memory_fn
+        self._flops_probed = False
+
+    def probe_flops_once(self, thunk: Callable[[], float],
+                         source: str = "analytic_flops") -> None:
+        """Run the round-FLOP probe exactly once per process (tracing the
+        round program is host-side work worth paying once, not per
+        round). A probe failure warns and leaves MFU omitted — perf
+        accounting must never take down a round loop."""
+        if self._flops_probed:
+            return
+        self._flops_probed = True
+        try:
+            flops = float(thunk())
+        except Exception:  # degrade contract: a failed probe omits mfu
+            logging.warning("perf accounting: round-FLOP probe failed — "
+                            "mfu omitted from perf records", exc_info=True)
+            return
+        if flops == flops and flops > 0:
+            self.round_flops = flops
+            self.flops_source = source
+
+    def set_round_flops(self, flops: float, source: str) -> None:
+        """Directly pin the round FLOP count (benches that already
+        computed it; replaces any probed value)."""
+        self._flops_probed = True
+        self.round_flops = float(flops)
+        self.flops_source = source
+
+    def derive(self, round_rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        memory = None
+        if self._memory_fn is not None:
+            try:
+                memory = self._memory_fn()
+            except Exception:  # ft: allow[FT005] degrade contract: gauges omitted, never an exception
+                memory = None
+        return derive_perf_record(round_rec,
+                                  round_flops=self.round_flops,
+                                  flops_source=self.flops_source,
+                                  peak_flops=self.peak_flops,
+                                  memory=memory)

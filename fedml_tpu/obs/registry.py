@@ -28,6 +28,10 @@ from typing import Dict
 KIND_PHASE = "phase"      # wall-clock totals + call counts (timer.phase/add)
 KIND_COUNTER = "counter"  # monotone event counts (timer.count)
 KIND_GAUGE = "gauge"      # high-water marks, max-aggregated (timer.gauge)
+#: fields of the per-round ``perf`` flight record (obs/perf.py) — derived
+#: from a closed round's deltas, not a RoundTimer series; registered here
+#: so FT017 pins the names the same way it pins the timer's
+KIND_DERIVED = "derived"
 
 
 def _m(kind: str, subsystem: str, meaning: str) -> Dict[str, str]:
@@ -138,6 +142,38 @@ METRICS: Dict[str, Dict[str, str]] = {
                               "one-shot jax.profiler window (bumped at "
                               "the window's close, so the delta lands "
                               "in the following round's record)"),
+    # -- perf flight deck (obs/perf.py): per-round derived perf record ------
+    "mfu": _m(KIND_DERIVED, "perf",
+              "model FLOP utilization: achieved FLOP/s over the fleet "
+              "bf16 peak (documented per-device table x device count; "
+              "$FEDML_TPU_PEAK_FLOPS overrides the per-device figure); "
+              "omitted on CPU/unknown devices"),
+    "achieved_flops_per_s": _m(KIND_DERIVED, "perf",
+                               "round program FLOPs (analytic jaxpr cost "
+                               "model) over the measured round duration"),
+    "comm_compute_overlap_frac": _m(KIND_DERIVED, "perf",
+                                    "fraction of host pack+upload hidden "
+                                    "behind device compute by the round "
+                                    "pipeline (prefetch-hit rounds: "
+                                    "1 - prefetch_wait/(pack+upload); "
+                                    "serial rounds read 0)"),
+    "wire_bytes_per_sec_up": _m(KIND_DERIVED, "perf",
+                                "client->server wire throughput this "
+                                "round (encoded frame bytes / duration)"),
+    "wire_bytes_per_sec_down": _m(KIND_DERIVED, "perf",
+                                  "server->client wire throughput this "
+                                  "round (encoded frame bytes / "
+                                  "duration)"),
+    "device_mem_peak_mb": _m(KIND_GAUGE, "perf",
+                             "peak device (HBM) bytes in use across "
+                             "local devices, MB — best-effort "
+                             "memory_stats(); omitted where the backend "
+                             "exposes none (CPU)"),
+    "device_mem_in_use_mb": _m(KIND_DERIVED, "perf",
+                               "current device bytes in use across local "
+                               "devices, MB at round close — best-effort "
+                               "memory_stats(); omitted where the "
+                               "backend exposes none (CPU)"),
 }
 
 

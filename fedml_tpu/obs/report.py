@@ -1,0 +1,190 @@
+"""Per-job performance report — the SLO/billing artifact.
+
+``python -m fedml_tpu.obs report <dir>`` folds a flight-log directory
+(or an already-merged timeline) into ONE summary per ``job_id``:
+round-time distribution, rounds/s, report-latency quantiles, MFU trend
+(first-half vs second-half mean — is the job speeding up or
+degrading?), wire byte totals, the eviction/retry/checkpoint counter
+roll-up, and an anomaly index. This is the per-job artifact the
+multi-job tenancy ROADMAP item consumes as-is: one federation cluster,
+N tenants, one report each — latency quantiles are the SLO half,
+wire/compute totals are the billing half.
+
+Emitted as JSON (machine-readable, default) or markdown (review-ready).
+All derivation is a pure function of the merged timeline, so the
+report equals what ``obs merge`` + hand-arithmetic would give.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from fedml_tpu.obs.tail import _quantile, round_table_rows
+
+#: counter families rolled up into the report (everything else a round
+#: record carries still lands under ``counters_total``)
+_ROLLUP_PREFIXES = ("ft_", "cp_", "state_", "obs_", "comm_",
+                    "prefetch_")
+
+
+def _dist(values: List[float]) -> Optional[Dict[str, float]]:
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return None
+    return {
+        "p50": round(_quantile(vals, 0.5), 6),
+        "p90": round(_quantile(vals, 0.9), 6),
+        "mean": round(sum(vals) / len(vals), 6),
+        "max": round(max(vals), 6),
+    }
+
+
+def _mfu_trend(mfus: List[float]) -> Optional[Dict[str, Any]]:
+    vals = [v for v in mfus if v is not None]
+    if not vals:
+        return None
+    half = len(vals) // 2
+    first = vals[:half] or vals
+    second = vals[half:] or vals
+    fm = sum(first) / len(first)
+    sm = sum(second) / len(second)
+    # 5% relative movement before calling a direction — measurement noise
+    # must not read as a performance verdict
+    if sm > fm * 1.05:
+        direction = "improving"
+    elif sm < fm * 0.95:
+        direction = "degrading"
+    else:
+        direction = "flat"
+    return {
+        "mean": round(sum(vals) / len(vals), 6),
+        "min": round(min(vals), 6),
+        "max": round(max(vals), 6),
+        "first_half_mean": round(fm, 6),
+        "second_half_mean": round(sm, 6),
+        "trend": direction,
+    }
+
+
+def summarize_job(merged: Dict[str, Any], job_id: str) -> Dict[str, Any]:
+    """One job's summary from that job's OWN merged timeline (the
+    caller merges per job — round rows are keyed by round index, so two
+    jobs' round 0 must never share a fold)."""
+    rounds = merged["rounds"]
+    table = round_table_rows(merged)
+    durations = [r["duration_s"] for r in table
+                 if r["duration_s"] is not None]
+    latencies = [s.get("report_latency_s")
+                 for row in rounds for s in row.get("silo_reports", [])
+                 if s.get("report_latency_s") is not None]
+    bytes_up = sum(r["bytes_up"] or 0 for r in table)
+    bytes_down = sum(r["bytes_down"] or 0 for r in table)
+    counters_total: Dict[str, int] = {}
+    for row in rounds:
+        srv = row.get("server") or {}
+        for k, v in (srv.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters_total[k] = counters_total.get(k, 0) + v
+    rollup = {k: v for k, v in sorted(counters_total.items())
+              if k.startswith(_ROLLUP_PREFIXES)}
+    anomalies = [{"round": a.get("round"), "reason": a.get("reason"),
+                  "detail": a.get("detail")}
+                 for a in merged.get("anomalies", [])]
+    n_rounds = len([r for r in table if r["duration_s"] is not None])
+    epochs = sorted({rec.get("epoch")
+                     for row in rounds
+                     for rec in [row.get("server")] if rec} - {None})
+    return {
+        "job_id": job_id,
+        "rounds": len(table),
+        "first_round": table[0]["round"] if table else None,
+        "last_round": table[-1]["round"] if table else None,
+        "server_epochs": epochs,
+        "partial_rounds": sum(1 for r in table if r["partial"]),
+        "round_time_s": _dist(durations),
+        "rounds_per_sec": (round(n_rounds / sum(durations), 4)
+                           if durations and sum(durations) > 0 else None),
+        "report_latency_s": _dist(latencies),
+        "mfu": _mfu_trend([r["mfu"] for r in table]),
+        "wire": {
+            "bytes_up": bytes_up,
+            "bytes_down": bytes_down,
+            "bytes_per_round": (round((bytes_up + bytes_down)
+                                      / len(table), 1) if table else None),
+        },
+        "counters": rollup,
+        "anomaly_count": len(anomalies),
+        "anomalies": anomalies,
+    }
+
+
+def summarize(inputs, job_id: Optional[str] = None) -> Dict[str, Any]:
+    """Per-job summaries from flight-log paths/directories. Returns
+    ``{"jobs": {job_id: summary, ...}}`` (restricted to one job when
+    ``job_id`` is given). The logs are read ONCE and folded per job, so
+    a directory shared by several jobs reports them independently; a
+    ``job_id`` no record carries yields an empty ``jobs`` map (the CLI's
+    exit-2 input error), never a vacuous zero-round summary."""
+    from fedml_tpu.obs.flight import read_flight_log
+    from fedml_tpu.obs.merge import _resolve_paths, fold_records
+    records: List[Dict[str, Any]] = []
+    for path in _resolve_paths(inputs):
+        records.extend(read_flight_log(path))
+    jobs = sorted({str(r.get("job_id")) for r in records
+                   if r.get("job_id") is not None})
+    if job_id is not None:
+        jobs = [j for j in jobs if j == job_id]
+    return {"jobs": {j: summarize_job(fold_records(records, job_id=j), j)
+                     for j in jobs}}
+
+
+def to_markdown(report: Dict[str, Any]) -> str:
+    """The review-ready rendering: one section per job."""
+    lines: List[str] = []
+    for job_id, s in sorted(report["jobs"].items()):
+        lines.append(f"## job `{job_id}`")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        rt = s.get("round_time_s") or {}
+        rl = s.get("report_latency_s") or {}
+        mfu = s.get("mfu") or {}
+        wire = s.get("wire") or {}
+        rows = [
+            ("rounds", f"{s['rounds']} "
+                       f"(r{s['first_round']}..r{s['last_round']}, "
+                       f"{s['partial_rounds']} partial)"),
+            ("server epochs", ", ".join(str(e)
+                                        for e in s["server_epochs"])
+             or "-"),
+            ("rounds/s", s.get("rounds_per_sec")),
+            ("round time p50/p90/max (s)",
+             "/".join(str(rt.get(k, "-"))
+                      for k in ("p50", "p90", "max")) if rt else "-"),
+            ("report latency p50/p90 (s)",
+             "/".join(str(rl.get(k, "-"))
+                      for k in ("p50", "p90")) if rl else "-"),
+            ("MFU mean (trend)",
+             (f"{mfu.get('mean')} ({mfu.get('trend')}: "
+              f"{mfu.get('first_half_mean')} -> "
+              f"{mfu.get('second_half_mean')})") if mfu else "-"),
+            ("wire bytes up/down",
+             f"{wire.get('bytes_up', 0)}/{wire.get('bytes_down', 0)} "
+             f"({wire.get('bytes_per_round')} B/round)"),
+            ("anomalies", s.get("anomaly_count", 0)),
+        ]
+        for name, value in rows:
+            lines.append(f"| {name} | {value if value is not None else '-'}"
+                         " |")
+        counters = s.get("counters") or {}
+        if counters:
+            lines.append("")
+            lines.append("counters: " + ", ".join(
+                f"`{k}`={v}" for k, v in counters.items()))
+        if s.get("anomalies"):
+            lines.append("")
+            lines.append("anomaly index:")
+            for a in s["anomalies"]:
+                lines.append(f"- round {a['round']}: {a['reason']}")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"

@@ -440,7 +440,7 @@ class DistributedFedAvgAPI:
         self._obs = build_observability(
             getattr(self.config, "obs_dir", None),
             job_id=getattr(self.config, "job_id", None) or "spmd",
-            rank=0, role="server")
+            rank=0, role="server", perf_device_count=self.n_dev)
         if self._obs is not None:
             self._obs.bind_timer(self.timer)
         # same-cohort device cache as FedAvgAPI._pack_cache: full
@@ -604,8 +604,22 @@ class DistributedFedAvgAPI:
                 self._base_key, round_idx,
                 jnp.asarray(np.asarray(padded), dtype=jnp.uint32))
             keysd = jax.device_put(keys, self._data_sharding)
+        decayed = self.config.train.lr_decay_round != 1.0
+        if self._obs is not None:
+            # one-shot roofline probe (obs/perf.py): trace the sharded
+            # round program at GLOBAL shapes — analytic_flops then counts
+            # the whole-mesh FLOPs, matching the fleet peak the perf
+            # accountant was built with (perf_device_count=n_dev).
+            # Traced before dispatch so donation can't invalidate inputs.
+            from fedml_tpu.utils.flops import analytic_flops
+            args = ((self.variables, xd, yd, maskd, keysd, wd,
+                     jnp.uint32(round_idx)) if decayed
+                    else (self.variables, xd, yd, maskd, keysd, wd))
+            self._obs.probe_round_flops(
+                lambda: analytic_flops(self._round_fn, *args),
+                source="analytic_conv_gn_jaxpr")
         with self.timer.phase("dispatch"):
-            if self.config.train.lr_decay_round != 1.0:
+            if decayed:
                 # decayed builder takes the replicated round index as its
                 # final operand (make_spmd_round's conditional spec)
                 self.variables, stats = self._round_fn(
@@ -618,7 +632,8 @@ class DistributedFedAvgAPI:
             round_idx, extra={"cohort": [int(i) for i in idxs]})
         if self._obs is not None:
             self._obs.round_end(round_idx,
-                                rec["duration_s"] if rec else None)
+                                rec["duration_s"] if rec else None,
+                                record=rec)
         return idxs, stats
 
     def run_rounds_fused(self, r0: int, rounds: int, next_window=None):

@@ -21,7 +21,12 @@ The reference's only tracing is wall-clock log lines
   on. Counters bumped by OTHER threads mid-round (prefetch worker,
   heartbeats) are charged to the round that was open — same overlap
   semantics as the phase means. Begin/end never touch RNG, schedules,
-  or device state: timelines are a pure observer.
+  or device state: timelines are a pure observer. The record
+  ``end_round`` returns is also the roofline accountant's input
+  (``fedml_tpu/obs/perf.py``): drivers pass it to
+  ``Observability.round_end(record=...)`` and the per-round ``perf``
+  record (MFU, overlap frac, wire bytes/s) derives from exactly these
+  deltas — the derivation never reads the live timer.
 - ``profile`` — context manager around ``jax.profiler.trace`` emitting a
   TensorBoard-loadable trace directory when enabled, a no-op otherwise.
 """

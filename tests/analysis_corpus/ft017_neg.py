@@ -11,3 +11,8 @@ def roll_up(timer, hit, name, seen):
         pass
     timer.count(name)  # non-literal: aliasing limit, not checked
     seen.add("not_a_metric_name")  # a set, not a timer receiver
+    # the perf flight-deck names (obs/perf.py derived records + the HBM
+    # watermark gauge) are registered — using them at a timer site is
+    # legal, exactly as Observability.round_end mirrors the gauge
+    timer.gauge("device_mem_peak_mb", 96.0)
+    timer.gauge("mfu", 0.41)

@@ -184,8 +184,7 @@ def pytest_runtest_logreport(report):
 
 
 def pytest_sessionfinish(session, exitstatus):
-    out = os.environ.get("FEDML_TPU_TEST_DURATIONS")
-    if not out or not _TEST_DURATIONS:
+    if not _TEST_DURATIONS:
         return
     import json
     import time
@@ -200,13 +199,47 @@ def pytest_sessionfinish(session, exitstatus):
         "slowest": [{"test": n, "duration_s": round(d, 3)}
                     for n, d in top],
     }
-    d = os.path.dirname(out)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    tmp = f"{out}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=2)
-    os.replace(tmp, out)
+    out = os.environ.get("FEDML_TPU_TEST_DURATIONS")
+    if out:
+        d = os.path.dirname(out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{out}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, out)
+    # the slowest-20 artifact above is overwritten per run — the trend
+    # ledger row is the HISTORY: tests/sec per session, keyed by host
+    # fingerprint, so slow-test creep regresses the same soft-fail lane
+    # as a bench rounds/sec drop (fedml_tpu/obs/trend.py). Only a FULL,
+    # GREEN fast-lane session is evidence: the row's population is
+    # pinned to exactly the `-m "not slow"` lane — a -k/-file/--lf/
+    # --deselect subset, a different markexpr (e.g. slow tests
+    # included), or a failed run computes tests/sec over a different
+    # population and would poison the key's trailing median with false
+    # regressions (or mask real creep).
+    ledger = os.environ.get("FEDML_TPU_TREND_LEDGER")
+    opt = session.config.option
+    selected = (
+        bool(getattr(opt, "keyword", ""))
+        or getattr(opt, "markexpr", "") != "not slow"
+        or bool(getattr(opt, "lf", False))
+        or bool(getattr(opt, "deselect", None))
+        or any(a.endswith(".py") or "::" in a
+               for a in session.config.args))
+    if ledger and payload["total_call_s"] > 0 and exitstatus == 0 \
+            and not selected:
+        from fedml_tpu.obs import trend
+        row = trend.make_row(
+            "pytest_fast_lane",
+            {"rounds_per_sec": round(payload["total_tests"]
+                                     / payload["total_call_s"], 4)},
+            host_tag="pytest",
+            extra={"total_tests": payload["total_tests"],
+                   "total_call_s": payload["total_call_s"],
+                   "slowest_test_s": round(top[0][1], 3) if top else None,
+                   "exitstatus": int(exitstatus)})
+        trend.append_row(ledger, row)
 
 
 @pytest.fixture(scope="session")

@@ -11,12 +11,17 @@ Oracle strategy mirrors test_control_plane.py:
 - merge-tool alignment against a KNOWN synthetic chaos schedule, and
   the ledger cross-check catching a planted divergence;
 - the acceptance core: a chaos-harness cross-silo run with
-  observability ON yields a merged timeline whose per-round rows agree
-  with ledger.jsonl, with the trajectory BIT-EXACT vs observability
-  OFF — the same pure-observer rule (and test pattern) as PR-7
-  checkpointing;
+  observability ON (perf accounting included) yields a merged timeline
+  whose per-round rows agree with ledger.jsonl, with the trajectory
+  BIT-EXACT vs observability OFF — the same pure-observer rule (and
+  test pattern) as PR-7 checkpointing;
 - anomaly detector p90·k semantics + the profiler's one-shot arm/
-  cooldown contract (injected start/stop fns — no real jax traces).
+  cooldown contract (injected start/stop fns — no real jax traces);
+- roofline/MFU derivation (obs/perf.py) against HAND-COMPUTED oracles,
+  including the memory-stats-absent and failed-flops-probe degrades;
+- the live tail console: concurrent writer threads + mid-tail rotation
+  with the reconstructed table EQUAL to the ``obs merge`` ground truth,
+  and the per-job report's hand-checked aggregates.
 """
 
 import json
@@ -32,9 +37,10 @@ from fedml_tpu.control import ServerControlCheckpointer
 from fedml_tpu.control.failover_harness import build_fixture
 from fedml_tpu.models.lr import LogisticRegression
 from fedml_tpu.obs import (AnomalyProfiler, FlightRecorder, Observability,
-                           RoundAnomalyDetector, build_observability,
-                           check_against_ledger, merge_flight_logs,
-                           read_flight_log)
+                           PerfAccountant, RoundAnomalyDetector,
+                           build_observability, check_against_ledger,
+                           derive_perf_record, device_peak_flops,
+                           merge_flight_logs, read_flight_log)
 from fedml_tpu.utils.tracing import RoundTimer
 
 
@@ -336,8 +342,22 @@ class TestObservabilityIsAPureObserver:
                     if r["rank"] == s["silo_rank"])
         # 5) the ring buffer carries the same 3 rounds
         assert [r["round"] for r in timer.round_records()] == [0, 1, 2]
+        # 6) perf accounting was ON for the whole (bit-exact) run: every
+        #    round derived a perf record with real per-round wire rates
+        #    (the server credits byte deltas at each close)
+        for row in merged["rounds"]:
+            perf = row["perf"]
+            assert perf is not None and perf["kind"] == "perf"
+            assert perf["wire_bytes_per_sec_up"] > 0
+            assert perf["wire_bytes_per_sec_down"] > 0
+        # per-round wire deltas sum to (at most) the endpoint totals the
+        # launcher credits — the remainder is the FINISH sweep
+        up_per_round = sum(
+            (row["server"].get("counters") or {}).get("comm_bytes_up", 0)
+            for row in merged["rounds"])
+        assert 0 < up_per_round <= timer.counters["comm_bytes_up"]
 
-    def test_sim_driver_timeline_and_parity(self, tmp_path):
+    def test_sim_driver_timeline_and_parity(self, tmp_path, monkeypatch):
         from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
         from fedml_tpu.data.synthetic import make_blob_federated
         ds = make_blob_federated(client_num=4, dim=8, class_num=3,
@@ -357,6 +377,9 @@ class TestObservabilityIsAPureObserver:
             return jax.tree.map(np.asarray, api.variables), api
 
         clean, _ = run()
+        # a pinned per-device peak so the CPU run still derives MFU (the
+        # documented table knows no CPU kind — env override is the knob)
+        monkeypatch.setenv("FEDML_TPU_PEAK_FLOPS", "1e12")
         obs_dir = str(tmp_path / "sim_obs")
         observed, api = run(obs_dir=obs_dir)
         tree_equal(clean, observed)
@@ -369,6 +392,20 @@ class TestObservabilityIsAPureObserver:
         assert all(r["phases"].get("dispatch", {}).get("n") == 1
                    for r in rounds)
         assert len(api.timer.round_records()) == 3
+        # perf leg: the analytic round-FLOP probe ran once and every
+        # round derived an MFU against the pinned peak — the SPMD
+        # ROADMAP item's measured-MFU evidence path, on the sim driver
+        perfs = [r for r in rows if r["kind"] == "perf"]
+        assert [p["round"] for p in perfs] == [0, 1, 2]
+        for p in perfs:
+            assert p["flops_source"] == "analytic_conv_gn_jaxpr"
+            assert p["round_flops"] > 0
+            assert p["peak_flops"] == 1e12
+            assert 0 < p["mfu"] < 1
+            # hand-check: mfu is exactly achieved/peak for this record
+            np.testing.assert_allclose(
+                p["mfu"], (p["round_flops"] / p["duration_s"]) / 1e12,
+                rtol=1e-3)
 
 
 # ---------------------------------------------------------------------------
@@ -489,7 +526,370 @@ class TestBuildObservability:
         obs = build_observability(str(tmp_path), job_id="j", rank=0,
                                   role="server")
         assert obs.detector is not None and obs.profiler is not None
+        assert obs.perf is not None  # roofline accounting rides along
         silo = build_observability(str(tmp_path), job_id="j", rank=2,
                                    role="silo")
         assert silo.detector is None and silo.profiler is None
+        assert silo.perf is None
         assert silo.recorder.rank == 2
+
+
+# ---------------------------------------------------------------------------
+class TestPerfAccounting:
+    """obs/perf.py derivation vs HAND-COMPUTED oracles — every figure in
+    a perf record must be reproducible with pencil arithmetic from the
+    round record it derives from."""
+
+    def test_mfu_hand_computed_oracle(self):
+        # 8 GFLOP round over 2.0 s = 4 GFLOP/s achieved; peak 1 TFLOP/s
+        # -> MFU = 4e9 / 1e12 = 0.004 exactly
+        rec = derive_perf_record(
+            {"round": 7, "duration_s": 2.0, "phases": {}, "counters": {}},
+            round_flops=8e9, flops_source="analytic", peak_flops=1e12)
+        assert rec["kind"] == "perf" and rec["round"] == 7
+        assert rec["achieved_flops_per_s"] == 4e9
+        assert rec["mfu"] == 0.004
+        assert rec["round_flops"] == 8e9
+        assert rec["flops_source"] == "analytic"
+
+    def test_mfu_omitted_without_peak_or_flops(self):
+        rec = derive_perf_record(
+            {"round": 0, "duration_s": 1.0}, round_flops=8e9)
+        assert "mfu" not in rec  # no peak: achieved only, no guess
+        assert rec["achieved_flops_per_s"] == 8e9
+        rec = derive_perf_record({"round": 0, "duration_s": 1.0},
+                                 peak_flops=1e12)
+        assert "mfu" not in rec and "achieved_flops_per_s" not in rec
+
+    def test_overlap_frac_hand_computed_oracle(self):
+        # pack 0.4 + upload 0.1 = 0.5 host work; the caller only waited
+        # 0.05 on the pipeline -> hidden 0.45/0.5 = 0.9
+        rec = derive_perf_record({
+            "round": 1, "duration_s": 1.0,
+            "phases": {"pack": {"s": 0.4, "n": 1},
+                       "upload": {"s": 0.1, "n": 1},
+                       "prefetch_wait": {"s": 0.05, "n": 1}},
+            "counters": {"prefetch_hit": 1}})
+        assert rec["comm_compute_overlap_frac"] == 0.9
+        # serial round (no prefetch hit): pack ran inline, nothing hidden
+        rec = derive_perf_record({
+            "round": 1, "duration_s": 1.0,
+            "phases": {"pack": {"s": 0.4, "n": 1}}, "counters": {}})
+        assert rec["comm_compute_overlap_frac"] == 0.0
+        # cached round (no pack at all): the metric is meaningless -> absent
+        rec = derive_perf_record({"round": 1, "duration_s": 1.0,
+                                  "phases": {}, "counters": {}})
+        assert "comm_compute_overlap_frac" not in rec
+
+    def test_wire_rates_hand_computed_oracle(self):
+        rec = derive_perf_record({
+            "round": 2, "duration_s": 2.0, "phases": {},
+            "counters": {"comm_bytes_up": 1000, "comm_bytes_down": 500}})
+        assert rec["wire_bytes_per_sec_up"] == 500.0
+        assert rec["wire_bytes_per_sec_down"] == 250.0
+
+    def test_zero_duration_yields_no_record(self):
+        assert derive_perf_record({"round": 0, "duration_s": 0.0}) is None
+        assert derive_perf_record({"round": 0}) is None
+
+    def test_memory_stats_absent_degrades(self):
+        from fedml_tpu.obs.perf import device_memory_gauges
+        # the CPU backend exposes no memory_stats: the probe must return
+        # None (or a dict) WITHOUT raising, and the record omits gauges
+        assert device_memory_gauges() is None or isinstance(
+            device_memory_gauges(), dict)
+        acct = PerfAccountant(peak_flops=1e12, memory_fn=lambda: None)
+        rec = acct.derive({"round": 0, "duration_s": 1.0})
+        assert "device_mem_peak_mb" not in rec
+        # a RAISING memory probe degrades the same way
+        def boom():
+            raise RuntimeError("no memory_stats on this backend")
+        acct = PerfAccountant(peak_flops=1e12, memory_fn=boom)
+        rec = acct.derive({"round": 0, "duration_s": 1.0})
+        assert rec is not None and "device_mem_peak_mb" not in rec
+
+    def test_memory_gauges_attach_when_present(self):
+        acct = PerfAccountant(
+            peak_flops=1e12,
+            memory_fn=lambda: {"device_mem_peak_mb": 12.5,
+                               "device_mem_in_use_mb": 8.0})
+        rec = acct.derive({"round": 0, "duration_s": 1.0})
+        assert rec["device_mem_peak_mb"] == 12.5
+        assert rec["device_mem_in_use_mb"] == 8.0
+
+    def test_peak_flops_env_override(self, monkeypatch):
+        monkeypatch.setenv("FEDML_TPU_PEAK_FLOPS", "2.5e12")
+        assert device_peak_flops() == 2.5e12
+        monkeypatch.setenv("FEDML_TPU_PEAK_FLOPS", "not-a-number")
+        # unparseable override is ignored; CPU device kind -> no peak
+        assert device_peak_flops() is None
+        monkeypatch.delenv("FEDML_TPU_PEAK_FLOPS")
+        assert device_peak_flops() is None  # CPU: MFU not meaningful
+
+    def test_device_count_scales_peak(self):
+        acct = PerfAccountant(peak_flops=1e12, device_count=8,
+                              memory_fn=None)
+        assert acct.peak_flops == 8e12
+        acct.set_round_flops(16e12, "pinned")
+        rec = acct.derive({"round": 0, "duration_s": 2.0})
+        # 8 TFLOP/s achieved over 8 TFLOP/s fleet peak = MFU 1.0
+        assert rec["mfu"] == 1.0
+
+    def test_probe_failure_degrades_and_latches(self):
+        acct = PerfAccountant(peak_flops=1e12, memory_fn=None)
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("trace failed")
+
+        acct.probe_flops_once(boom)
+        acct.probe_flops_once(boom)  # latched: never re-probes
+        assert calls == [1]
+        rec = acct.derive({"round": 0, "duration_s": 1.0})
+        assert rec is not None and "mfu" not in rec
+
+    def test_observability_flushes_perf_record_and_gauge(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), job_id="p", rank=0)
+        acct = PerfAccountant(peak_flops=1e12,
+                              memory_fn=lambda: {"device_mem_peak_mb":
+                                                 42.0})
+        acct.set_round_flops(5e11, "pinned")
+        obs = Observability(rec, perf=acct)
+        timer = RoundTimer()
+        obs.bind_timer(timer)
+        obs.round_end(0, 0.5, record={"round": 0, "duration_s": 0.5,
+                                      "phases": {}, "counters": {}})
+        rows = read_flight_log(rec.path)
+        perf = [r for r in rows if r["kind"] == "perf"]
+        assert len(perf) == 1
+        assert perf[0]["mfu"] == 1.0  # 1e12 achieved over 1e12 peak
+        # the HBM watermark mirrors into the timer's gauge family
+        assert timer.gauges["device_mem_peak_mb"] == 42.0
+        # record=None (legacy callers) writes no perf record
+        obs.round_end(1, 0.5)
+        assert len([r for r in read_flight_log(rec.path)
+                    if r["kind"] == "perf"]) == 1
+
+
+# ---------------------------------------------------------------------------
+class TestTailConsole:
+    """The live console: rotation-aware concurrent following with the
+    reconstructed table pinned EQUAL to the offline merge."""
+
+    def test_follower_buffers_torn_line_until_complete(self, tmp_path):
+        from fedml_tpu.obs.tail import LogFollower
+        path = tmp_path / "flight_rank0.jsonl"
+        f = open(path, "w")
+        f.write('{"kind": "round", "round": 0}\n{"kind": "round", "rou')
+        f.flush()
+        fol = LogFollower(str(path))
+        assert [r["round"] for r in fol.poll()] == [0]
+        f.write('nd": 1}\n')  # the torn tail completes
+        f.flush()
+        assert [r["round"] for r in fol.poll()] == [1]
+        f.close()
+        fol.close()
+
+    def test_follower_survives_rotation(self, tmp_path):
+        from fedml_tpu.obs.tail import LogFollower
+        rec = FlightRecorder(str(tmp_path), rank=0, rotate_lines=3,
+                             keep_last_n=50)
+        fol = LogFollower(rec.path)
+        got = []
+        for r in range(10):  # seals at 3, 6, 9 — mid-follow rotations
+            rec.append({"kind": "round", "round": r})
+            got.extend(fol.poll())
+        got.extend(fol.poll())
+        rec.close()
+        fol.close()
+        assert [r["round"] for r in got] == list(range(10))
+
+    def test_concurrent_tail_with_rotation_matches_merge(self, tmp_path):
+        """Two rank logs appended by writer threads while the tail
+        merges — including rotations mid-tail — must reconstruct
+        exactly the offline ``obs merge`` ground truth."""
+        import time as _time
+
+        from fedml_tpu.obs.tail import TimelineTailer
+        d = str(tmp_path)
+        n_rounds = 40
+
+        def server_writer():
+            rec = FlightRecorder(d, job_id="t", rank=0, epoch=1,
+                                 rotate_lines=7, keep_last_n=100)
+            for r in range(n_rounds):
+                rec.append({"kind": "silo", "round": r, "silo_rank": 1,
+                            "event": "reply",
+                            "report_latency_s": 0.001})
+                rec.append({"kind": "round", "round": r,
+                            "duration_s": 0.002,
+                            "phases": {}, "counters": {}, "gauges": {},
+                            "cohort": [0], "reported": [0],
+                            "partial": False})
+                _time.sleep(0.001)
+            rec.close()
+
+        def silo_writer():
+            rec = FlightRecorder(d, job_id="t", rank=1, epoch=9,
+                                 rotate_lines=5, keep_last_n=100)
+            for r in range(n_rounds):
+                rec.append({"kind": "round", "round": r,
+                            "client_idx": r % 3, "train_s": 0.001})
+                _time.sleep(0.001)
+            rec.close()
+
+        tailer = TimelineTailer(d)
+        threads = [threading.Thread(target=server_writer),
+                   threading.Thread(target=silo_writer)]
+        for t in threads:
+            t.start()
+        while any(t.is_alive() for t in threads):
+            tailer.poll()
+            _time.sleep(0.002)
+        for t in threads:
+            t.join()
+        tailer.poll()  # final drain
+        got = tailer.merged()
+        want = merge_flight_logs([d])
+        assert got == want
+        assert [r["round"] for r in got["rounds"]] == list(range(n_rounds))
+        tailer.close()
+
+    def test_tailer_retention_cap_bounds_memory(self, tmp_path):
+        from fedml_tpu.obs.tail import TimelineTailer
+        rec = FlightRecorder(str(tmp_path), rank=0)
+        for r in range(30):
+            rec.append({"kind": "round", "round": r})
+        rec.close()
+        tailer = TimelineTailer(str(tmp_path), max_records_per_rank=10)
+        tailer.poll()
+        merged = tailer.merged()
+        # only the newest window survives — the live console's contract
+        assert [r["round"] for r in merged["rounds"]] == \
+            list(range(20, 30))
+        tailer.close()
+
+    def test_tail_and_report_reconstruct_two_rank_two_epoch_log(
+            self, tmp_path):
+        """The acceptance log shape: two ranks, the server under TWO
+        epochs (a failover re-close), plus perf records — tail and
+        report must agree with the merge ground truth and with hand
+        arithmetic."""
+        from fedml_tpu.obs.report import summarize
+        from fedml_tpu.obs.tail import TimelineTailer, render_table
+        d = str(tmp_path)
+        life1 = FlightRecorder(d, job_id="j", rank=0, epoch=1)
+        silo = FlightRecorder(d, job_id="j", rank=1, epoch=70)
+        for r in range(3):
+            silo.append({"kind": "round", "round": r, "train_s": 0.01})
+            life1.append({"kind": "silo", "round": r, "silo_rank": 1,
+                          "event": "reply", "report_latency_s": 0.02})
+            life1.append({"kind": "round", "round": r,
+                          "duration_s": 0.5, "phases": {},
+                          "counters": {"comm_bytes_up": 1000,
+                                       "comm_bytes_down": 3000},
+                          "gauges": {}, "cohort": [r], "reported": [0],
+                          "partial": False})
+            life1.append({"kind": "perf", "round": r, "duration_s": 0.5,
+                          "mfu": 0.1 * (r + 1),
+                          "wire_bytes_per_sec_up": 2000.0})
+        life1.close()
+        # second server life: re-closes round 2 partial under epoch 2
+        life2 = FlightRecorder(d, job_id="j", rank=0, epoch=2)
+        life2.append({"kind": "round", "round": 2, "duration_s": 0.7,
+                      "phases": {},
+                      "counters": {"comm_bytes_up": 500,
+                                   "comm_bytes_down": 1500},
+                      "gauges": {}, "cohort": [2], "reported": [],
+                      "partial": True})
+        life2.append({"kind": "perf", "round": 2, "duration_s": 0.7,
+                      "mfu": 0.05, "wire_bytes_per_sec_up": 714.3})
+        life2.close()
+
+        tailer = TimelineTailer(d)
+        tailer.poll()
+        got = tailer.merged()
+        want = merge_flight_logs([d])
+        assert got == want
+        # the re-close (later epoch, later t_wall) wins, perf included
+        assert got["rounds"][2]["server"]["epoch"] == 2
+        assert got["rounds"][2]["server"]["partial"] is True
+        assert got["rounds"][2]["perf"]["mfu"] == 0.05
+        # the rendered frame carries the derived aggregates
+        frame = render_table(got)
+        assert "rounds: 3" in frame and "mfu" in frame
+        # per-job report vs hand arithmetic
+        rep = summarize([d])["jobs"]["j"]
+        assert rep["rounds"] == 3
+        assert rep["server_epochs"] == [1, 2]
+        assert rep["partial_rounds"] == 1
+        # wire: rounds 0,1 at 1000+3000 each; round 2's re-close 500+1500
+        assert rep["wire"]["bytes_up"] == 1000 + 1000 + 500
+        assert rep["wire"]["bytes_down"] == 3000 + 3000 + 1500
+        # round times: [0.5, 0.5, 0.7] -> 3 rounds / 1.7 s
+        assert rep["rounds_per_sec"] == round(3 / 1.7, 4)
+        assert rep["mfu"]["min"] == 0.05 and rep["mfu"]["max"] == 0.2
+        tailer.close()
+
+    def test_cli_tail_report_and_merge_formats(self, tmp_path):
+        import csv as csvmod
+        import io
+        import subprocess
+        import sys
+        _plant_flight_logs(tmp_path, TestMergeTool.SCHEDULE)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # tail --once renders a single frame and exits 0
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "tail",
+             str(tmp_path), "--once"],
+            capture_output=True, text=True, env=env)
+        assert rc.returncode == 0, rc.stderr
+        assert "rounds: 3" in rc.stdout
+        # an empty directory exits 2 (documented input-error code) —
+        # for tail AND merge (a typo'd path must not read as success)
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "tail", str(empty),
+             "--once"],
+            capture_output=True, text=True, env=env)
+        assert rc.returncode == 2
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "merge", str(empty)],
+            capture_output=True, text=True, env=env)
+        assert rc.returncode == 2
+        # merge --format csv: parseable flat rows, one per round
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "merge",
+             str(tmp_path), "--format", "csv"],
+            capture_output=True, text=True, env=env)
+        assert rc.returncode == 0, rc.stderr
+        rows = list(csvmod.DictReader(io.StringIO(rc.stdout)))
+        assert [r["round"] for r in rows] == ["0", "1", "2"]
+        assert rows[1]["partial"] == "True"
+        # merge --format json: the whole merged timeline on stdout
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "merge",
+             str(tmp_path), "--format", "json"],
+            capture_output=True, text=True, env=env)
+        merged = json.loads(rc.stdout)
+        assert len(merged["rounds"]) == 3
+        # the exit-code contract is documented in --help
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "merge", "--help"],
+            capture_output=True, text=True, env=env)
+        assert "exit codes" in rc.stdout
+        # report: json + markdown
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "report",
+             str(tmp_path)],
+            capture_output=True, text=True, env=env)
+        assert rc.returncode == 0, rc.stderr
+        rep = json.loads(rc.stdout)
+        assert rep["jobs"]["chaos"]["rounds"] == 3
+        rc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.obs", "report",
+             str(tmp_path), "--format", "markdown"],
+            capture_output=True, text=True, env=env)
+        assert rc.returncode == 0 and "## job `chaos`" in rc.stdout
