@@ -25,6 +25,14 @@ JAX_PLATFORMS=cpu python -m fedml_tpu.control.failover_harness --smoke \
 JAX_PLATFORMS=cpu python -m fedml_tpu.obs merge runs/obs_smoke/flight \
     --ledger runs/obs_smoke/killed/ledger.jsonl \
     --output runs/obs_smoke/merged.json
+# multi-job tenancy smoke (fedml_tpu/sched): two federation jobs over
+# ONE shared fabric + device, the victim's server SIGKILLed
+# mid-schedule and respawned — exits non-zero unless the survivor's
+# ledger AND final model are bit-identical to its solo leg, the victim
+# recovered via its own job_<id>/ checkpoint (cp_restores >= 1), and
+# `obs report` renders one per-tenant summary from the shared obs dir
+rm -rf runs/sched_smoke
+JAX_PLATFORMS=cpu python -m fedml_tpu.sched smoke --root runs/sched_smoke
 # slowest-20 artifact (tests/conftest.py sessionfinish hook): fast-lane
 # time creep becomes a diffable runs/ number instead of a README
 # anecdote — AND a trend-ledger row, so creep regresses like a bench

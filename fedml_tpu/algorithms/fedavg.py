@@ -117,7 +117,8 @@ class FedAvgConfig:
     # profiles. None (default) = off; on, it is a pure observer —
     # trajectories stay bit-exact (test_obs.py pins this).
     obs_dir: Optional[str] = None
-    # flight-record correlation id; defaults to "sim" for this driver
+    # flight-record correlation id; unset derives a collision-safe
+    # "sim-<8 hex>" per run (obs.default_job_id)
     job_id: Optional[str] = None
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 
@@ -207,10 +208,13 @@ class FedAvgAPI:
         # observability (fedml_tpu/obs): flight recorder + slow-round
         # anomaly profiling for the sim driver; config.obs_dir None
         # (default) keeps this fully off
-        from fedml_tpu.obs import build_observability
+        from fedml_tpu.obs import build_observability, default_job_id
         self._obs = build_observability(
             getattr(self.config, "obs_dir", None),
-            job_id=getattr(self.config, "job_id", None) or "sim",
+            # collision-safe default: two unconfigured runs sharing an
+            # obs dir must not interleave under one literal id
+            job_id=(getattr(self.config, "job_id", None)
+                    or default_job_id("sim")),
             rank=0, role="server")
         if self._obs is not None:
             self._obs.bind_timer(self.timer)

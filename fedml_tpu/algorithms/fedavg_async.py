@@ -35,7 +35,7 @@ from fedml_tpu.algorithms.fedavg_cross_silo import (
     MSG_ARG_KEY_NUM_SAMPLES, MSG_ARG_KEY_ROUND, MSG_TYPE_C2S_SEND_MODEL,
     MSG_TYPE_ROUND_TIMEOUT, MSG_TYPE_S2C_FINISH, MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL, FedAvgAggregator, FedAvgClientManager,
-    FedAvgServerManager, _DEVICE_LOCK, _to_numpy)
+    FedAvgServerManager, _to_numpy)
 from fedml_tpu.comm.message import Message
 from fedml_tpu.core import pytree as pt
 
@@ -91,7 +91,7 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             import time as _time
             self.liveness.observe_report_latency(
                 worker, _time.monotonic() - self._bcast_at)
-        with _DEVICE_LOCK:  # delta decompression is device compute
+        with self._device_lock:  # delta decompression is device compute
             payload = self._decode_model_payload(
                 msg.get(MSG_ARG_KEY_MODEL_PARAMS))
         self.aggregator.add_local_trained_result(
@@ -185,7 +185,7 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
                     Message(MSG_TYPE_S2C_FINISH, self.rank, worker))
             self.finish()
             return
-        with _DEVICE_LOCK:  # staleness merge: device compute
+        with self._device_lock:  # staleness merge: device compute
             self.global_model = pt.tree_axpy(
                 a, w_client, pt.tree_scale(self.global_model, 1.0 - a))
         self.version += 1
@@ -205,7 +205,7 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         rng = np.random.RandomState(self.version)
         client_idx = int(rng.randint(0, self.client_num_in_total))
         out = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, msg.get_sender_id())
-        with _DEVICE_LOCK:  # D2H transfer while other silos may train
+        with self._device_lock:  # D2H transfer while other silos may train
             out.add(MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(self.global_model))
         out.add(MSG_ARG_KEY_CLIENT_INDEX, client_idx)
         out.add(MSG_ARG_KEY_ROUND, self.version)

@@ -109,6 +109,10 @@ MSG_ARG_KEY_OBS_DIGEST = "obs_digest"
 #: 10 silos' first local_train calls racing the server init never
 #: returned; the identical protocol is fine on XLA:CPU). One lock around
 #: every device-touching section keeps the actor protocol portable.
+#: Under the federation scheduler (fedml_tpu/sched) every actor holds a
+#: per-job JobDeviceGate INSTEAD, which takes a fair-share slot and then
+#: THIS lock — so gated and ungated paths still serialize on one mutex.
+# ft: allow[FT018] sanctioned singleton: the physical device has ONE dispatch queue shared by every tenant — a per-job mutex could not serialize cross-job dispatch; job-fair ordering is layered on top by sched.RoundInterleaver
 _DEVICE_LOCK = threading.RLock()
 
 #: One jitted local_train per (module, task, cfg): in-process silos share
@@ -117,6 +121,7 @@ _DEVICE_LOCK = threading.RLock()
 #: anchor config over the chip tunnel — round 0 paid 10x that before this
 #: cache). Real multi-host cross-silo deployments have one silo per
 #: process, where this cache is a no-op.
+# ft: allow[FT018] sanctioned singleton: a cache of PURE jitted programs keyed by (module, task, cfg) — entries carry no job state, so tenants sharing an identical program is exactly the deduplication the cache exists for
 _LOCAL_TRAIN_CACHE: Dict = {}
 
 
@@ -221,8 +226,16 @@ class FedAvgServerManager(ServerManager):
                  round_deadline_s: Optional[float] = None,
                  min_quorum_frac: float = 0.5,
                  server_ckpt=None, pace=None, join_admission=None,
-                 max_deadline_extensions: Optional[int] = 25):
+                 max_deadline_extensions: Optional[int] = 25,
+                 device_gate=None):
         super().__init__(rank, size, com_manager)
+        #: the mutex every device-touching section holds. Default: the
+        #: process-wide _DEVICE_LOCK (single-tenant, byte-identical
+        #: legacy behavior). The federation scheduler passes a per-job
+        #: JobDeviceGate (sched/interleave.py) so tenants take
+        #: fair-share turns on the one chip.
+        self._device_lock = (device_gate if device_gate is not None
+                             else _DEVICE_LOCK)
         self.aggregator = aggregator
         self.comm_round = comm_round
         self.client_num_in_total = client_num_in_total
@@ -333,7 +346,7 @@ class FedAvgServerManager(ServerManager):
         state exists to save."""
         from flax import serialization as fser
         agg = self.aggregator
-        with _DEVICE_LOCK:  # D2H transfers are device dispatches
+        with self._device_lock:  # D2H transfers are device dispatches
             gm = fser.to_state_dict(_to_numpy(self.global_model))
             pending = {str(w): fser.to_state_dict(_to_numpy(m))
                        for w, m in agg.model_dict.items()}
@@ -625,13 +638,13 @@ class FedAvgServerManager(ServerManager):
         in_sync = (pol.downlink_enabled and self._mirror is not None
                    and self._silos_in_sync())
         self._bcast_seq += 1
-        with _DEVICE_LOCK:  # D2H transfer is a device dispatch
+        with self._device_lock:  # D2H transfer is a device dispatch
             full = _to_numpy(self.global_model)
         if not in_sync:
             self._mirror = full
             self._mirror_fp = tree_fingerprint(full)
             return full
-        with _DEVICE_LOCK:  # delta compression is device compute
+        with self._device_lock:  # delta compression is device compute
             key = jax.random.fold_in(jax.random.key(1733), self._bcast_seq)
             payload, _ = compress_for_policy(full, self._mirror, None, key,
                                              pol)
@@ -733,7 +746,7 @@ class FedAvgServerManager(ServerManager):
         if obs_row is not None:
             self.obs.recorder.append(obs_row)
         try:
-            with _DEVICE_LOCK:  # delta decompression is device compute
+            with self._device_lock:  # delta decompression is device compute
                 payload = self._decode_model_payload(
                     msg.get(MSG_ARG_KEY_MODEL_PARAMS))
         except Exception:
@@ -804,7 +817,7 @@ class FedAvgServerManager(ServerManager):
                 "partial": bool(partial)})
             if partial:
                 self.ft_counters["partial_rounds"] += 1
-        with _DEVICE_LOCK:
+        with self._device_lock:
             self.global_model = self._aggregate_round(partial=partial)
         if self.on_round_done is not None:
             # outside the lock: eval re-locks internally, sink I/O doesn't
@@ -1033,7 +1046,7 @@ class FedAvgServerManager(ServerManager):
         if self._mirror is not None:
             payload = self._mirror
         else:
-            with _DEVICE_LOCK:  # D2H transfer is a device dispatch
+            with self._device_lock:  # D2H transfer is a device dispatch
                 payload = _to_numpy(self.global_model)
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
@@ -1128,9 +1141,14 @@ class FedAvgClientManager(ClientManager):
                  heartbeat_s: float = 0.0,
                  rejoin_idle_s: Optional[float] = None,
                  join_on_start: bool = False,
-                 obs=None):
+                 obs=None, device_gate=None):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
+        #: device mutex (see FedAvgServerManager): the process-wide
+        #: _DEVICE_LOCK by default, a per-job fair-share gate under the
+        #: federation scheduler
+        self._device_lock = (device_gate if device_gate is not None
+                             else _DEVICE_LOCK)
         #: observability bundle (fedml_tpu/obs): when set, this silo
         #: writes its own flight log AND piggybacks a compact counter
         #: digest on replies/heartbeats. None (default) = the legacy
@@ -1353,7 +1371,7 @@ class FedAvgClientManager(ClientManager):
                     "silo received a compressed broadcast before any "
                     "full-precision model — the server must send INIT "
                     "full (transport reordering or a protocol bug)")
-            with _DEVICE_LOCK:  # delta rebuild is device compute
+            with self._device_lock:  # delta rebuild is device compute
                 variables = _to_numpy(decompress(variables, self._held))
         self._held = variables
         seq = msg.get_params().get(MSG_ARG_KEY_BCAST_SEQ)
@@ -1427,7 +1445,7 @@ class FedAvgClientManager(ClientManager):
         # SHARED f32 formula (round_lr_scale) so every driver path scales
         # by the bit-identical factor
         scale = round_lr_scale(self._train_cfg, round_idx)
-        with _DEVICE_LOCK:
+        with self._device_lock:
             key = jax.random.fold_in(
                 jax.random.fold_in(self._base_key, round_idx), client_idx)
             if scale is None:
@@ -1523,7 +1541,9 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           join_rate_limit: float = 0.0,
                           max_deadline_extensions: Optional[int] = 25,
                           obs_dir: Optional[str] = None,
-                          job_id: Optional[str] = None):
+                          job_id: Optional[str] = None,
+                          comm_factory=None,
+                          device_gate=None):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -1587,7 +1607,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                       checkpoint_mgr=checkpoint_mgr, resume=resume,
                       compression=policy,
                       round_deadline_s=round_deadline_s,
-                      min_quorum_frac=min_quorum_frac, **control)
+                      min_quorum_frac=min_quorum_frac,
+                      device_gate=device_gate, **control)
         if server_optimizer:
             return FedOptServerManager(
                 0, size, server_com, aggregator, comm_round,
@@ -1598,6 +1619,14 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                                    comm_round, dataset.client_num,
                                    global_model, **common)
 
+    if job_id is None and (checkpoint_dir or server_checkpoint_dir):
+        # launch_federation keys the derived default job id on
+        # client_state_dir only; a run that persists via
+        # server_checkpoint_dir alone must ALSO rejoin its own flight
+        # timeline on crash-resume instead of forking a phantom job
+        from fedml_tpu.obs import default_job_id
+        job_id = default_job_id(
+            "fed", stable_key=(checkpoint_dir or server_checkpoint_dir))
     model, history, _ = launch_federation(
         dataset, module, task, worker_num, train_cfg, server_factory,
         backend=backend, addresses=addresses, wire_codec=wire_codec,
@@ -1606,7 +1635,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         join_timeout_s=join_timeout_s, round_record_hook=round_record_hook,
         timer=timer, prefetch_depth=prefetch_depth,
         heartbeat_s=heartbeat_s, fault_plan=fault_plan,
-        obs_dir=obs_dir, job_id=job_id)
+        obs_dir=obs_dir, job_id=job_id,
+        comm_factory=comm_factory, device_gate=device_gate)
     return model, history
 
 
@@ -1626,7 +1656,9 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       heartbeat_s: float = 0.0,
                       fault_plan=None,
                       obs_dir: Optional[str] = None,
-                      job_id: Optional[str] = None):
+                      job_id: Optional[str] = None,
+                      comm_factory=None,
+                      device_gate=None):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
@@ -1635,19 +1667,47 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     manager (callers that want a non-``none`` downlink construct their
     server with the same resolved policy). Returns ``(final global
     model, history, server)`` — the server carries ``round_timer`` with
-    the wire byte accounting."""
+    the wire byte accounting.
+
+    Multi-job tenancy hooks (fedml_tpu/sched): ``comm_factory(rank)``
+    supplies each rank's endpoint instead of ``create_comm_manager``
+    (the scheduler hands per-job virtual channels over one shared
+    fabric); ``device_gate`` replaces the process-wide device lock with
+    a per-job fair-share gate. Both ``None`` (the default) is the
+    byte-identical single-tenant path."""
     train_cfg = train_cfg or TrainConfig()
     policy = resolve_compression(compression, compress=compress)
     size = worker_num + 1
-    router = InProcRouter() if backend.upper() in ("INPROC", "MPI") else None
-    # parse ONCE: one seeded plan instance shared by every endpoint, so
-    # per-rank RNG streams come from the same seed (comm/faults.py)
-    from fedml_tpu.comm.faults import parse_fault_plan
-    plan = parse_fault_plan(fault_plan)
+    gate = device_gate if device_gate is not None else _DEVICE_LOCK
+    if comm_factory is not None:
+        # the factory's endpoints are prebuilt elsewhere (the scheduler's
+        # shared fabric): transport knobs only create_comm_manager
+        # consumes would be silently dropped here — refuse, so a caller
+        # expecting chaos injection or wire auth cannot run without them
+        dropped = [name for name, unset in (
+            ("fault_plan", fault_plan is None),
+            ("token", token is None),
+            ("addresses", addresses is None),
+            ("wire_codec", wire_codec)) if not unset]
+        if dropped:
+            raise ValueError(
+                f"comm_factory supplies prebuilt endpoints: {dropped} "
+                "would be silently ignored — apply transport knobs where "
+                "the endpoints are built (e.g. SharedFabric(wire_codec=, "
+                "token=, fault_plan=))")
+        router, plan = None, None
+    else:
+        router = (InProcRouter()
+                  if backend.upper() in ("INPROC", "MPI") else None)
+        # parse ONCE: one seeded plan instance shared by every endpoint,
+        # so per-rank RNG streams come from the same seed (comm/faults.py)
+        from fedml_tpu.comm.faults import parse_fault_plan
+        plan = parse_fault_plan(fault_plan)
 
     sample_x = dataset.train_data_global[0][:1]
-    global_model = module.init(jax.random.key(seed), jnp.asarray(sample_x),
-                               train=False)
+    with gate:  # model init is a device dispatch (tenants contend)
+        global_model = module.init(jax.random.key(seed),
+                                   jnp.asarray(sample_x), train=False)
     history: List[Dict] = []
     eval_fn = jax.jit(make_eval(module, task))
 
@@ -1655,7 +1715,7 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
         xt, yt = dataset.test_data_global
         if not len(xt):
             return
-        with _DEVICE_LOCK:  # only the eval is device compute
+        with gate:  # only the eval is device compute
             stats = eval_fn(model, jnp.asarray(xt), jnp.asarray(yt),
                             jnp.ones(len(xt), jnp.float32))
             acc = float(stats["correct_sum"]) / max(1.0,
@@ -1679,10 +1739,13 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                                 round_idx, exc_info=True)
 
     aggregator = FedAvgAggregator(worker_num)
-    server_com = create_comm_manager(backend, 0, size, router=router,
-                                     addresses=addresses,
-                                     wire_codec=wire_codec, token=token,
-                                     fault_plan=plan)
+    if comm_factory is not None:
+        server_com = comm_factory(0)
+    else:
+        server_com = create_comm_manager(backend, 0, size, router=router,
+                                         addresses=addresses,
+                                         wire_codec=wire_codec, token=token,
+                                         fault_plan=plan)
     server = server_factory(size, server_com, aggregator, global_model,
                             on_round_done)
     from fedml_tpu.utils.tracing import RoundTimer
@@ -1691,8 +1754,15 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     # role — the server gets the anomaly detector + one-shot profiler,
     # each silo records its own log and piggybacks digests. obs_dir
     # None (default) keeps the wire format byte-identical.
-    from fedml_tpu.obs import build_observability, endpoint_epoch
-    job = job_id or "fed"
+    from fedml_tpu.obs import (build_observability, default_job_id,
+                               endpoint_epoch)
+    # collision-safe default: two unconfigured jobs sharing an obs dir
+    # must never interleave under one literal id (computed ONCE per
+    # launch so every rank of this run carries the same id). Keyed on
+    # the run's durable namespace when it has one, so a crash-resumed
+    # leg rejoins its own flight timeline instead of forking a phantom
+    # second job.
+    job = job_id or default_job_id("fed", stable_key=client_state_dir)
     obs_server = build_observability(obs_dir, job_id=job, rank=0,
                                      role="server")
     if obs_server is not None:
@@ -1702,9 +1772,13 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     clients = []
     client_coms = []
     for rank in range(1, size):
-        com = create_comm_manager(backend, rank, size, router=router,
-                                  addresses=addresses, wire_codec=wire_codec,
-                                  token=token, fault_plan=plan)
+        if comm_factory is not None:
+            com = comm_factory(rank)
+        else:
+            com = create_comm_manager(backend, rank, size, router=router,
+                                      addresses=addresses,
+                                      wire_codec=wire_codec,
+                                      token=token, fault_plan=plan)
         # ft: allow[FT008] one endpoint per SILO at launch — bounded by worker_num (tens), not the client population
         client_coms.append(com)
         silo_obs = build_observability(obs_dir, job_id=job, rank=rank,
@@ -1718,7 +1792,8 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
             state_dir=(os.path.join(client_state_dir, f"silo_{rank}")
                        if client_state_dir else None),
             resume=resume, prefetch_depth=prefetch_depth,
-            heartbeat_s=heartbeat_s, obs=silo_obs))
+            heartbeat_s=heartbeat_s, obs=silo_obs,
+            device_gate=device_gate))
 
     # Warm the two heavyweight programs ON THE MAIN THREAD before any
     # actor thread starts: one local_train at the padded shape and one
@@ -1748,20 +1823,26 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
         # handle_message_init
         warm_key = jax.random.fold_in(
             jax.random.fold_in(jax.random.key(seed), 0), 0)
-        warm_vars, _ = _shared_local_train(module, task, train_cfg)(
-            _to_numpy(global_model), jnp.asarray(wx[0]), jnp.asarray(wy[0]),
-            jnp.asarray(wmask[0]), warm_key, **warm_kw)
-        jax.block_until_ready(warm_vars)
+        # under the scheduler this launch's warmup races OTHER tenants'
+        # live rounds on the shared chip — hold the (per-job) gate for
+        # the executions; solo launches see an uncontended lock
+        with gate:
+            warm_vars, _ = _shared_local_train(module, task, train_cfg)(
+                _to_numpy(global_model), jnp.asarray(wx[0]),
+                jnp.asarray(wy[0]), jnp.asarray(wmask[0]), warm_key,
+                **warm_kw)
+            jax.block_until_ready(warm_vars)
         del warm_vars
         logging.info("cross-silo warmup: local_train ready in %.1fs; "
                      "eval compile...", _time.time() - t0)
         t0 = _time.time()
         xt, yt = dataset.test_data_global
         if len(xt):
-            warm_stats = eval_fn(global_model, jnp.asarray(xt),
-                                 jnp.asarray(yt),
-                                 jnp.ones(len(xt), jnp.float32))
-            jax.block_until_ready(warm_stats)
+            with gate:
+                warm_stats = eval_fn(global_model, jnp.asarray(xt),
+                                     jnp.asarray(yt),
+                                     jnp.ones(len(xt), jnp.float32))
+                jax.block_until_ready(warm_stats)
         logging.info("cross-silo warmup: eval ready in %.1fs (test n=%d)",
                      _time.time() - t0, len(xt))
     except Exception:  # warmup is an optimization, never a launch blocker

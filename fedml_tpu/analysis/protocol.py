@@ -59,7 +59,10 @@ from fedml_tpu.analysis.lint import FileContext, dotted_name, is_test_path
 GRAPH_VERSION = 1
 
 #: envelope/header keys every message carries — never payload contract
-_HEADER_KEYS = frozenset({"msg_type", "sender", "receiver", "__wire_seq__"})
+#: (__wire_job__ is the scheduler's tenancy tag, stamped at the
+#: transport layer like the reliable seq stamp — comm/base.py)
+_HEADER_KEYS = frozenset({"msg_type", "sender", "receiver", "__wire_seq__",
+                          "__wire_job__"})
 
 _HINTS = {
     "FT200": ("regenerate the snapshot: python -m fedml_tpu.analysis "

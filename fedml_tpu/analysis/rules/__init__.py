@@ -1,6 +1,6 @@
 """Rule registry: one module per project-specific rule.
 
-Each rule carries an id (FT001..FT017), a docstring explaining the
+Each rule carries an id (FT001..FT018), a docstring explaining the
 hazard in THIS codebase's terms, and a fix hint. ``all_rules()`` is the
 canonical ordered instantiation the engine and the CLI share.
 
@@ -39,6 +39,7 @@ from fedml_tpu.analysis.rules.donation import DonatedReuseRule
 from fedml_tpu.analysis.rules.float64 import Float64Rule
 from fedml_tpu.analysis.rules.host_sync import HostSyncRule
 from fedml_tpu.analysis.rules.jit_static import JitScalarArgRule
+from fedml_tpu.analysis.rules.job_isolation import JobIsolationRule
 from fedml_tpu.analysis.rules.metrics_names import MetricNameRule
 from fedml_tpu.analysis.rules.population_growth import PopulationGrowthRule
 from fedml_tpu.analysis.rules.rng import GlobalRngRule
@@ -49,7 +50,7 @@ _RULES = (GlobalRngRule, DonatedReuseRule, HostSyncRule,
           CommTimeoutRule, PopulationGrowthRule, ServerStateRule,
           SharedStateLockRule, LockOrderRule,
           FsEnumOrderRule, SetIterationOrderRule,
-          WallClockControlFlowRule, MetricNameRule)
+          WallClockControlFlowRule, MetricNameRule, JobIsolationRule)
 
 #: engine / whole-program / audit checks that are not per-file Rule
 #: instances but are part of the rule surface

@@ -1,0 +1,136 @@
+"""FT018 — module-global mutable state reachable from a job's
+server/silo classes (tenancy isolation guard).
+
+Multi-job tenancy (``fedml_tpu/sched``) runs N federations in ONE
+process: every server manager, silo actor, control-plane object, and
+compression mirror must be *instanced or keyed per job*, or two tenants
+silently share state and the bit-exact solo-parity contract (the chaos
+harness's acceptance oracle) rots the first time someone caches
+something at module scope "for convenience". That failure mode is
+invisible to single-job tests — exactly the class a static guard
+exists for.
+
+The rule: in the cross-silo actor modules and the scheduler package, a
+module-level binding of a MUTABLE container or synchronization object
+(dict/list/set literals and comprehensions; ``dict()``/``list()``/
+``set()``/``defaultdict``/``deque``/``OrderedDict``/``Counter`` calls;
+``threading.Lock/RLock/Condition/Event/Semaphore``; ``queue.Queue``
+family) is a finding when it is *reachable from a job's server/silo
+classes* — referenced inside a class whose base names a
+``*ServerManager``/``*ClientManager``, or inside a module-level
+function such a class calls (one hop — the ``_shared_local_train``
+pattern).
+
+Sanctioned singletons carry ``# ft: allow[FT018] why`` at the binding:
+the device mutex (one physical dispatch queue exists no matter how many
+tenants) and the pure jitted-program cache (keyed by (module, task,
+cfg), carries no job state) are the two in-tree examples — the pragma
+rationale is the review surface for any future one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_corpus_path)
+
+#: the multi-tenant actor surface: cross-silo server/silo modules + the
+#: scheduler package itself (path suffixes / path fragments)
+_SCOPED_SUFFIXES = ("algorithms/fedavg_cross_silo.py",
+                    "algorithms/fedavg_async.py")
+_SCOPED_FRAGMENT = "fedml_tpu/sched/"
+
+#: constructor names (last dotted component) that build mutable state
+_MUTABLE_CTORS = frozenset({
+    "dict", "list", "set", "defaultdict", "deque", "OrderedDict",
+    "Counter", "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "LifoQueue", "PriorityQueue",
+    "SimpleQueue",
+})
+
+_ACTOR_BASES = ("ServerManager", "ClientManager")
+
+
+def _is_mutable_binding(value: ast.expr) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        if name and name.split(".")[-1] in _MUTABLE_CTORS:
+            return True
+    return False
+
+
+def _is_actor_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = (dotted_name(base) or "").split(".")[-1]
+        if any(tok in name for tok in _ACTOR_BASES):
+            return True
+    return False
+
+
+def _names_loaded(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class JobIsolationRule(Rule):
+    id = "FT018"
+    title = ("module-global mutable state reachable from a job's "
+             "server/silo classes (tenancy isolation hazard)")
+    hint = ("move the state onto the manager instance (or key it per "
+            "job id); a deliberate process-wide singleton carries "
+            "# ft: allow[FT018] with the rationale reviewers will hold "
+            "it to")
+
+    def applies(self, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        return (any(rel.endswith(s) for s in _SCOPED_SUFFIXES)
+                or _SCOPED_FRAGMENT in rel
+                or is_corpus_path(relpath))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # 1) module-level mutable bindings: name -> binding node
+        bindings: Dict[str, ast.AST] = {}
+        for node in ctx.tree.body:
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not _is_mutable_binding(value):
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    bindings[tgt.id] = node
+        if not bindings:
+            return
+        # 2) names referenced inside actor classes, and the module-level
+        #    functions those classes reach (one hop)
+        module_funcs = {n.name: n for n in ctx.tree.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        reachable: Set[str] = set()
+        for cls in ctx.tree.body:
+            if not isinstance(cls, ast.ClassDef) or not _is_actor_class(cls):
+                continue
+            direct = _names_loaded(cls)
+            reachable |= direct
+            for fname in direct & set(module_funcs):
+                reachable |= _names_loaded(module_funcs[fname])
+        for name in sorted(set(bindings) & reachable):
+            node = bindings[name]
+            yield ctx.finding(
+                self, node,
+                f"module-global mutable {name!r} is reachable from a "
+                f"server/silo class — under multi-job tenancy every "
+                f"tenant in this process shares it, so one job's state "
+                f"can leak into another's trajectory (the bit-exact "
+                f"solo-parity contract breaks silently)")

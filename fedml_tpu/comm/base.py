@@ -21,6 +21,15 @@ from fedml_tpu.comm.message import Message
 #: life's.
 WIRE_SEQ_KEY = "__wire_seq__"
 
+#: tenancy tag (fedml_tpu/sched/router.py): a frame multiplexed over a
+#: shared endpoint carries the job it belongs to. Reliable-delivery
+#: streams are keyed per ``(peer, job)`` — two jobs sharing one physical
+#: endpoint pair keep INDEPENDENT epoch/seq streams and dedup windows,
+#: so job A's restart can never supersede job B's live stream. Absent
+#: (the single-tenant default) the stream key degenerates to the peer
+#: alone: byte-identical legacy behavior.
+WIRE_JOB_KEY = "__wire_job__"
+
 #: dedup window per sender: seqs older than (highest seen - window) are
 #: treated as duplicates. 4096 in-flight frames per peer is orders of
 #: magnitude beyond the protocol's round-trip pipelining.
@@ -63,25 +72,69 @@ class BaseCommunicationManager(abc.ABC):
         self._seq_lock = threading.Lock()
         #: this endpoint incarnation's stream epoch (see WIRE_SEQ_KEY)
         self._seq_epoch = int.from_bytes(os.urandom(4), "big")
-        self._send_seq: Dict[int, int] = defaultdict(int)
-        #: sender -> (epoch, seen seq set, highest seq seen) — receive dedup
-        self._seen: Dict[int, Tuple[int, Set[int], int]] = {}
-        #: sender -> superseded incarnation epochs (late frames from a
-        #: previous life must stay dropped, not reopen a window)
-        self._old_epochs: Dict[int, Set[int]] = defaultdict(set)
+        #: stream key is (peer, job tag) — see WIRE_JOB_KEY; job is None
+        #: on every single-tenant frame
+        self._send_seq: Dict[Tuple, int] = defaultdict(int)
+        #: (sender, job) -> (epoch, seen seq set, highest seq seen) —
+        #: receive dedup
+        self._seen: Dict[Tuple, Tuple[int, Set[int], int]] = {}
+        #: (sender, job) -> superseded incarnation epochs (late frames
+        #: from a previous life must stay dropped, not reopen a window)
+        self._old_epochs: Dict[Tuple, Set[int]] = defaultdict(set)
+        #: (job, "tx"/"rx") -> bytes: the per-tenant slice of the wire
+        #: totals on a shared endpoint (sched/router.py) — what each
+        #: JobChannel reports as ITS bytes_sent/bytes_received, so the
+        #: per-job SLO/billing accounting is real frame lengths, not
+        #: zeros. Two ints per job ever seen; deliberately NOT purged
+        #: with the job's streams (the launcher's final wire credit
+        #: runs after FINISH stops the channel).
+        self._job_bytes: Dict[Tuple, int] = defaultdict(int)
+        #: (job, counter name) -> count: the per-tenant slice of the
+        #: fault-tolerance event counters, credited at the sites where
+        #: the frame (and so its job tag) is in hand — send retries,
+        #: dedup drops. Same non-purged lifetime as _job_bytes.
+        self._job_counters: Dict[Tuple, int] = defaultdict(int)
 
-    def _count_sent(self, n: int) -> None:
+    def _count_sent(self, n: int, job=None) -> None:
         with self._bytes_lock:
             self.bytes_sent += int(n)
+            if job is not None:
+                self._job_bytes[(job, "tx")] += int(n)
 
-    def _count_received(self, n: int) -> None:
+    def _count_received(self, n: int, job=None) -> None:
         with self._bytes_lock:
             self.bytes_received += int(n)
+            if job is not None:
+                self._job_bytes[(job, "rx")] += int(n)
 
-    def bump(self, name: str, n: int = 1) -> None:
-        """Increment a fault-tolerance event counter."""
+    def _credit_job_received(self, n: int, job) -> None:
+        """Per-job slice ONLY — for backends whose raw inbound frames
+        are counted on the socket thread, before decode reveals the
+        job tag (tcp/grpc)."""
+        if job is None:
+            return
+        with self._bytes_lock:
+            self._job_bytes[(job, "rx")] += int(n)
+
+    def job_bytes(self, job) -> Tuple[int, int]:
+        """(sent, received) bytes carried for ``job`` on this endpoint."""
+        with self._bytes_lock:
+            return (self._job_bytes.get((job, "tx"), 0),
+                    self._job_bytes.get((job, "rx"), 0))
+
+    def bump(self, name: str, n: int = 1, job=None) -> None:
+        """Increment a fault-tolerance event counter; ``job`` (when the
+        event's frame is in hand) also credits the tenant's slice."""
         with self._bytes_lock:
             self.counters[name] += int(n)
+            if job is not None:
+                self._job_counters[(job, name)] += int(n)
+
+    def job_counters(self, job) -> Dict[str, int]:
+        """``job``'s slice of the fault-tolerance event counters."""
+        with self._bytes_lock:
+            return {name: v for (j, name), v in self._job_counters.items()
+                    if j == job}
 
     # -- reliable-delivery bookkeeping --------------------------------------
     def _stamp_seq(self, msg: Message) -> None:
@@ -93,9 +146,10 @@ class BaseCommunicationManager(abc.ABC):
         """
         if WIRE_SEQ_KEY in msg.msg_params:
             return
+        stream = (msg.get_receiver_id(), msg.msg_params.get(WIRE_JOB_KEY))
         with self._seq_lock:
-            self._send_seq[msg.get_receiver_id()] += 1
-            seq = self._send_seq[msg.get_receiver_id()]
+            self._send_seq[stream] += 1
+            seq = self._send_seq[stream]
         msg.add(WIRE_SEQ_KEY, [self._seq_epoch, seq])
 
     def _accept(self, msg: Message) -> bool:
@@ -108,15 +162,15 @@ class BaseCommunicationManager(abc.ABC):
         if stamp is None:
             return True
         epoch, seq = int(stamp[0]), int(stamp[1])
-        sender = msg.get_sender_id()
+        stream = (msg.get_sender_id(), msg.msg_params.get(WIRE_JOB_KEY))
         with self._seq_lock:
-            cur_epoch, seen, high = self._seen.get(sender,
+            cur_epoch, seen, high = self._seen.get(stream,
                                                    (None, set(), 0))
-            if epoch in self._old_epochs[sender]:
+            if epoch in self._old_epochs[stream]:
                 return False  # late frame from a superseded incarnation
             if cur_epoch is not None and epoch != cur_epoch:
                 # fresh incarnation: supersede the old stream, reset window
-                self._old_epochs[sender].add(cur_epoch)
+                self._old_epochs[stream].add(cur_epoch)
                 seen, high = set(), 0
             if seq in seen or seq <= high - _DEDUP_WINDOW:
                 return False
@@ -126,8 +180,29 @@ class BaseCommunicationManager(abc.ABC):
             if len(seen) > 2 * _DEDUP_WINDOW:
                 floor = high - _DEDUP_WINDOW
                 seen = {s for s in seen if s > floor}
-            self._seen[sender] = (epoch, seen, high)
+            self._seen[stream] = (epoch, seen, high)
         return True
+
+    def purge_streams(self, job) -> None:
+        """Drop the heavy reliable-delivery stream state whose job tag
+        equals ``job`` — a finished tenant on a shared endpoint
+        (sched/router.py). A relaunched job opens fresh streams under a
+        new channel epoch, so the seq windows are never consulted
+        again; keeping them would leak one dedup window per
+        ``(peer, job)`` ever seen on a persistent fabric. The purged
+        incarnation's epoch is folded into ``_old_epochs`` (ints only)
+        rather than dropped: a late transport-retried frame from the
+        dead incarnation must stay dropped — if its epoch were
+        forgotten, ``_accept`` would treat the RELAUNCHED job's live
+        epoch as the superseded one and wedge the new stream."""
+        with self._seq_lock:
+            for k in [k for k in self._send_seq if k[1] == job]:
+                del self._send_seq[k]
+            for k in [k for k in self._seen if k[1] == job]:
+                epoch = self._seen[k][0]
+                if epoch is not None:
+                    self._old_epochs[k].add(epoch)
+                del self._seen[k]
 
     @abc.abstractmethod
     def send_message(self, msg: Message) -> None:
@@ -141,7 +216,7 @@ class BaseCommunicationManager(abc.ABC):
 
     def _notify(self, msg: Message) -> None:
         if not self._accept(msg):
-            self.bump("dedup_drops")
+            self.bump("dedup_drops", job=msg.msg_params.get(WIRE_JOB_KEY))
             return
         for obs in list(self._observers):
             obs.receive_message(msg.get_type(), msg)

@@ -233,6 +233,9 @@ class FaultyCommManager:
         self._fired = defaultdict(int)     # rule idx -> faults injected
         self._down_until = 0.0
         self.counters: Dict[str, int] = defaultdict(int)
+        #: (job, counter name) -> count: the wrapper's own per-tenant
+        #: slice (see BaseCommunicationManager._job_counters)
+        self._job_counters: Dict[tuple, int] = defaultdict(int)
         inner.add_observer(_InnerTap(self))
 
     # -- byte accounting: the inner backend owns the wire ------------------
@@ -244,9 +247,25 @@ class FaultyCommManager:
     def bytes_received(self) -> int:
         return self.inner.bytes_received
 
-    def bump(self, name: str, n: int = 1) -> None:
+    def job_bytes(self, job):
+        return self.inner.job_bytes(job)
+
+    def job_counters(self, job):
+        out = dict(self.inner.job_counters(job))
+        with self._rng_lock:
+            for (j, name), v in self._job_counters.items():
+                if j == job:
+                    out[name] = out.get(name, 0) + int(v)
+        return out
+
+    def purge_streams(self, job) -> None:
+        self.inner.purge_streams(job)
+
+    def bump(self, name: str, n: int = 1, job=None) -> None:
         with self._rng_lock:
             self.counters[name] += int(n)
+            if job is not None:
+                self._job_counters[(job, name)] += int(n)
 
     def all_counters(self) -> Dict[str, int]:
         """Wrapper fault counts merged with the inner backend's transport

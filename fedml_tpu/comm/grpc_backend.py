@@ -31,7 +31,7 @@ import queue
 import threading
 from typing import Dict, Iterator, Optional, Tuple
 
-from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.base import WIRE_JOB_KEY, BaseCommunicationManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.reliable import RetryPolicy, retry_call
 
@@ -172,8 +172,9 @@ class GrpcCommManager(BaseCommunicationManager):
             attempt, self.retry,
             describe=f"grpc sendMessage to rank {dest} ({host}:{port})",
             is_transient=_is_transient_rpc,
-            on_retry=lambda a, exc: self.bump("retries"))
-        self._count_sent(n)
+            on_retry=lambda a, exc: self.bump(
+                "retries", job=msg.msg_params.get(WIRE_JOB_KEY)))
+        self._count_sent(n, msg.msg_params.get(WIRE_JOB_KEY))
 
     def handle_receive_message(self) -> None:
         self._running = True
@@ -181,7 +182,12 @@ class GrpcCommManager(BaseCommunicationManager):
             item = self._inbox.get()
             if item is _STOP:
                 break
-            self._notify(Message.from_bytes(item))
+            n = len(item)
+            msg = Message.from_bytes(item)
+            # raw total was counted on the servicer thread; the per-job
+            # slice needs the decoded tag
+            self._credit_job_received(n, msg.msg_params.get(WIRE_JOB_KEY))
+            self._notify(msg)
 
     def stop_receive_message(self) -> None:
         self._running = False

@@ -14,7 +14,7 @@ import queue
 import threading
 from typing import Dict
 
-from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.base import WIRE_JOB_KEY, BaseCommunicationManager
 from fedml_tpu.comm.message import Message
 
 _STOP = object()
@@ -55,7 +55,8 @@ class InProcCommManager(BaseCommunicationManager):
         self._stamp_seq(msg)
         if self.wire_codec:
             payload = msg.to_bytes()
-            self._count_sent(len(payload))
+            self._count_sent(len(payload),
+                             msg.msg_params.get(WIRE_JOB_KEY))
         else:
             payload = msg  # object hand-off: no frame, no byte accounting
         self.router.mailbox(msg.get_receiver_id()).put(payload)
@@ -67,8 +68,10 @@ class InProcCommManager(BaseCommunicationManager):
             if item is _STOP:
                 break
             if isinstance(item, (bytes, bytearray)):
-                self._count_received(len(item))
+                n = len(item)
                 item = Message.from_bytes(item)
+                self._count_received(n,
+                                     item.msg_params.get(WIRE_JOB_KEY))
             self._notify(item)
 
     def stop_receive_message(self) -> None:

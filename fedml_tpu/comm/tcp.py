@@ -24,7 +24,7 @@ import struct
 import threading
 from typing import Dict, Optional, Tuple
 
-from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.base import WIRE_JOB_KEY, BaseCommunicationManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.reliable import RetryPolicy, retry_call
 
@@ -88,7 +88,7 @@ class _Peer:
         self.retry = retry
         self.lock = threading.Lock()
         self.sock: socket.socket | None = None
-        self._bump = bump or (lambda name, n=1: None)
+        self._bump = bump or (lambda name, n=1, job=None: None)
 
     def _send_once(self, frame) -> None:
         """One attempt: (re)connect if needed, write the frame. A failed
@@ -107,21 +107,23 @@ class _Peer:
                 self.sock = None
             raise
 
-    def send(self, frame) -> None:
+    def send(self, frame, job=None) -> None:
         """``frame``: bytes-like or a parts list (see ``send_frame``).
 
         Retried under the peer's policy; raises ``TransportError`` after
         the budget is spent — never a silent drop. The retried frame
         carries the same wire seq (stamped before encoding), so a
         duplicate from a send that failed AFTER delivery is shed by the
-        receiver's dedup.
+        receiver's dedup. ``job`` credits retries to the tenant's
+        counter slice on a shared fabric.
         """
         with self.lock:
             retry_call(
                 lambda: self._send_once(frame), self.retry,
                 describe=f"tcp send to {self.address[0]}:{self.address[1]}",
                 is_transient=lambda exc: isinstance(exc, OSError),
-                on_retry=lambda attempt, exc: self._bump("retries"))
+                on_retry=lambda attempt, exc: self._bump("retries",
+                                                         job=job))
 
     def close(self) -> None:
         with self.lock:
@@ -174,8 +176,9 @@ class TcpCommManager(BaseCommunicationManager):
         # parts, not one joined frame: a model update goes header-then-
         # buffers straight to the socket with no contiguous copy
         parts = msg.to_parts()
-        peer.send(parts)
-        self._count_sent(sum(len(p) for p in parts))
+        peer.send(parts, job=msg.msg_params.get(WIRE_JOB_KEY))
+        self._count_sent(sum(len(p) for p in parts),
+                         msg.msg_params.get(WIRE_JOB_KEY))
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
@@ -216,7 +219,12 @@ class TcpCommManager(BaseCommunicationManager):
             item = self._inbox.get()
             if item is _STOP:
                 break
-            self._notify(Message.from_bytes(item))
+            n = len(item)
+            msg = Message.from_bytes(item)
+            # raw total was counted on the socket thread; the per-job
+            # slice needs the decoded tag
+            self._credit_job_received(n, msg.msg_params.get(WIRE_JOB_KEY))
+            self._notify(msg)
 
     def stop_receive_message(self) -> None:
         self._running = False

@@ -27,6 +27,7 @@ every write path degrades to a logged warning, never an exception.
 
 from __future__ import annotations
 
+import itertools
 import logging
 from typing import Any, Dict, Optional
 
@@ -41,10 +42,46 @@ from fedml_tpu.obs.registry import METRICS, metric_names
 __all__ = [
     "AnomalyProfiler", "FlightRecorder", "Observability",
     "PerfAccountant", "RoundAnomalyDetector", "FLIGHT_FORMAT", "METRICS",
-    "build_observability", "check_against_ledger", "derive_perf_record",
-    "device_peak_flops", "endpoint_epoch", "flight_log_paths",
-    "merge_flight_logs", "metric_names", "read_flight_log",
+    "build_observability", "check_against_ledger", "default_job_id",
+    "derive_perf_record", "device_peak_flops", "endpoint_epoch",
+    "flight_log_paths", "merge_flight_logs", "metric_names",
+    "read_flight_log",
 ]
+
+
+#: per-process nonce feeding default_job_id (two launches in ONE
+#: process — e.g. back-to-back runs in a test session — must also
+#: derive distinct ids)
+_JOB_ID_COUNTER = itertools.count()
+
+
+def default_job_id(prefix: str = "job", stable_key=None) -> str:
+    """A collision-safe default job id for launches that set none.
+
+    Flight records from different runs sharing one obs dir align on
+    ``(job_id, round)`` — a LITERAL default ("fed") makes two
+    unconfigured runs interleave into one phantom job. The derived id
+    is ``<prefix>-<8 hex>``: of ``stable_key`` when given (the run's
+    durable namespace, e.g. its checkpoint dir — a RESTARTED resume leg
+    must rejoin its previous incarnation's flight timeline, not fork a
+    phantom second job), else of this run's identity (pid + a
+    wall/counter nonce): stable for the launch that computed it (the
+    launcher stamps every rank with the SAME id), unique across runs.
+    Explicitly configured ids always win — this is only the unset
+    fallback.
+    """
+    import hashlib
+    import os
+    import time
+    if stable_key:
+        token = hashlib.sha1(
+            os.path.abspath(str(stable_key)).encode()).hexdigest()[:8]
+    else:
+        nonce = next(_JOB_ID_COUNTER)
+        token = hashlib.sha1(
+            f"{os.getpid()}:{time.time_ns()}:{nonce}".encode()
+        ).hexdigest()[:8]
+    return f"{prefix}-{token}"
 
 
 def endpoint_epoch(com) -> Optional[int]:

@@ -76,7 +76,8 @@ def _cmd_merge(args) -> int:
         print()
     elif args.format == "csv":
         flat = round_table_rows(merged)
-        cols = ["round", "duration_s", "cohort", "reported", "partial",
+        cols = ["round", "job_id", "duration_s", "cohort", "reported",
+                "partial",
                 "mfu", "overlap_frac", "wire_up_bps", "wire_down_bps",
                 "bytes_up", "bytes_down", "report_latency_p50_s",
                 "silo_reports", "anomalies"]
@@ -210,8 +211,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "this ledger.jsonl; exit 1 on mismatch")
     m.add_argument("--output", type=str, default=None,
                    help="write the merged timeline JSON here")
-    m.add_argument("--job_id", type=str, default=None,
-                   help="restrict the merge to one job id")
+    m.add_argument("--job_id", "--job", type=str, default=None,
+                   help="restrict the merge to one job id (tenant) — "
+                        "with a scheduler-shared obs dir this is the "
+                        "per-tenant inspection filter")
     m.add_argument("--format", choices=["lines", "json", "csv"],
                    default="lines",
                    help="stdout format: human per-round lines "
@@ -224,7 +227,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                        epilog=_EXIT_CODES_EPILOG)
     t.add_argument("directory", help="obs directory being written by a "
                                      "live federation")
-    t.add_argument("--job_id", type=str, default=None)
+    t.add_argument("--job_id", "--job", type=str, default=None,
+                   help="follow one tenant's records only")
     t.add_argument("--interval", type=float, default=0.5,
                    help="poll/render interval seconds (default 0.5)")
     t.add_argument("--max-seconds", type=float, default=None,
@@ -241,7 +245,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                        epilog=_EXIT_CODES_EPILOG)
     r.add_argument("inputs", nargs="+",
                    help="flight log files and/or directories")
-    r.add_argument("--job_id", type=str, default=None)
+    r.add_argument("--job_id", "--job", type=str, default=None,
+                   help="report one tenant only (default: every job "
+                        "found in the inputs)")
     r.add_argument("--format", choices=["json", "markdown"],
                    default="json")
     r.add_argument("--output", type=str, default=None,

@@ -234,3 +234,39 @@ def flight_log_paths(directory: str) -> List[str]:
                 stems.add(m.group("stem"))
     return [os.path.join(directory, f"{stem}.jsonl")
             for stem in sorted(stems)]
+
+
+def flight_scan_entries(directory: str):
+    """``[(dir, log_paths)]`` for the directories actually holding
+    ``directory``'s flight logs: the directory itself when it has logs
+    of its own, PLUS any immediate subdirectory that does — ONE level,
+    the federation scheduler's shared obs layout (``obs/job_<id>/`` per
+    tenant). The single definition of that layout rule, shared by
+    ``obs merge`` and ``obs tail`` so the two tools can never disagree
+    about which tenants a shared dir contains — computed in ONE scan
+    (the live tail re-discovers every poll interval). Both-and rather
+    than either-or: a solo run pointed at the shared root must not
+    silently hide the tenant subdirs (records are job-stamped;
+    ``--job`` filters). Empty when nothing is found yet (a live tail
+    keeps watching)."""
+    entries = []
+    try:
+        own = flight_log_paths(directory)
+        if own:
+            entries.append((directory, own))
+        subs = sorted(os.listdir(directory))
+    except OSError:
+        return entries
+    for sub in subs:
+        subdir = os.path.join(directory, sub)
+        try:
+            if os.path.isdir(subdir):
+                sub_paths = flight_log_paths(subdir)
+                if sub_paths:
+                    entries.append((subdir, sub_paths))
+        except OSError:
+            # one tenant's dir vanishing mid-scan (a finished job being
+            # cleaned up under a live tail) must not hide every OTHER
+            # tenant's logs
+            continue
+    return entries

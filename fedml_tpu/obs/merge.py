@@ -17,14 +17,21 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-from fedml_tpu.obs.flight import (flight_log_paths, read_flight_log)
+from fedml_tpu.obs.flight import flight_scan_entries, read_flight_log
 
 
 def _resolve_paths(inputs: Sequence[str]) -> List[str]:
+    """Expand directories to their flight logs. A directory's own logs
+    AND one level of subdirectories are included
+    (:func:`flight_scan_entries` — the federation scheduler's shared
+    obs layout, ``obs/job_<id>/`` per tenant), so ``obs merge
+    <shared-obs-dir> --job <id>`` inspects one tenant of a multi-job
+    run without path archaeology."""
     paths: List[str] = []
     for p in inputs:
         if os.path.isdir(p):
-            paths.extend(flight_log_paths(p))
+            for _d, log_paths in flight_scan_entries(p):
+                paths.extend(log_paths)
         else:
             paths.append(p)
     return sorted(set(paths))
@@ -40,13 +47,17 @@ def fold_records(records: Sequence[Dict[str, Any]],
         records = [r for r in records if r.get("job_id") == job_id]
     job_ids = sorted({str(r.get("job_id")) for r in records})
 
-    rounds: Dict[int, Dict[str, Any]] = {}
+    # rows are keyed per (job, round): N tenants sharing one obs dir
+    # reuse the same round numbers, and an unfiltered merge must yield
+    # N disjoint per-tenant timelines, not one blended row per number
+    rounds: Dict[tuple, Dict[str, Any]] = {}
     anomalies: List[Dict[str, Any]] = []
     unmatched: List[Dict[str, Any]] = []
 
-    def row(r: int) -> Dict[str, Any]:
-        return rounds.setdefault(int(r), {
-            "round": int(r), "server": None, "perf": None,
+    def row(rec: Dict[str, Any], r: int) -> Dict[str, Any]:
+        job = rec.get("job_id")
+        return rounds.setdefault((str(job), int(r)), {
+            "round": int(r), "job_id": job, "server": None, "perf": None,
             "silo_rounds": {}, "silo_reports": [], "anomalies": []})
 
     for rec in records:
@@ -57,31 +68,31 @@ def fold_records(records: Sequence[Dict[str, Any]],
             continue
         if kind == "round":
             if rec.get("rank") == 0:
-                prev = row(r)["server"]
+                prev = row(rec, r)["server"]
                 # a failover re-close re-records the round: keep the
                 # LAST occurrence, the same dedup rule the ledger
                 # reader applies
                 if prev is None or (rec.get("t_wall", 0)
                                     >= prev.get("t_wall", 0)):
-                    row(r)["server"] = rec
+                    row(rec, r)["server"] = rec
             else:
-                row(r)["silo_rounds"][int(rec["rank"])] = rec
+                row(rec, r)["silo_rounds"][int(rec["rank"])] = rec
         elif kind == "perf":
             # the round's derived roofline record (obs/perf.py) — same
             # keep-last rule as the server round row it derives from
-            prev = row(r)["perf"]
+            prev = row(rec, r)["perf"]
             if prev is None or (rec.get("t_wall", 0)
                                 >= prev.get("t_wall", 0)):
-                row(r)["perf"] = rec
+                row(rec, r)["perf"] = rec
         elif kind == "silo":
-            row(r)["silo_reports"].append(rec)
+            row(rec, r)["silo_reports"].append(rec)
         elif kind == "anomaly":
-            row(r)["anomalies"].append(rec)
+            row(rec, r)["anomalies"].append(rec)
             anomalies.append(rec)
         else:
             unmatched.append(rec)
 
-    timeline = [rounds[r] for r in sorted(rounds)]
+    timeline = [rounds[k] for k in sorted(rounds)]
     return {"job_ids": job_ids, "rounds": timeline,
             "anomalies": anomalies, "unmatched": unmatched}
 
@@ -112,9 +123,22 @@ def check_against_ledger(merged: Dict[str, Any],
     ledger's; a ledger round with no server flight row is a gap (the
     flight log rotated past it, or observability was off for part of
     the run) and is reported as such."""
+    ledger_rows = list(ledger_rows)
     by_round = {int(r["round"]): r for r in ledger_rows}
+    flight_rows = merged["rounds"]
+    # a ledger belongs to ONE job, but its rows carry no job_id — the
+    # caller's --job filter (merge_flight_logs(job_id=...)) is the only
+    # way to scope a multi-tenant merge to the ledger's tenant
+    if len({row.get("job_id") for row in flight_rows}) > 1:
+        # nothing identifies which tenant this ledger belongs to —
+        # comparing it against a blended timeline would yield phantom
+        # mismatches for every co-tenant round
+        return ["merged timeline spans multiple jobs ("
+                + ", ".join(merged.get("job_ids", [])) +
+                ") and the ledger rows carry no job_id — re-run with "
+                "--job <id> to scope the check to one tenant"]
     flight_by_round = {row["round"]: row["server"]
-                       for row in merged["rounds"]
+                       for row in flight_rows
                        if row.get("server") is not None}
     problems: List[str] = []
     for r in sorted(by_round):

@@ -114,6 +114,23 @@ METRICS: Dict[str, Dict[str, str]] = {
                              "admission control"),
     "cp_steered_deadline_s": _m(KIND_GAUGE, "control plane",
                                 "largest pace-steered round deadline"),
+    # -- federation scheduler (fedml_tpu/sched/) ---------------------------
+    "sched_device_time": _m(KIND_PHASE, "scheduler",
+                            "wall-clock this job held the shared device "
+                            "gate (fair-share accounting; solo runs "
+                            "without a gate emit none)"),
+    "sched_gate_wait": _m(KIND_PHASE, "scheduler",
+                          "wall-clock this job's actors queued for a "
+                          "device slot behind co-tenants (contention "
+                          "visibility per tenant)"),
+    "sched_device_acquires": _m(KIND_COUNTER, "scheduler",
+                                "device-gate grants to this job "
+                                "(deficit-round-robin turns taken)"),
+    "sched_unrouted_frames": _m(KIND_COUNTER, "scheduler",
+                                "frames arriving at a shared fabric "
+                                "endpoint for a job not running there "
+                                "(counted on the physical endpoint, "
+                                "dropped)"),
     # -- tiered client-state store (state/store.py) ------------------------
     "state_cache_hits": _m(KIND_COUNTER, "state store",
                            "shard reads served from the resident LRU"),

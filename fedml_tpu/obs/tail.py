@@ -26,15 +26,13 @@ from __future__ import annotations
 import json
 import logging
 import os
-import re
 import sys
 import time
 from typing import Any, Dict, List, Optional
 
-from fedml_tpu.obs.flight import _SEGMENT_RE
+from fedml_tpu.obs.flight import _SEGMENT_RE, flight_scan_entries
 from fedml_tpu.obs.merge import fold_records
 
-_LIVE_RE = re.compile(r"^flight_rank\d+\.jsonl$")
 
 
 def _parse_lines(path: str, lines: List[str]) -> List[Dict[str, Any]]:
@@ -195,22 +193,21 @@ class TimelineTailer:
 
     def _discover(self) -> None:
         """Create a follower for every rank stem present (live file OR
-        sealed segments — a rank whose live file just sealed must still
-        be discovered)."""
-        try:
-            names = sorted(os.listdir(self.directory))
-        except OSError:
-            return
-        for fn in names:
-            if _LIVE_RE.match(fn):
-                stem = fn[:-len(".jsonl")]
-            else:
-                m = _SEGMENT_RE.match(fn)
-                stem = m.group("stem") if m else None
-            if stem and stem not in self._followers:
-                self._followers[stem] = LogFollower(
-                    os.path.join(self.directory, f"{stem}.jsonl"))
-                self._records[stem] = []
+        sealed segments — ``flight_log_paths`` lists a rank by its live
+        name either way). The shared-obs-dir rule (the directory's own
+        logs plus ONE level of ``obs/job_<id>/`` tenant subdirs) lives
+        in :func:`flight_scan_entries` — the one definition merge and
+        tail both follow, one scan per poll — so one tail follows every
+        tenant of a multi-job run, or one with ``--job``. Stems are
+        prefixed by subdir so two tenants' rank-0 logs stay distinct."""
+        for d, log_paths in flight_scan_entries(self.directory):
+            prefix = ("" if d == self.directory
+                      else os.path.basename(d) + "/")
+            for path in log_paths:
+                key = prefix + os.path.basename(path)[:-len(".jsonl")]
+                if key not in self._followers:
+                    self._followers[key] = LogFollower(path)
+                    self._records[key] = []
 
     def poll(self) -> int:
         """Drain every follower once; returns how many new records
@@ -301,6 +298,9 @@ def round_table_rows(merged: Dict[str, Any],
                      if s.get("report_latency_s") is not None]
         rows.append({
             "round": row["round"],
+            # disambiguates tenants in an unfiltered multi-job tail
+            # (rows are per (job, round); round numbers repeat)
+            "job_id": row.get("job_id"),
             "duration_s": srv.get("duration_s"),
             "cohort": len(srv.get("cohort") or []) or None,
             "reported": (len(srv["reported"])
@@ -321,9 +321,28 @@ def round_table_rows(merged: Dict[str, Any],
     return rows
 
 
+def _window_rows(all_rows: List[Dict[str, Any]], job_ids,
+                 last: int) -> List[Dict[str, Any]]:
+    """The round rows the refreshing frame displays. Single-tenant: the
+    newest ``last`` rows. Multi-tenant: the window is split evenly and
+    each tenant contributes ITS newest rows — the timeline sorts by
+    (job, round), so a global tail would pin the whole window to the
+    lexicographically-last job while every other tenant's fresh rounds
+    insert invisibly mid-list and the tail looks frozen for them."""
+    if len(job_ids) <= 1:
+        return all_rows[-last:]
+    share = max(1, last // len(job_ids))
+    window: List[Dict[str, Any]] = []
+    for job in job_ids:  # merged job_ids are sorted
+        rows = [r for r in all_rows if r.get("job_id") == job]
+        window.extend(rows[-share:])
+    return window
+
+
 def render_table(merged: Dict[str, Any], last: int = 20) -> str:
     """The refreshing console frame: a header of derived aggregates
-    over the whole timeline plus the newest ``last`` round rows."""
+    over the whole timeline plus the newest ``last`` round rows (split
+    evenly across tenants on a shared obs dir, with a job column)."""
     all_rows = round_table_rows(merged)
     durations = [r["duration_s"] for r in all_rows
                  if r["duration_s"] is not None]
@@ -349,16 +368,21 @@ def render_table(merged: Dict[str, Any], last: int = 20) -> str:
         + ("   mfu(mean): " + f"{sum(mfus) / len(mfus):.4f}"
            if mfus else ""),
     ]
-    cols = (f"{'rnd':>5} {'dur_s':>8} {'coh':>4} {'rep':>4} {'part':>4} "
-            f"{'mfu':>7} {'ovl':>5} {'up/s':>9} {'down/s':>9} "
+    multi_job = len(merged["job_ids"]) > 1
+    job_w = (max(3, max(len(str(j)) for j in merged["job_ids"]))
+             if multi_job else 0)
+    job_col = f"{'job':>{job_w}} " if multi_job else ""
+    cols = (f"{job_col}{'rnd':>5} {'dur_s':>8} {'coh':>4} {'rep':>4} "
+            f"{'part':>4} {'mfu':>7} {'ovl':>5} {'up/s':>9} {'down/s':>9} "
             f"{'ft/cp':<22} anomalies")
     lines = head + ["-" * len(cols), cols]
-    for r in all_rows[-last:]:
+    for r in _window_rows(all_rows, merged["job_ids"], last):
         ft = ",".join(f"{k.replace('ft_', '').replace('cp_', '')}={v}"
                       for k, v in sorted(r["ft"].items())) or "-"
         anom = ",".join(a for a in r["anomalies"] if a)
         lines.append(
-            f"{r['round']:>5} "
+            (f"{str(r['job_id']):>{job_w}} " if multi_job else "")
+            + f"{r['round']:>5} "
             f"{_fmt(r['duration_s'], '.3f'):>8} "
             f"{_fmt(r['cohort']):>4} "
             f"{_fmt(r['reported']):>4} "

@@ -436,10 +436,13 @@ class DistributedFedAvgAPI:
         self.timer = RoundTimer()  # pack/dispatch means, as FedAvgAPI
         # observability (fedml_tpu/obs): per-round flight timeline +
         # slow-round anomaly profiling; config.obs_dir None = off
-        from fedml_tpu.obs import build_observability
+        from fedml_tpu.obs import build_observability, default_job_id
         self._obs = build_observability(
             getattr(self.config, "obs_dir", None),
-            job_id=getattr(self.config, "job_id", None) or "spmd",
+            # collision-safe default (see obs.default_job_id): unset
+            # job ids must not collide in a shared obs dir
+            job_id=(getattr(self.config, "job_id", None)
+                    or default_job_id("spmd")),
             rank=0, role="server", perf_device_count=self.n_dev)
         if self._obs is not None:
             self._obs.bind_timer(self.timer)
@@ -841,6 +844,15 @@ class DistributedFedAvgAPI:
 
         from fedml_tpu.algorithms.fedavg import _normalized, _progress_log
         cfg = self.config
+        if (checkpoint_mgr is not None and self._obs is not None
+                and getattr(cfg, "job_id", None) is None):
+            # re-key the derived default id onto the run's durable
+            # namespace BEFORE any record lands: a crash-resumed leg must
+            # rejoin its own flight timeline, not fork a phantom second
+            # job under a fresh nonce (obs.default_job_id stable_key)
+            from fedml_tpu.obs import default_job_id
+            self._obs.recorder.job_id = default_job_id(
+                "spmd", stable_key=checkpoint_mgr.directory)
         t0 = time.time()
         start = 0
         if checkpoint_mgr is not None and resume:

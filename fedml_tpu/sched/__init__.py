@@ -1,0 +1,39 @@
+"""Federation scheduler — multi-job tenancy over one mesh and one fabric.
+
+The paper's production shape is "one cluster, many tenants": heavy
+traffic from many concurrent federation jobs (different models,
+populations, compression policies, round counts) multiplexed over
+shared infrastructure. This package is that control layer:
+
+- ``router``     — job-tagged frame demux: one physical endpoint pair
+  per rank carries every job's traffic; each job keeps its own
+  reliable-delivery streams (``JobRouter`` / ``JobChannel`` /
+  ``SharedFabric``);
+- ``interleave`` — share-weighted deficit round-robin over the one
+  device; blocked jobs yield their slot (``RoundInterleaver`` /
+  ``JobDeviceGate``);
+- ``jobs``       — ``JobSpec`` + ``jobs.json`` parsing and the pure
+  spec -> federation fixture builder;
+- ``launcher``   — ``launch_jobs``: N concurrent federations, each with
+  its own control plane under ``<base>/job_<id>/`` and its own flight
+  logs under ``<base>/obs/job_<id>/``;
+- ``chaos``      — the tenancy failover harness: real SIGKILL of one
+  tenant's server; every other tenant must be bit-identical to its
+  solo run (``run_tenancy_failover`` / ``run_tenancy_smoke``).
+
+CLI: ``python -m fedml_tpu.sched launch --jobs jobs.json``.
+"""
+
+from fedml_tpu.sched.interleave import JobDeviceGate, RoundInterleaver
+from fedml_tpu.sched.jobs import (JobSpec, build_job_fixture, load_jobs,
+                                  spec_from_dict)
+from fedml_tpu.sched.launcher import (job_control_dir, job_obs_dir,
+                                      launch_jobs, run_one_job)
+from fedml_tpu.sched.router import (JobChannel, JobRouter, SharedFabric)
+
+__all__ = [
+    "JobChannel", "JobRouter", "SharedFabric",
+    "RoundInterleaver", "JobDeviceGate",
+    "JobSpec", "load_jobs", "spec_from_dict", "build_job_fixture",
+    "launch_jobs", "run_one_job", "job_control_dir", "job_obs_dir",
+]

@@ -1,0 +1,381 @@
+"""Tenancy failover harness — the scheduler's chaos acceptance oracle.
+
+The claim multi-job tenancy must defend: one tenant's coordinator dying
+is THAT tenant's problem. The harness proves it the hard way:
+
+1. **solo legs** — every surviving job runs single-tenant through the
+   scheduler (same code path, trivial interleaver) and its
+   ``ledger.jsonl`` + final model are recorded as the reference;
+2. **shared leg** — all jobs run concurrently: the survivors in-process
+   over ONE shared fabric (``sched/router.py``), the victim's silos in
+   the same process contending for the SAME device through the shared
+   interleaver, and the victim's *server* as a real TCP subprocess
+   (``python -m fedml_tpu.sched serve`` — coordinators deploy as their
+   own processes; that is exactly what makes a real SIGKILL possible);
+3. **the kill** — once the victim's ledger closes ``kill_after_round``,
+   its server process takes SIGKILL, is respawned with the same flags,
+   restores from its own ``job_<id>/`` control snapshot and completes;
+4. **the verdict** — every survivor's ledger rows and final model must
+   be BIT-IDENTICAL to its solo leg (tenancy + a co-tenant's death
+   changed nothing), and the victim must finish its full schedule with
+   ``cp_restores >= 1``.
+
+``run_tenancy_smoke`` is the two-job cpu-smoke fronting
+``ci/run_fast.sh`` (exit non-zero unless the verdict holds, including a
+per-job ``obs report`` rendered from the one shared obs dir).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from fedml_tpu.sched.interleave import RoundInterleaver
+from fedml_tpu.sched.jobs import JobSpec, build_job_fixture
+from fedml_tpu.sched.launcher import (job_control_dir, job_obs_dir,
+                                      launch_jobs)
+from fedml_tpu.sched.router import SharedFabric
+
+#: the default three-tenant fixture: different populations, shapes,
+#: round counts and shares — tenants must be allowed to be unalike
+DEFAULT_SPECS = (
+    JobSpec(id="joba", workers=2, rounds=6, seed=5, share=1.0,
+            dim=8, class_num=3, n_samples=120, batch_size=8, lr=0.2),
+    JobSpec(id="jobb", workers=3, rounds=8, seed=7, share=1.0,
+            dim=6, class_num=2, n_samples=150, batch_size=10, lr=0.1,
+            round_deadline_s=2.0, heartbeat_s=0.3),
+    JobSpec(id="jobc", workers=2, rounds=6, seed=9, share=2.0,
+            dim=10, class_num=4, n_samples=160, batch_size=8, lr=0.15),
+)
+
+
+def model_blob(model) -> bytes:
+    """Canonical bytes of a model pytree (numpy'd state dict through the
+    msgpack codec) — THE bit-identity oracle for final-model parity."""
+    import jax
+    import numpy as np
+    from flax import serialization as fser
+    return fser.msgpack_serialize(
+        fser.to_state_dict(jax.tree.map(np.asarray, model)))
+
+
+def solo_parity(ref: Dict, ten: Dict):
+    """The tenancy acceptance oracle: ``(error, ledger_ok, model_ok)``
+    for one job's solo-run result vs its shared-fabric result. ONE
+    definition — the chaos harness and the bench `multi_tenancy` stage
+    must enforce the SAME bit-exact isolation contract."""
+    err = ref.get("error") or ten.get("error")
+    ledger_ok = not err and ref.get("ledger") == ten.get("ledger")
+    model_ok = (not err
+                and model_blob(ref["model"]) == model_blob(ten["model"]))
+    return err, bool(ledger_ok), bool(model_ok)
+
+
+def _write_spec(spec: JobSpec, path: str) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(spec.to_json(), f, indent=2)
+    os.replace(tmp, path)
+
+
+def _spawn_victim_server(spec_path: str, ckpt_dir: str, port_base: int,
+                         log_path: str,
+                         obs_dir: Optional[str]) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "fedml_tpu.sched", "serve",
+           "--spec", spec_path, "--ckpt_dir", ckpt_dir,
+           "--port_base", str(port_base)]
+    if obs_dir:
+        cmd.extend(["--obs_dir", obs_dir])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    logf = open(log_path, "ab")
+    try:
+        return subprocess.Popen(cmd, stdout=logf, stderr=logf, env=env)
+    finally:
+        logf.close()  # the child holds its own fd
+
+
+def serve_spec(spec_path: str, ckpt_dir: str, port_base: int, *,
+               join_timeout_s: float = 600.0,
+               obs_dir: Optional[str] = None) -> int:
+    """Subprocess entry: ONE server incarnation for one tenant job over
+    TCP, run until its schedule completes or this process is killed
+    (the point of the exercise). Control plane + ledger live under
+    ``ckpt_dir`` — the job's own ``job_<id>/`` namespace."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.algorithms.fedavg_cross_silo import (FedAvgAggregator,
+                                                        FedAvgServerManager)
+    from fedml_tpu.control import build_control_plane
+    from fedml_tpu.control.failover_harness import (_make_com,
+                                                    make_addresses)
+    from fedml_tpu.sched.jobs import spec_from_dict
+    from fedml_tpu.utils.tracing import RoundTimer
+    with open(spec_path) as f:
+        spec = spec_from_dict(json.load(f))
+    ds, module, _task, _tcfg = build_job_fixture(spec)
+    size = spec.workers + 1
+    com = _make_com("TCP", 0, size,
+                    addresses=make_addresses(port_base, size))
+    global_model = module.init(jax.random.key(spec.seed),
+                               jnp.asarray(ds.train_data_global[0][:1]),
+                               train=False)
+    # the spec pins EVERYTHING that shapes the trajectory — no silent
+    # substitutes here: a strict-barrier victim (round_deadline_s=None)
+    # must run strict-barrier semantics in the subprocess too
+    control = build_control_plane(
+        server_checkpoint_dir=ckpt_dir, pace_steering=spec.pace_steering,
+        join_rate_limit=spec.join_rate_limit,
+        round_deadline_s=spec.round_deadline_s,
+        min_quorum_frac=spec.min_quorum_frac,
+        max_deadline_extensions=spec.max_deadline_extensions)
+    server = FedAvgServerManager(
+        0, size, com, FedAvgAggregator(spec.workers), spec.rounds,
+        ds.client_num, global_model, compression=spec.compression,
+        round_deadline_s=spec.round_deadline_s,
+        min_quorum_frac=spec.min_quorum_frac,
+        **control)
+    server.round_timer = RoundTimer()
+    if obs_dir:
+        from fedml_tpu.obs import build_observability, endpoint_epoch
+        obs = build_observability(obs_dir, job_id=spec.id, rank=0,
+                                  role="server")
+        obs.recorder.set_epoch(endpoint_epoch(com))
+        obs.bind_timer(server.round_timer)
+        server.obs = obs
+    thread = threading.Thread(target=server.run, daemon=True)
+    thread.start()
+    server.send_init_msg()
+    thread.join(timeout=join_timeout_s)
+    done = server.round_idx >= spec.rounds and not thread.is_alive()
+    summary = {
+        "job_id": spec.id,
+        "rounds_completed": int(server.round_idx),
+        "schedule_rounds": int(spec.rounds),
+        "done": bool(done),
+        "cp_counters": {k: int(v) for k, v in server.cp_counters.items()},
+        "ft_counters": {k: int(v) for k, v in server.ft_counters.items()},
+        "error": (str(server.scheduling_error)
+                  if server.scheduling_error else None),
+    }
+    tmp = os.path.join(ckpt_dir, f"summary.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(summary, f)
+    os.replace(tmp, os.path.join(ckpt_dir, "server_summary.json"))
+    com.stop_receive_message()
+    return 0 if done else 1
+
+
+def _run_victim_job(spec: JobSpec, base_dir: str, inter: RoundInterleaver,
+                    *, port_base: int, kill_after_round: int,
+                    timeout_s: float, obs: bool, out: Dict) -> None:
+    """The victim tenant in the shared leg: silos in THIS process (same
+    device, same interleaver as every co-tenant), server as a TCP
+    subprocess that gets SIGKILLed after ``kill_after_round`` closes and
+    respawned (auto-restore from its own job_<id>/ snapshot)."""
+    from fedml_tpu.algorithms.fedavg_cross_silo import FedAvgClientManager
+    from fedml_tpu.control import ServerControlCheckpointer
+    from fedml_tpu.control.failover_harness import (_make_com,
+                                                    _wait_for_round,
+                                                    make_addresses)
+    ctrl = job_control_dir(base_dir, spec.id)
+    os.makedirs(ctrl, exist_ok=True)
+    ds, module, task, tcfg = build_job_fixture(spec)
+    size = spec.workers + 1
+    addresses = make_addresses(port_base, size)
+    inter.register(spec.id, spec.share)
+    if not spec.heartbeat_s:
+        # honored anyway (the spec is the trajectory contract), but the
+        # respawned server learns of live silos through their heartbeats
+        # — a heartbeat-less victim may hang after the SIGKILL
+        logging.warning("victim job %s has heartbeat_s=%r: silos cannot "
+                        "announce themselves to the respawned server; "
+                        "recovery may stall", spec.id, spec.heartbeat_s)
+    clients, threads = [], []
+    for rank in range(1, size):
+        com = _make_com("TCP", rank, size, addresses=addresses)
+        clients.append(FedAvgClientManager(
+            rank, size, com, ds, module, task, tcfg, seed=spec.seed,
+            compression=spec.compression,
+            heartbeat_s=spec.heartbeat_s,
+            device_gate=inter.gate(spec.id)))
+    for c in clients:
+        t = threading.Thread(target=c.run, daemon=True)
+        t.start()
+        threads.append(t)
+    spec_path = os.path.join(ctrl, "spec.json")
+    _write_spec(spec, spec_path)
+    log_path = os.path.join(ctrl, "server.log")
+    obs_dir = job_obs_dir(base_dir, spec.id) if obs else None
+    proc = _spawn_victim_server(spec_path, ctrl, port_base, log_path,
+                                obs_dir)
+    killed_at = None
+    rc = None
+    try:
+        _wait_for_round(ctrl, kill_after_round, proc, timeout_s / 2)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        killed_at = kill_after_round
+        proc = _spawn_victim_server(spec_path, ctrl, port_base, log_path,
+                                    obs_dir)
+        rc = proc.wait(timeout=timeout_s)
+    except Exception as exc:  # noqa: BLE001 — the verdict reports it
+        out[spec.id] = {"job_id": spec.id, "error": repr(exc),
+                        "server_log": log_path}
+        return
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        for t in threads:
+            t.join(timeout=60)
+    summary = {}
+    summary_path = os.path.join(ctrl, "server_summary.json")
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            summary = json.load(f)
+    out[spec.id] = {
+        "job_id": spec.id,
+        "summary": summary,
+        "ledger": ServerControlCheckpointer(ctrl).read_ledger(),
+        "killed_at_round": killed_at,
+        "restart_rc": rc,
+        "server_log": log_path,
+    }
+
+
+def run_tenancy_failover(root: str, *,
+                         specs: Optional[Sequence[JobSpec]] = None,
+                         victim: Optional[str] = None,
+                         kill_after_round: int = 2,
+                         port_base: int = 40510,
+                         timeout_s: float = 300.0,
+                         join_timeout_s: float = 240.0,
+                         obs: bool = True) -> Dict:
+    """The full scenario: solo reference legs, then the shared leg with
+    a real SIGKILL of one tenant's server. Returns the verdict dict
+    (``ok`` plus per-job parity/recovery evidence)."""
+    specs = list(specs if specs is not None else DEFAULT_SPECS)
+    victim = victim or specs[1].id
+    by_id = {s.id: s for s in specs}
+    if victim not in by_id:
+        raise ValueError(f"victim {victim!r} not among job ids "
+                         f"{sorted(by_id)}")
+    survivors = [s for s in specs if s.id != victim]
+    os.makedirs(root, exist_ok=True)
+
+    # -- solo reference legs (single-tenant through the SAME scheduler) --
+    solo: Dict[str, Dict] = {}
+    for spec in survivors:
+        res = launch_jobs([spec], os.path.join(root, "solo", spec.id),
+                          obs=False, join_timeout_s=join_timeout_s)
+        solo[spec.id] = res["jobs"][spec.id]
+
+    # -- shared leg: survivors in-process + victim server subprocess ----
+    shared_dir = os.path.join(root, "shared")
+    inter = RoundInterleaver()
+    victim_out: Dict[str, Dict] = {}
+    vt = threading.Thread(
+        target=_run_victim_job,
+        args=(by_id[victim], shared_dir, inter),
+        kwargs=dict(port_base=port_base, kill_after_round=kill_after_round,
+                    timeout_s=timeout_s, obs=obs, out=victim_out),
+        daemon=True, name=f"sched-victim-{victim}")
+    vt.start()
+    shared = launch_jobs(survivors, shared_dir, interleaver=inter,
+                         obs=obs, join_timeout_s=join_timeout_s)
+    # the victim leg's own internal budgets sum to ~1.5*timeout_s + 120
+    # (timeout_s/2 waiting for the kill round, 30 s post-SIGKILL reap,
+    # timeout_s for the respawned server, 30 s + 60 s teardown joins) —
+    # the outer join must cover them, or a slow-but-legal victim gets a
+    # spurious "still running after budget" verdict
+    vt.join(timeout=1.5 * timeout_s + 180)
+    if vt.is_alive():
+        victim_out.setdefault(victim, {"job_id": victim,
+                                       "error": "victim leg still "
+                                                "running after budget"})
+
+    # -- the verdict -----------------------------------------------------
+    jobs_report: Dict[str, Dict] = {}
+    ok = True
+    for spec in survivors:
+        ref = solo[spec.id]
+        ten = shared["jobs"].get(spec.id, {})
+        err, ledger_ok, model_ok = solo_parity(ref, ten)
+        jobs_report[spec.id] = {
+            "role": "survivor",
+            "error": err,
+            "ledger_rounds": len(ten.get("ledger") or []),
+            "ledger_identical_to_solo": bool(ledger_ok),
+            "model_identical_to_solo": bool(model_ok),
+        }
+        ok = ok and ledger_ok and model_ok
+    vres = victim_out.get(victim, {})
+    vsum = vres.get("summary", {})
+    recovered = (vres.get("error") is None
+                 and vsum.get("done") is True
+                 and vsum.get("cp_counters", {}).get("restores", 0) >= 1)
+    jobs_report[victim] = {
+        "role": "victim",
+        "error": vres.get("error"),
+        "killed_at_round": vres.get("killed_at_round"),
+        "rounds_completed": vsum.get("rounds_completed"),
+        "cp_restores": vsum.get("cp_counters", {}).get("restores", 0),
+        "recovered_full_schedule": bool(recovered),
+        "server_log": vres.get("server_log"),
+    }
+    ok = ok and recovered
+    return {
+        "ok": bool(ok),
+        "victim": victim,
+        "jobs": jobs_report,
+        "device_time_s": inter.usage(),
+        "fairness_ratio": inter.fairness_ratio(),
+        "obs_dir": os.path.join(shared_dir, "obs") if obs else None,
+    }
+
+
+def run_tenancy_smoke(root: str, *, port_base: int = 40570,
+                      timeout_s: float = 300.0) -> int:
+    """The ci/run_fast.sh front: two jobs over one fabric, the victim's
+    server SIGKILLed mid-schedule. Exit 0 only when the survivor's
+    ledger AND model are bit-identical to its solo leg, the victim
+    recovered via its own checkpoint, AND ``obs report`` renders one
+    summary per tenant from the shared obs dir."""
+    specs = [
+        JobSpec(id="joba", workers=2, rounds=6, seed=5, share=1.0,
+                batch_size=8, lr=0.2),
+        JobSpec(id="jobb", workers=3, rounds=8, seed=7, share=1.0,
+                dim=6, class_num=2, n_samples=150, batch_size=10,
+                lr=0.1, round_deadline_s=2.0, heartbeat_s=0.3),
+    ]
+    t0 = time.time()
+    res = run_tenancy_failover(root, specs=specs, victim="jobb",
+                               port_base=port_base, timeout_s=timeout_s)
+    # per-tenant SLO report from the ONE shared obs dir — part of the
+    # smoke's contract, not an optional extra
+    report_jobs: List[str] = []
+    report_ok = False
+    if res["obs_dir"] and os.path.isdir(res["obs_dir"]):
+        from fedml_tpu.obs.report import summarize
+        report = summarize([res["obs_dir"]])
+        report_jobs = sorted(report["jobs"])
+        report_ok = set(report_jobs) >= {s.id for s in specs}
+    ok = bool(res["ok"] and report_ok)
+    print(json.dumps({
+        "tenancy_smoke": "ok" if ok else "FAILED",
+        "elapsed_s": round(time.time() - t0, 1),
+        "jobs": res["jobs"],
+        "fairness_ratio": res["fairness_ratio"],
+        "obs_report_jobs": report_jobs,
+    }, indent=2))
+    if not ok:
+        logging.error("tenancy smoke failed: %s",
+                      json.dumps(res["jobs"], indent=2))
+    return 0 if ok else 1

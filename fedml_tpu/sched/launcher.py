@@ -1,0 +1,166 @@
+"""The federation scheduler — N tenant jobs over one fabric, one device.
+
+``launch_jobs`` is the in-process multi-tenant launcher: every job is a
+full cross-silo federation (its own server manager, silo actors,
+control plane, compression policy, round schedule) running concurrently
+with the others over
+
+- ONE comm fabric — per-rank physical endpoints shared by every job
+  through the job-tagged demux (``sched/router.py``);
+- ONE device — silo local_train / server aggregate dispatches ordered
+  by share-weighted deficit round-robin (``sched/interleave.py``);
+- PER-JOB control isolation — each job's ``ServerControlCheckpointer``
+  (+ ledger), ``PaceSteerer``, and ``JoinAdmissionController`` live
+  under ``<base_dir>/job_<id>/``, built by the same
+  ``build_control_plane`` path a solo launch uses, with steering fed by
+  that job's own report-latency distribution;
+- PER-JOB observability — flight logs under ``<base_dir>/obs/job_<id>/``
+  stamped with the job id, so ``obs report <base_dir>/obs`` yields one
+  SLO/billing summary per tenant from the one shared obs dir.
+
+Isolation contract (the chaos harness's acceptance oracle): each job's
+``ledger.jsonl`` and final model are bit-identical to its solo
+single-tenant run — tenancy changes WHEN things run, never WHAT they
+compute.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from fedml_tpu.sched.interleave import RoundInterleaver
+from fedml_tpu.sched.jobs import JobSpec, build_job_fixture
+from fedml_tpu.sched.router import SharedFabric
+
+
+def job_control_dir(base_dir: str, job_id: str) -> str:
+    """``<base_dir>/job_<id>/`` — the job's control-plane namespace
+    (server snapshots + ledger.jsonl + silo residual state)."""
+    return os.path.join(base_dir, f"job_{job_id}")
+
+
+def job_obs_dir(base_dir: str, job_id: str) -> str:
+    """``<base_dir>/obs/job_<id>/`` — the job's flight logs inside the
+    SHARED obs dir (one subdir per tenant: per-process log files never
+    interleave across jobs, while ``obs merge/report <base_dir>/obs``
+    still sees every tenant)."""
+    return os.path.join(base_dir, "obs", f"job_{job_id}")
+
+
+def run_one_job(spec: JobSpec, base_dir: str, *, comm_factory=None,
+                device_gate=None, timer=None, obs: bool = True,
+                join_timeout_s: float = 600.0,
+                backend: str = "INPROC") -> Dict:
+    """Run ONE job's full federation (blocking). ``comm_factory`` /
+    ``device_gate`` come from the scheduler's shared fabric and
+    interleaver; both ``None`` runs the job exactly as a solo
+    ``run_fedavg_cross_silo`` launch would."""
+    from fedml_tpu.algorithms.fedavg_cross_silo import run_fedavg_cross_silo
+    from fedml_tpu.control import ServerControlCheckpointer
+    from fedml_tpu.utils.tracing import RoundTimer
+    ds, module, task, tcfg = build_job_fixture(spec)
+    ctrl_dir = job_control_dir(base_dir, spec.id)
+    timer = timer if timer is not None else RoundTimer()
+    model, history = run_fedavg_cross_silo(
+        ds, module, task=task, worker_num=spec.workers,
+        comm_round=spec.rounds, train_cfg=tcfg, seed=spec.seed,
+        backend=backend,
+        compression=spec.compression,
+        checkpoint_dir=ctrl_dir,
+        server_checkpoint_dir=ctrl_dir,
+        round_deadline_s=spec.round_deadline_s,
+        min_quorum_frac=spec.min_quorum_frac,
+        heartbeat_s=spec.heartbeat_s,
+        pace_steering=spec.pace_steering,
+        join_rate_limit=spec.join_rate_limit,
+        max_deadline_extensions=spec.max_deadline_extensions,
+        join_timeout_s=join_timeout_s,
+        timer=timer,
+        obs_dir=(job_obs_dir(base_dir, spec.id) if obs else None),
+        job_id=spec.id,
+        comm_factory=comm_factory,
+        device_gate=device_gate)
+    ledger = ServerControlCheckpointer(ctrl_dir).read_ledger()
+    return {"job_id": spec.id, "history": history, "model": model,
+            "ledger": ledger, "rounds": spec.rounds,
+            "counters": {k: int(v) for k, v in timer.counters.items()},
+            "phases": {k: float(v) for k, v in timer.totals.items()},
+            "control_dir": ctrl_dir}
+
+
+def launch_jobs(specs: Sequence[JobSpec], base_dir: str, *,
+                backend: str = "INPROC",
+                interleave: bool = True, obs: bool = True,
+                join_timeout_s: float = 600.0,
+                interleaver: Optional[RoundInterleaver] = None,
+                fabric: Optional[SharedFabric] = None) -> Dict:
+    """Run every job concurrently over one shared fabric + one device.
+
+    Returns ``{"jobs": {job_id: result}, "device_time_s": {...},
+    "fairness_ratio": ...}``; a job that failed carries an ``error``
+    entry instead of killing its co-tenants (blast-radius isolation is
+    the whole point). ``interleaver``/``fabric`` may be supplied by a
+    caller that co-schedules additional out-of-process tenants (the
+    chaos harness's SIGKILLed server job).
+    """
+    specs = list(specs)
+    ids = [s.id for s in specs]
+    if len(set(ids)) != len(ids):
+        raise ValueError(f"duplicate job ids in launch: {sorted(ids)}")
+    os.makedirs(base_dir, exist_ok=True)
+    inter = interleaver if interleaver is not None else RoundInterleaver()
+    for spec in specs:
+        inter.register(spec.id, spec.share)
+    own_fabric = fabric is None
+    if fabric is None:
+        size = max(s.workers for s in specs) + 1
+        fabric = SharedFabric(backend, size)
+    results: Dict[str, Dict] = {}
+    from fedml_tpu.utils.tracing import RoundTimer
+
+    def run_job(spec: JobSpec) -> None:
+        timer = RoundTimer()
+        gate = (inter.gate(spec.id, timer=timer) if interleave else None)
+        try:
+            results[spec.id] = run_one_job(
+                spec, base_dir, comm_factory=fabric.comm_factory(spec.id),
+                device_gate=gate, timer=timer, obs=obs,
+                join_timeout_s=join_timeout_s, backend=backend)
+        except Exception as exc:  # noqa: BLE001 — isolate tenant failures
+            logging.error("job %s failed: %r", spec.id, exc, exc_info=True)
+            results[spec.id] = {"job_id": spec.id, "error": repr(exc)}
+
+    threads = [threading.Thread(target=run_job, args=(s,), daemon=True,
+                                name=f"sched-job-{s.id}") for s in specs]
+    for t in threads:
+        t.start()
+    # one shared deadline, not a fresh budget per join: a single stuck
+    # tenant must not delay the hang report by N x budget
+    deadline = time.monotonic() + join_timeout_s + 120.0
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    hung = [s.id for s, t in zip(specs, threads) if t.is_alive()]
+    for job in hung:
+        # is_alive() can race a thread that already stored its result
+        # (mid-return straggler): a row that exists speaks for itself —
+        # never stamp an error onto a completed job
+        if job not in results:
+            results[job] = {"job_id": job,
+                            "error": "job thread still running after "
+                                     "the join budget"}
+    if own_fabric:
+        fabric.stop()
+    # snapshot: an abandoned (post-budget) job thread rebinds its slot in
+    # `results` when it finally finishes — that must not retroactively
+    # replace the error row the caller is already reading
+    return {"jobs": dict(results),
+            "device_time_s": inter.usage(),
+            "steady_device_time_s": inter.steady_usage(),
+            # steady = past each tenant's compile prologue (the
+            # headline figure); raw includes the one-off JIT charges
+            "fairness_ratio": inter.fairness_ratio(),
+            "fairness_ratio_raw": inter.fairness_ratio(steady=False)}

@@ -1,0 +1,36 @@
+"""FT018 negative corpus: the compliant shapes — per-instance state,
+immutable module constants, globals unreachable from actor classes, and
+a pragma'd sanctioned singleton."""
+
+import threading
+
+
+class ServerManager:  # stand-in base
+    pass
+
+
+# immutable module constants are fine (not mutable containers)
+MSG_TYPE_SYNC = 2
+_DEADLINES = (1.0, 2.0, 4.0)
+
+# mutable, but reachable from NO server/silo class — helper-module state
+_MODULE_ONLY_REGISTRY = {}
+
+
+def register(name, fn):
+    _MODULE_ONLY_REGISTRY[name] = fn
+
+
+# sanctioned singleton: the pragma carries the reviewer-facing rationale
+# ft: allow[FT018] one physical device dispatch queue exists regardless of tenant count
+_DEVICE_MUTEX = threading.RLock()
+
+
+class TenantAwareServerManager(ServerManager):
+    def __init__(self):
+        # per-INSTANCE state: each job's server carries its own
+        self.mirrors = {}
+
+    def handle_reply(self, msg):
+        with _DEVICE_MUTEX:
+            return self.mirrors.get(msg)

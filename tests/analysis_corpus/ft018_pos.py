@@ -1,0 +1,43 @@
+"""FT018 positive corpus: module-global mutable state reachable from a
+job's server/silo classes — the tenancy-isolation hazard, in both
+detection shapes (direct class reference and the one-hop module-helper
+pattern)."""
+
+import collections
+import threading
+
+
+class ServerManager:  # stand-in base (the rule matches by base NAME)
+    pass
+
+
+class ClientManager:
+    pass
+
+
+# direct hit: a dict literal the server class reads/writes
+_ROUND_MIRRORS = {}
+
+# direct hit: a lock the silo class serializes on
+_UPLINK_LOCK = threading.Lock()
+
+# one-hop hit: a cache only touched through a module helper the silo
+# class calls
+_PACK_CACHE = collections.defaultdict(list)
+
+
+def _cached_pack(key):
+    _PACK_CACHE[key].append(key)
+    return _PACK_CACHE[key]
+
+
+class MirrorfulServerManager(ServerManager):
+    def handle_reply(self, msg):
+        _ROUND_MIRRORS[msg] = msg
+        return _ROUND_MIRRORS
+
+
+class PackingClientManager(ClientManager):
+    def handle_broadcast(self, msg):
+        with _UPLINK_LOCK:
+            return _cached_pack(msg)

@@ -756,6 +756,34 @@ class TestTailConsole:
         assert [r["round"] for r in got["rounds"]] == list(range(n_rounds))
         tailer.close()
 
+    def test_multi_tenant_window_shows_every_jobs_newest_rounds(
+            self, tmp_path):
+        """An unfiltered tail of a shared obs dir must show EVERY
+        tenant's newest rounds: the timeline sorts by (job, round), so
+        a naive global tail pins the window to the lexicographically
+        last job and the others look frozen."""
+        from fedml_tpu.obs.tail import render_table
+        jobs = ["aa", "bb", "cc"]
+        for j in jobs:
+            rec = FlightRecorder(str(tmp_path / f"job_{j}"), job_id=j,
+                                 rank=0, epoch=1)
+            for r in range(30):
+                rec.append({"kind": "round", "round": r,
+                            "duration_s": 0.01, "phases": {},
+                            "counters": {}, "gauges": {},
+                            "cohort": [0], "reported": [0],
+                            "partial": False})
+            rec.close()
+        merged = merge_flight_logs([str(tmp_path)])
+        assert merged["job_ids"] == jobs
+        frame = render_table(merged, last=6)
+        lines = frame.splitlines()
+        assert any(line.lstrip().startswith("job ") for line in lines)
+        for j in jobs:  # each tenant's NEWEST rounds are in the window
+            assert any(line.lstrip().startswith(f"{j} ")
+                       and " 29 " in f" {line} " for line in lines), \
+                (j, frame)
+
     def test_tailer_retention_cap_bounds_memory(self, tmp_path):
         from fedml_tpu.obs.tail import TimelineTailer
         rec = FlightRecorder(str(tmp_path), rank=0)
