@@ -33,6 +33,12 @@ JAX_PLATFORMS=cpu python -m fedml_tpu.obs merge runs/obs_smoke/flight \
 # `obs report` renders one per-tenant summary from the shared obs dir
 rm -rf runs/sched_smoke
 JAX_PLATFORMS=cpu python -m fedml_tpu.sched smoke --root runs/sched_smoke
+# federated-serving smoke (fedml_tpu/serve, ~10 s): train a small
+# federation WITH the TCP/JSON inference endpoint attached, drive 50
+# closed-loop requests, and exit non-zero unless at least one hot swap
+# landed, ZERO requests were shed, and the SLO report carries measured
+# latency quantiles + the served round
+JAX_PLATFORMS=cpu python -m fedml_tpu.serve --smoke
 # slowest-20 artifact (tests/conftest.py sessionfinish hook): fast-lane
 # time creep becomes a diffable runs/ number instead of a README
 # anecdote — AND a trend-ledger row, so creep regresses like a bench

@@ -288,6 +288,14 @@ class FedAvgServerManager(ServerManager):
         #: observability bundle (fedml_tpu/obs) — bound by the launcher
         #: alongside round_timer; None = flight recorder off (default)
         self.obs = None
+        #: serving publish hook (fedml_tpu/serve) — bound by the
+        #: launcher when a serving tier is attached. Called with every
+        #: broadcast's payload (full tree or compression-mirror delta —
+        #: the rollout decodes deltas with the silos' own chain rule)
+        #: and once more with the final model at FINISH. None (default)
+        #: = no serving, byte-identical legacy behavior; the hook is a
+        #: pure observer and must never raise into the round loop.
+        self.publish_model = None
         #: cumulative transport bytes already credited into the round
         #: timer (pure-observer accounting, NOT schedule state: a
         #: restored server starts a fresh endpoint whose counters reset,
@@ -579,6 +587,16 @@ class FedAvgServerManager(ServerManager):
         """FINISH every silo (evicted ones included — a dead peer's send
         failure is logged, not fatal: the federation is done either way)
         and stop the server loop."""
+        if self.publish_model is not None:
+            # the LAST aggregate is never broadcast (the schedule ends) —
+            # publish it full so the endpoint serves the final model
+            try:
+                with self._device_lock:
+                    final = _to_numpy(self.global_model)
+                self.publish_model(self.round_idx, final)
+            except Exception:
+                logging.warning("final serving publish failed",
+                                exc_info=True)
         for worker in range(1, self.size):
             try:
                 self.send_message(
@@ -659,6 +677,17 @@ class FedAvgServerManager(ServerManager):
         no base for), and a send that exhausts its transport retries
         evicts the peer instead of killing the server loop."""
         payload = self._encode_broadcast()
+        if self.publish_model is not None:
+            # serving rollout feed: the broadcast payload doubles as the
+            # checkpoint delta (full on INIT/fallback, mirror delta in
+            # steady state) — published BEFORE the sends so the endpoint
+            # swaps round r in while round r trains
+            try:
+                self.publish_model(self.round_idx, payload)
+            except Exception:
+                logging.warning("serving publish for round %d failed — "
+                                "training continues unaffected",
+                                self.round_idx, exc_info=True)
         live = self.liveness.live_workers()
         # ledger payload + the latency origin every reply is measured from
         self._round_cohort = [int(idxs[w - 1]) for w in range(1, self.size)]
@@ -1543,7 +1572,10 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           obs_dir: Optional[str] = None,
                           job_id: Optional[str] = None,
                           comm_factory=None,
-                          device_gate=None):
+                          device_gate=None,
+                          serve_port: Optional[int] = None,
+                          serve_staleness_rounds: int = 2,
+                          serving=None):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -1580,6 +1612,14 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     digest rows in ``flight_rank<r>.jsonl`` next to the control-plane
     ledger, anomaly-armed one-shot profiling under ``obs_dir/profiles``.
     Pure observer: trajectories are bit-exact vs ``obs_dir=None``.
+
+    Serving (fedml_tpu/serve): ``serve_port`` attaches a serving tier —
+    each broadcast's model hot-swaps into a jitted, batch-coalescing
+    TCP/JSON inference endpoint on that port (0 = ephemeral) that
+    serves round r while r+1 trains, staleness-bounded by
+    ``serve_staleness_rounds``; ``serving`` hands in a prebuilt
+    ``ServingTier`` instead (the caller owns its lifecycle). Also a
+    pure observer — trajectories are bit-exact with serving on or off.
 
     The reference's equivalent is `mpirun -np worker_num+1 main_fedavg.py`
     (FedAvgAPI.py:20-67 rank dispatch); here ranks are threads over the
@@ -1636,7 +1676,9 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         timer=timer, prefetch_depth=prefetch_depth,
         heartbeat_s=heartbeat_s, fault_plan=fault_plan,
         obs_dir=obs_dir, job_id=job_id,
-        comm_factory=comm_factory, device_gate=device_gate)
+        comm_factory=comm_factory, device_gate=device_gate,
+        serve_port=serve_port,
+        serve_staleness_rounds=serve_staleness_rounds, serving=serving)
     return model, history
 
 
@@ -1658,7 +1700,10 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       obs_dir: Optional[str] = None,
                       job_id: Optional[str] = None,
                       comm_factory=None,
-                      device_gate=None):
+                      device_gate=None,
+                      serve_port: Optional[int] = None,
+                      serve_staleness_rounds: int = 2,
+                      serving=None):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
@@ -1769,31 +1814,63 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
         obs_server.recorder.set_epoch(endpoint_epoch(server_com))
         obs_server.bind_timer(server.round_timer)
         server.obs = obs_server
+    # serving tier (fedml_tpu/serve): a prebuilt tier (caller-owned) or
+    # one constructed here from serve_port (0 = ephemeral). Either way
+    # the server's broadcast/finish publishes feed the rollout, the tier
+    # shares THIS launch's device gate (fair-share co-tenant under the
+    # scheduler) and lands its metrics on the same round timer + flight
+    # log as everything else.
+    tier, own_tier = serving, False
+    if tier is None and serve_port is not None:
+        from fedml_tpu.serve import build_serving
+        tier = build_serving(
+            module, task, sample_x,
+            staleness_rounds=serve_staleness_rounds,
+            checkpointer=getattr(server, "_server_ckpt", None),
+            device_gate=gate, timer=server.round_timer, obs=obs_server,
+            port=serve_port)
+        own_tier = True
+    if tier is not None:
+        server.publish_model = tier.publish_hook
     clients = []
     client_coms = []
-    for rank in range(1, size):
-        if comm_factory is not None:
-            com = comm_factory(rank)
-        else:
-            com = create_comm_manager(backend, rank, size, router=router,
-                                      addresses=addresses,
-                                      wire_codec=wire_codec,
-                                      token=token, fault_plan=plan)
-        # ft: allow[FT008] one endpoint per SILO at launch — bounded by worker_num (tens), not the client population
-        client_coms.append(com)
-        silo_obs = build_observability(obs_dir, job_id=job, rank=rank,
-                                       role="silo")
-        if silo_obs is not None:
-            silo_obs.recorder.set_epoch(endpoint_epoch(com))
-        # ft: allow[FT008] one manager per SILO at launch — silo count is the federation's process count, not its population
-        clients.append(FedAvgClientManager(
-            rank, size, com, dataset, module, task, train_cfg, seed=seed,
-            compression=policy,
-            state_dir=(os.path.join(client_state_dir, f"silo_{rank}")
-                       if client_state_dir else None),
-            resume=resume, prefetch_depth=prefetch_depth,
-            heartbeat_s=heartbeat_s, obs=silo_obs,
-            device_gate=device_gate))
+    try:
+        for rank in range(1, size):
+            if comm_factory is not None:
+                com = comm_factory(rank)
+            else:
+                com = create_comm_manager(backend, rank, size,
+                                          router=router,
+                                          addresses=addresses,
+                                          wire_codec=wire_codec,
+                                          token=token, fault_plan=plan)
+            # ft: allow[FT008] one endpoint per SILO at launch — bounded by worker_num (tens), not the client population
+            client_coms.append(com)
+            silo_obs = build_observability(obs_dir, job_id=job, rank=rank,
+                                           role="silo")
+            if silo_obs is not None:
+                silo_obs.recorder.set_epoch(endpoint_epoch(com))
+            # ft: allow[FT008] one manager per SILO at launch — silo count is the federation's process count, not its population
+            clients.append(FedAvgClientManager(
+                rank, size, com, dataset, module, task, train_cfg,
+                seed=seed,
+                compression=policy,
+                state_dir=(os.path.join(client_state_dir, f"silo_{rank}")
+                           if client_state_dir else None),
+                resume=resume, prefetch_depth=prefetch_depth,
+                heartbeat_s=heartbeat_s, obs=silo_obs,
+                device_gate=device_gate))
+    except BaseException:
+        # a silo endpoint/manager that fails to construct (port already
+        # bound, bad address, state-dir OSError) raises BEFORE the main
+        # run block's finally exists — the serving front's listening
+        # socket and the obs recorder must not outlive the failed
+        # launch (an in-process relaunch would hit EADDRINUSE)
+        if own_tier:
+            tier.close()
+        if obs_server is not None:
+            obs_server.close()
+        raise
 
     # Warm the two heavyweight programs ON THE MAIN THREAD before any
     # actor thread starts: one local_train at the padded shape and one
@@ -1852,25 +1929,43 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
 
     threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
     server_thread = threading.Thread(target=server.run, daemon=True)
-    for t in threads:
-        t.start()
-    server_thread.start()
-    server.send_init_msg()
-    server_thread.join(timeout=join_timeout_s)
-    if server_thread.is_alive():
-        if raise_on_timeout:
-            raise RuntimeError(
-                f"federation did not finish within {join_timeout_s:.0f}s "
-                "(dead worker or quorum never reached?)")
-        # non-raising path: an empty/partial history otherwise looks like
-        # a silent success — say loudly what happened (observed: a slow
-        # XLA:CPU compile pushing the protocol past the join budget)
-        logging.error(
-            "federation still running after join_timeout_s=%.0f — "
-            "returning partial history (%d records); raise the timeout "
-            "for slow-compile hosts", join_timeout_s, len(history))
-    for t in threads:
-        t.join(timeout=60)
+    try:
+        for t in threads:
+            t.start()
+        server_thread.start()
+        server.send_init_msg()
+        server_thread.join(timeout=join_timeout_s)
+        if server_thread.is_alive():
+            if raise_on_timeout:
+                raise RuntimeError(
+                    f"federation did not finish within "
+                    f"{join_timeout_s:.0f}s "
+                    "(dead worker or quorum never reached?)")
+            # non-raising path: an empty/partial history otherwise looks
+            # like a silent success — say loudly what happened (observed:
+            # a slow XLA:CPU compile pushing the protocol past the join
+            # budget)
+            logging.error(
+                "federation still running after join_timeout_s=%.0f — "
+                "returning partial history (%d records); raise the "
+                "timeout for slow-compile hosts", join_timeout_s,
+                len(history))
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        # EVERY exit (incl. the join-timeout raise above and the stall
+        # re-raise below) releases the serving front's listening socket
+        # + worker threads and stops any open obs profile window — a
+        # raised launch must not leave a port bound for the process
+        # lifetime (an in-process relaunch would hit EADDRINUSE)
+        if own_tier:
+            # flushes the final SLO record into the flight log, then
+            # stops the front + swap worker + coalescer;
+            # caller-provided tiers stay open (the caller is still
+            # serving / inspecting them)
+            tier.close()
+        if obs_server is not None:
+            obs_server.close()
     # wire accounting from the server's transport endpoint: every uplink
     # reply lands in bytes_received, every broadcast in bytes_sent —
     # ACTUAL encoded frame lengths, not array-size estimates. (Quorum's
@@ -1922,9 +2017,6 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     if getattr(server, "_pace", None) is not None \
             and getattr(server, "round_deadline_s", None):
         tmr.gauge("cp_steered_deadline_s", float(server.round_deadline_s))
-    if obs_server is not None:
-        # stop any profile window an aborted schedule left open
-        obs_server.close()
     err = getattr(server, "scheduling_error", None)
     if err is not None:
         # the server already checkpointed final state and FINISHed the

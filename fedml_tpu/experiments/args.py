@@ -94,6 +94,22 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "every telemetry record (default: a "
                              "per-driver constant) — lets one obs_dir "
                              "hold several jobs' logs")
+    parser.add_argument("--serve_port", type=int, default=None,
+                        help="federated serving tier (fedml_tpu/serve, "
+                             "--algo fedavg_cross_silo): hot-swap every "
+                             "round's aggregated model into a jitted, "
+                             "batch-coalescing TCP/JSON inference "
+                             "endpoint on this port (0 = ephemeral) that "
+                             "serves round r while r+1 trains. Pure "
+                             "observer: trajectories are bit-exact vs "
+                             "unset (the default: no serving)")
+    parser.add_argument("--serve_staleness_rounds", type=int, default=2,
+                        help="serving staleness bound: replies lagging "
+                             "the newest trained round by more than this "
+                             "many rounds are flagged stale (the "
+                             "endpoint keeps serving its last good "
+                             "model either way — a bounded-stale answer "
+                             "beats a refused one)")
     parser.add_argument("--compile_cache_dir", type=str, default=None,
                         help="persistent XLA compilation cache dir "
                              "(default: $FEDML_TPU_COMPILE_CACHE; unset = "

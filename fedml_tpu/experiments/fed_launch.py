@@ -171,6 +171,11 @@ def _validate_before_sink(args, ds):
     if args.accum_steps > 1 and args.algo in _CUSTOM_LOOP_ALGOS:
         logging.warning("--accum_steps is only wired for TrainConfig-based "
                         "algorithms; ignoring for %r", args.algo)
+    if getattr(args, "serve_port", None) is not None \
+            and args.algo != "fedavg_cross_silo":
+        logging.warning("--serve_port is only wired for --algo "
+                        "fedavg_cross_silo (the serving tier rides its "
+                        "broadcast publishes); ignoring for %r", args.algo)
     if (getattr(args, "prefetch_depth", 2) != 2
             and args.algo in _CUSTOM_LOOP_ALGOS):
         # the async round pipeline rides FedAvgAPI._host_round_inputs;
@@ -235,6 +240,16 @@ def run_algo(args):
             pace_steering=getattr(args, "pace_steering", False),
             join_rate_limit=getattr(args, "join_rate_limit", 0.0),
             max_deadline_extensions=resolve_max_extensions(args),
+            # federated serving tier (fedml_tpu/serve): hot-swapped
+            # inference endpoint riding the round-close publishes
+            serve_port=getattr(args, "serve_port", None),
+            serve_staleness_rounds=getattr(args, "serve_staleness_rounds",
+                                           2),
+            # flight recorder (fedml_tpu/obs): previously only the
+            # main_fedavg runners threaded these — the fed_launch
+            # cross-silo path silently dropped --obs_dir/--job_id
+            obs_dir=getattr(args, "obs_dir", None),
+            job_id=getattr(args, "job_id", None),
             # scale the join budget with the local work — on a 1-core
             # host the silo threads SERIALIZE, so the budget grows with
             # epochs x rounds x silos; the 1200 floor absorbs a

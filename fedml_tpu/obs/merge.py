@@ -58,7 +58,8 @@ def fold_records(records: Sequence[Dict[str, Any]],
         job = rec.get("job_id")
         return rounds.setdefault((str(job), int(r)), {
             "round": int(r), "job_id": job, "server": None, "perf": None,
-            "silo_rounds": {}, "silo_reports": [], "anomalies": []})
+            "silo_rounds": {}, "silo_reports": [], "serve": [],
+            "anomalies": []})
 
     for rec in records:
         kind = rec.get("kind")
@@ -84,6 +85,11 @@ def fold_records(records: Sequence[Dict[str, Any]],
             if prev is None or (rec.get("t_wall", 0)
                                 >= prev.get("t_wall", 0)):
                 row(rec, r)["perf"] = rec
+        elif kind == "serve":
+            # serving-tier rows (swap / slo snapshots, fedml_tpu/serve)
+            # keyed on the SERVED round — obs report's serving section
+            # folds exactly these, so live tail == offline report
+            row(rec, r)["serve"].append(rec)
         elif kind == "silo":
             row(rec, r)["silo_reports"].append(rec)
         elif kind == "anomaly":

@@ -131,6 +131,33 @@ METRICS: Dict[str, Dict[str, str]] = {
                                 "endpoint for a job not running there "
                                 "(counted on the physical endpoint, "
                                 "dropped)"),
+    # -- federated serving tier (fedml_tpu/serve/) -------------------------
+    "serve_requests": _m(KIND_COUNTER, "serving",
+                         "predict requests accepted by the batch "
+                         "coalescer (shed requests count too — they "
+                         "entered the submit path)"),
+    "serve_batches": _m(KIND_COUNTER, "serving",
+                        "coalesced batches dispatched to the warmed "
+                        "predict program"),
+    "serve_shed": _m(KIND_COUNTER, "serving",
+                     "requests rejected by load shedding (full bounded "
+                     "queue or a deadline that died in the queue — the "
+                     "429 analogue)"),
+    "serve_swap_ms": _m(KIND_GAUGE, "serving",
+                        "slowest hot-swap (async device_put + atomic "
+                        "reference flip) installing a round's model "
+                        "into the endpoint; the first install's "
+                        "bucket-ladder compile is excluded (one-off)"),
+    "serve_p50_ms": _m(KIND_GAUGE, "serving",
+                       "median request latency (submit to reply) over "
+                       "the coalescer's bounded window, high-watered"),
+    "serve_p99_ms": _m(KIND_GAUGE, "serving",
+                       "p99 request latency over the coalescer's "
+                       "bounded window, high-watered"),
+    "serve_staleness_rounds": _m(KIND_GAUGE, "serving",
+                                 "largest trained-vs-serving round gap "
+                                 "observed (the staleness bound's "
+                                 "measured counterpart)"),
     # -- tiered client-state store (state/store.py) ------------------------
     "state_cache_hits": _m(KIND_COUNTER, "state store",
                            "shard reads served from the resident LRU"),

@@ -24,7 +24,7 @@ from fedml_tpu.obs.tail import _quantile, round_table_rows
 #: counter families rolled up into the report (everything else a round
 #: record carries still lands under ``counters_total``)
 _ROLLUP_PREFIXES = ("ft_", "cp_", "state_", "obs_", "comm_",
-                    "prefetch_")
+                    "prefetch_", "serve_")
 
 
 def _dist(values: List[float]) -> Optional[Dict[str, float]]:
@@ -63,6 +63,59 @@ def _mfu_trend(mfus: List[float]) -> Optional[Dict[str, Any]]:
         "first_half_mean": round(fm, 6),
         "second_half_mean": round(sm, 6),
         "trend": direction,
+    }
+
+
+def _serving_section(rounds: List[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """The serving tier's SLO summary, folded from the ``serve`` flight
+    records the merge keyed per round (fedml_tpu/serve): cumulative
+    request/batch/shed counts from the NEWEST slo snapshot (they are
+    cumulative by construction), latency p50/p99 from the same row,
+    swap-cost distribution over every swap record, and the staleness
+    distribution across swaps. None when the job never served."""
+    slo_rows: List[Dict[str, Any]] = []
+    swap_rows: List[Dict[str, Any]] = []
+    for row in rounds:
+        for rec in row.get("serve", []):
+            if rec.get("event") == "slo":
+                slo_rows.append(rec)
+            elif rec.get("event") == "swap":
+                swap_rows.append(rec)
+    if not slo_rows and not swap_rows:
+        return None
+    slo_rows.sort(key=lambda r: (r.get("t_wall", 0), r.get("seq", 0)))
+    latest = slo_rows[-1] if slo_rows else {}
+    swap_ms = [r.get("swap_ms") for r in swap_rows
+               if r.get("swap_ms") is not None]
+    staleness = [r.get("staleness") for r in slo_rows
+                 if r.get("staleness") is not None]
+    requests = latest.get("requests", 0)
+    p50 = latest.get("latency_p50_ms")
+    p99 = latest.get("latency_p99_ms")
+    # request rate over the serving window (first serve record to the
+    # newest slo snapshot) — None when the window is a single instant
+    walls = [r.get("t_wall") for r in (slo_rows + swap_rows)
+             if r.get("t_wall") is not None]
+    window = (max(walls) - min(walls)) if len(walls) > 1 else 0.0
+    rate = (round(requests / window, 2) if window > 0 and requests
+            else None)
+    return {
+        "requests": int(requests),
+        "requests_per_sec": rate,
+        "batches": int(latest.get("batches", 0)),
+        "shed": int(latest.get("shed", 0)),
+        "latency_p50_ms": p50,
+        "latency_p99_ms": p99,
+        "swaps": len(swap_rows),
+        # the FIRST swap carries the one-off bucket warmup; the swap
+        # records themselves already exclude it (endpoint.install)
+        "swap_ms": _dist([float(v) for v in swap_ms]),
+        "served_round": latest.get("served_round"),
+        "staleness": {
+            "max": max(staleness) if staleness else 0,
+            "values": sorted({int(s) for s in staleness}),
+        } if staleness else None,
     }
 
 
@@ -113,6 +166,7 @@ def summarize_job(merged: Dict[str, Any], job_id: str) -> Dict[str, Any]:
                                       / len(table), 1) if table else None),
         },
         "counters": rollup,
+        "serving": _serving_section(rounds),
         "anomaly_count": len(anomalies),
         "anomalies": anomalies,
     }
@@ -173,6 +227,25 @@ def to_markdown(report: Dict[str, Any]) -> str:
              f"({wire.get('bytes_per_round')} B/round)"),
             ("anomalies", s.get("anomaly_count", 0)),
         ]
+        serving = s.get("serving")
+        if serving:
+            sw = serving.get("swap_ms") or {}
+            st = serving.get("staleness") or {}
+            rows.extend([
+                ("serving requests (rate)",
+                 f"{serving['requests']} "
+                 f"({serving.get('requests_per_sec') or '-'}/s, "
+                 f"{serving['shed']} shed)"),
+                ("serving latency p50/p99 (ms)",
+                 f"{serving.get('latency_p50_ms', '-')}/"
+                 f"{serving.get('latency_p99_ms', '-')}"),
+                ("serving swaps (p50/max ms)",
+                 f"{serving['swaps']} "
+                 f"({sw.get('p50', '-')}/{sw.get('max', '-')})"),
+                ("serving round (max staleness)",
+                 f"r{serving.get('served_round')} "
+                 f"({st.get('max', 0)} rounds)"),
+            ])
         for name, value in rows:
             lines.append(f"| {name} | {value if value is not None else '-'}"
                          " |")

@@ -59,6 +59,12 @@ class JobSpec:
     pace_steering: bool = False
     join_rate_limit: float = 0.0
     max_deadline_extensions: Optional[int] = 25
+    # federated serving tier (fedml_tpu/serve): None = no endpoint;
+    # 0 = ephemeral port. The tier shares the job's JobDeviceGate, so
+    # serving traffic takes fair-share device turns like the job's own
+    # training, and its metrics land in the job's obs/billing report.
+    serve_port: Optional[int] = None
+    serve_staleness_rounds: int = 2
     # dataset shape knobs (blob)
     dim: int = 8
     class_num: int = 3

@@ -83,7 +83,12 @@ def run_one_job(spec: JobSpec, base_dir: str, *, comm_factory=None,
         obs_dir=(job_obs_dir(base_dir, spec.id) if obs else None),
         job_id=spec.id,
         comm_factory=comm_factory,
-        device_gate=device_gate)
+        device_gate=device_gate,
+        # per-tenant serving endpoint: shares this job's device gate
+        # (fair-share slice) and its timer/obs, so serving SLO rows
+        # land in the same per-tenant billing report
+        serve_port=spec.serve_port,
+        serve_staleness_rounds=spec.serve_staleness_rounds)
     ledger = ServerControlCheckpointer(ctrl_dir).read_ledger()
     return {"job_id": spec.id, "history": history, "model": model,
             "ledger": ledger, "rounds": spec.rounds,

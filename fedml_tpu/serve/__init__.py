@@ -1,0 +1,45 @@
+"""fedml_tpu.serve — the federated serving tier.
+
+Closes the train->serve loop: every round's aggregated global model is
+hot-swapped into a jitted, batch-coalescing inference endpoint that
+serves WHILE the next round trains, sharing the one device through the
+same mutex (or per-job ``JobDeviceGate``) as training.
+
+Layers (one module each):
+
+- :mod:`fedml_tpu.serve.endpoint` — double-buffered param slots,
+  atomic reference-flip swap, bucket-laddered jit warmup (no request
+  ever eats an XLA compile);
+- :mod:`fedml_tpu.serve.batcher` — bounded-queue batch coalescing
+  (max batch + max linger), per-request deadlines, load shedding;
+- :mod:`fedml_tpu.serve.rollout` — staleness-bounded rollout fed by
+  full ``ServerControlCheckpointer`` blobs or compression-mirror
+  deltas (shared ``comm/compression.py`` decode path, full-precision
+  fallback on fingerprint mismatch), plus personalized variants from
+  the tiered client-state store;
+- :mod:`fedml_tpu.serve.server` — the threaded TCP/JSON front reusing
+  ``comm/`` framing, the :class:`ServingTier` bundle, and the
+  synthetic-traffic driver the bench/smoke use.
+
+``python -m fedml_tpu.serve --smoke`` is the CI front; launchers wire
+serving with ``--serve_port`` / ``--serve_staleness_rounds``. Serving
+is a PURE OBSERVER of training — trajectories are bit-exact with it on
+or off (pinned in ``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.serve.batcher import BatchCoalescer, ShedError
+from fedml_tpu.serve.endpoint import (ModelEndpoint, ServedModel,
+                                      bucket_for, bucket_ladder)
+from fedml_tpu.serve.rollout import PERSONAL_FIELD, RolloutManager
+from fedml_tpu.serve.server import (ServeClient, ServingServer,
+                                    ServingTier, build_serving,
+                                    drive_traffic)
+
+__all__ = [
+    "BatchCoalescer", "ModelEndpoint", "PERSONAL_FIELD",
+    "RolloutManager", "ServeClient", "ServedModel", "ServingServer",
+    "ServingTier", "ShedError", "bucket_for", "bucket_ladder",
+    "build_serving", "drive_traffic",
+]

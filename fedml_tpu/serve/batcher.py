@@ -1,0 +1,280 @@
+"""Bounded-queue batch coalescing for the inference endpoint.
+
+Single-row dispatches waste the chip: the predict program is warmed per
+batch bucket (``endpoint.bucket_ladder``), so the cheapest way to serve
+heavy traffic is to coalesce concurrent requests into one bucketed
+batch. The coalescer is deliberately boring and bounded:
+
+- **bounded queue** — ``queue_depth`` pending requests max; a submit
+  against a full queue is SHED immediately (the 429 analogue, counted
+  as ``serve_shed``), never parked on an unbounded list. Load beyond
+  the chip's throughput degrades to fast rejections, not to a latency
+  collapse;
+- **max batch + max linger** — the dispatch loop takes the first
+  waiting request, then drains more until the batch holds
+  ``max_batch`` rows or ``linger_us`` has passed since the first row
+  arrived. Low traffic pays at most the linger; saturated traffic
+  fills buckets without waiting;
+- **per-request deadline** — a request whose deadline expired while it
+  queued is answered with a shed instead of burning a device slot on
+  an answer nobody is waiting for;
+- **per-variant batches** — rows for different personalized variants
+  never share a batch (different params); the drain takes the longest
+  same-variant prefix so mixed traffic still coalesces.
+
+Latency accounting rides a bounded ring; ``slo_snapshot()`` derives
+p50/p99 and mirrors them into the metric registry's ``serve_p50_ms`` /
+``serve_p99_ms`` gauges.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ShedError(RuntimeError):
+    """Request rejected by load-shedding (full queue or dead deadline) —
+    the transport front maps this to its 429-style reply."""
+
+
+class _Request:
+    __slots__ = ("x", "variant", "deadline", "done", "outputs", "round_idx",
+                 "error", "t_submit")
+
+    def __init__(self, x, variant, deadline):
+        self.x = x
+        self.variant = variant
+        self.deadline = deadline  # monotonic seconds, or None
+        self.done = threading.Event()
+        self.outputs = None
+        self.round_idx: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+
+
+class BatchCoalescer:
+    """Coalesce concurrent predict calls into bucketed batches.
+
+    ``predict(x, variant) -> (outputs, round_idx)`` is the endpoint's
+    request path; the coalescer owns the one worker thread that calls
+    it, so device dispatch order is single-threaded by construction.
+    """
+
+    def __init__(self, predict, *, max_batch: int = 8,
+                 linger_us: int = 2000, queue_depth: int = 64,
+                 timer=None, latency_window: int = 4096):
+        self._predict = predict
+        self.max_batch = max(1, int(max_batch))
+        self.linger_s = max(0, int(linger_us)) / 1e6
+        self._queue: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=max(1, int(queue_depth)))
+        self._timer = timer
+        #: guards the counters + the latency ring (submit threads and
+        #: the dispatch worker both write)
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.batches = 0
+        self.shed = 0
+        self.batched_rows = 0
+        self._latencies_ms = collections.deque(maxlen=latency_window)
+        #: a different-variant request popped mid-drain, held as the
+        #: NEXT batch's head (worker-thread only). Never pushed back
+        #: into the shared queue: a blocking put into our own full
+        #: queue would deadlock the lone consumer, and a tail re-queue
+        #: would restart the request's wait behind everyone else
+        self._carry: Optional[_Request] = None
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._dispatch_loop,
+                                        daemon=True, name="serve-batcher")
+        self._worker.start()
+
+    # -- submit side ---------------------------------------------------------
+    def submit(self, x, *, variant: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               timeout_s: float = 30.0):
+        """Enqueue one request and block for its result. Returns
+        ``(outputs, served_round)``; raises :class:`ShedError` when the
+        queue is full or the deadline died in the queue, and re-raises
+        the endpoint's error (bad shape, nothing installed) as-is."""
+        with self._lock:
+            self.requests += 1
+            if self._timer is not None:
+                self._timer.count("serve_requests")
+        if self._stop.is_set():
+            # no worker will ever drain this — shed NOW instead of
+            # letting a straggler connection block out its full timeout
+            self._note_shed()
+            raise ShedError("coalescer closed — load shed")
+        deadline = (time.monotonic() + float(deadline_s)
+                    if deadline_s is not None else None)
+        req = _Request(x, variant, deadline)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self._note_shed()
+            raise ShedError(
+                f"serve queue full ({self._queue.maxsize} pending) — "
+                "load shed") from None
+        if not req.done.wait(timeout_s):
+            # the caller gave up; the worker will still answer the slot
+            # (discarded), and the deadline check sheds it if one is set
+            raise TimeoutError(f"no serve result within {timeout_s}s")
+        if req.error is not None:
+            raise req.error
+        return req.outputs, req.round_idx
+
+    def _note_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+            if self._timer is not None:
+                self._timer.count("serve_shed")
+
+    # -- dispatch side -------------------------------------------------------
+    def _drain_batch(self, first: _Request) -> List[_Request]:
+        """``first`` plus same-variant requests drained until the batch
+        is full or the linger window (measured from ``first``'s arrival)
+        closes. Differently-variant heads are put back for the next
+        batch."""
+        batch = [first]
+        rows = int(np.shape(first.x)[0]) if hasattr(first.x, "shape") \
+            else len(first.x)
+        # linger from the FIRST row's arrival: a saturated queue fills
+        # the bucket instantly; a trickle waits at most linger_s
+        until = first.t_submit + self.linger_s
+        while rows < self.max_batch:
+            remaining = until - time.monotonic()
+            try:
+                # window closed: take only what is ALREADY waiting (free
+                # rows), never wait more — the first request's latency
+                # budget is spent
+                nxt = (self._queue.get(timeout=remaining)
+                       # ft: allow[FT015] the linger window is a wall-clock serving contract (max added latency per request), not schedule state
+                       if remaining > 0 else self._queue.get_nowait())
+            except queue.Empty:
+                break
+            if nxt.variant != first.variant:
+                # different params: carry it as the next batch's head
+                self._carry = nxt
+                break
+            batch.append(nxt)
+            rows += int(np.shape(nxt.x)[0]) if hasattr(nxt.x, "shape") \
+                else len(nxt.x)
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            batch = self._drain_batch(first)
+            now = time.monotonic()
+            live: List[_Request] = []
+            for req in batch:
+                # ft: allow[FT015] per-request deadlines are real wall-clock SLOs — an expired request must be shed, not served late
+                if req.deadline is not None and now > req.deadline:
+                    req.error = ShedError("deadline expired in queue")
+                    self._note_shed()
+                    req.done.set()
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            self._run_batch(live)
+
+    def _run_batch(self, live: List[_Request]) -> None:
+        t0 = time.perf_counter()
+        xs = np.concatenate([np.asarray(r.x) for r in live])
+        try:
+            if xs.shape[0] > self.max_batch:
+                # oversized concat (several multi-row requests): split on
+                # the ladder's top rung so every chunk stays warm-compiled
+                outs, round_idx = [], None
+                for off in range(0, xs.shape[0], self.max_batch):
+                    o, round_idx = self._predict(
+                        xs[off:off + self.max_batch], live[0].variant)
+                    outs.append(o)
+                out = np.concatenate(outs)
+            else:
+                out, round_idx = self._predict(xs, live[0].variant)
+        except Exception as exc:  # surface per-request, keep serving
+            for req in live:
+                req.error = exc
+                req.done.set()
+            logging.warning("serve batch failed (%d requests)", len(live),
+                            exc_info=True)
+            return
+        ms_total = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            self.batches += 1
+            self.batched_rows += int(xs.shape[0])
+            if self._timer is not None:
+                self._timer.count("serve_batches")
+        off = 0
+        now = time.monotonic()
+        for req in live:
+            n = int(np.shape(req.x)[0]) if hasattr(req.x, "shape") \
+                else len(req.x)
+            req.outputs = out[off:off + n]
+            req.round_idx = round_idx
+            off += n
+            with self._lock:
+                self._latencies_ms.append(
+                    (now - req.t_submit) * 1000.0)
+            req.done.set()
+        logging.debug("serve batch: %d requests/%d rows in %.2fms",
+                      len(live), xs.shape[0], ms_total)
+
+    # -- accounting ----------------------------------------------------------
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """Cumulative counters + latency quantiles; mirrors p50/p99 into
+        the registry gauges. The serving tier appends this as a
+        ``serve``/``slo`` flight record so live tail == offline report
+        fold the same rows."""
+        from fedml_tpu.obs.tail import _quantile
+        with self._lock:
+            lat = list(self._latencies_ms)
+            snap = {"requests": int(self.requests),
+                    "batches": int(self.batches),
+                    "shed": int(self.shed),
+                    "batched_rows": int(self.batched_rows)}
+        p50, p99 = _quantile(lat, 0.50), _quantile(lat, 0.99)
+        if p50 is not None:
+            snap["latency_p50_ms"] = round(p50, 3)
+            snap["latency_p99_ms"] = round(p99, 3)
+            if self._timer is not None:
+                self._timer.gauge("serve_p50_ms", p50)
+                self._timer.gauge("serve_p99_ms", p99)
+        return snap
+
+    def close(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=5)
+        # answer anything still queued (or carried) with a shed, so no
+        # submitter blocks on a dead worker
+        if self._carry is not None:
+            self._carry.error = ShedError("coalescer closed")
+            self._carry.done.set()
+            self._carry = None
+        # two passes with a beat between them: a submit that passed the
+        # closed check just before _stop was set may still be putting —
+        # its request must get a shed reply, not a 30 s timeout
+        for _ in range(2):
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                req.error = ShedError("coalescer closed")
+                req.done.set()
+            time.sleep(0.05)

@@ -1,0 +1,406 @@
+"""Staleness-bounded rollout: aggregated checkpoints -> the endpoint.
+
+The rollout layer is the only writer of the endpoint's served slots.
+It is fed two ways, mirroring how the training tier itself moves
+models:
+
+- **full checkpoints** — ``ServerControlCheckpointer`` blobs (the
+  elastic control plane's durable snapshots): ``watch_checkpoints``
+  polls the directory and installs each new round's
+  ``global_model``. This path needs no live trainer at all — it is
+  what keeps the endpoint serving across a SIGKILLed training server
+  (the blobs outlive the process) and what lets a standalone serving
+  process follow a training run it never shares memory with;
+- **compression-mirror deltas** — the cross-silo server's broadcast
+  payloads double as checkpoint deltas: ``publish`` accepts either a
+  full numpy tree or a compressed payload and decodes the latter
+  against the PREVIOUS served params with the SAME
+  ``comm/compression.py`` decode path the silos use — structure
+  fingerprint checked, and any mismatch falls back to a full model
+  (checkpoint blob when available) exactly like the silo JOIN resync
+  rule.
+
+**Personalized variants**: per-silo / per-cohort fine-tuned deltas held
+in the PR-6 tiered client-state store (field ``serve_delta``, one flat
+f32 delta per variant id) are applied to the served global so the
+endpoint serves fine-tuned models, not just the one global
+(``refresh_personalized``).
+
+**Staleness bound**: the rollout tracks the newest TRAINED round it has
+seen vs the round actually serving; the gap is the staleness the
+``serve_staleness_rounds`` gauge high-waters, and responses past
+``staleness_rounds`` are flagged stale (the transport front surfaces
+the flag; requests are still answered — a bounded-stale answer beats a
+refused one, the same judgment the deadline-partial aggregate makes).
+
+Swaps run on ONE rollout worker thread: ``publish`` only enqueues, so
+the training round loop never blocks on a device transfer for serving
+(pure-observer discipline), and the endpoint's reference flip stays
+out of every request AND out of every round close.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+#: client-state store field holding per-variant personalization deltas
+#: (flat f32, quantize_tree layout — the same flat layout the top-k EF
+#: residuals use)
+PERSONAL_FIELD = "serve_delta"
+
+
+def _apply_flat_delta(tree, flat_delta: np.ndarray):
+    """tree + delta, delta in the flat f32 layout over tree's leaves —
+    decoded by the compression layer's OWN layout inverse
+    (``comm/compression._unflatten_like``), so a personalization delta
+    rebuilds exactly like a top-k EF payload would and the two layouts
+    can never drift apart."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.comm.compression import _unflatten_like
+    from fedml_tpu.core import pytree as pt
+    total = sum(int(np.prod(np.shape(l)) or 1)
+                for l in jax.tree.leaves(tree))
+    if int(flat_delta.size) != total:
+        raise ValueError(
+            f"personalization delta has {flat_delta.size} params but the "
+            f"served model has {total} — refusing a silently wrong "
+            "variant")
+    return jax.tree.map(np.asarray, pt.tree_add(
+        tree, _unflatten_like(jnp.asarray(flat_delta, jnp.float32),
+                              tree)))
+
+
+class RolloutManager:
+    """Feeds the endpoint; owns the swap worker and the staleness gauge."""
+
+    def __init__(self, endpoint, *, staleness_rounds: int = 2,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpointer=None, store=None, timer=None, obs=None):
+        self.endpoint = endpoint
+        self.staleness_rounds = max(0, int(staleness_rounds))
+        self._timer = timer
+        self._obs = obs
+        self._ckpt = checkpointer
+        if checkpoint_dir and checkpointer is None:
+            from fedml_tpu.control import ServerControlCheckpointer
+            self._ckpt = ServerControlCheckpointer(checkpoint_dir)
+        self._store = store
+        #: last FULL params actually serving (numpy) — the delta decode
+        #: base; advanced by exactly what each publish decodes to, the
+        #: same chain discipline as the silo mirror
+        self._base = None
+        #: True once a delta was refused/skipped: the base has drifted
+        #: off the sender's mirror at the VALUE level, which the
+        #: structure fingerprint cannot see — every further delta must
+        #: be refused (fallback or skip) until a FULL model rebases
+        #: the chain, exactly as a resynced silo waits for its full
+        #: mirror before decoding shared deltas again
+        self._chain_broken = False
+        self._lock = threading.Lock()
+        self.served_round = -1
+        self.trained_round = -1
+        self.delta_swaps = 0
+        self.full_swaps = 0
+        self.fallbacks = 0
+        #: FIFO swap queue, applied strictly in publish order: delta
+        #: payloads decode against the base the PREVIOUS payload
+        #: produced (the silo-mirror chain discipline), so a skipped
+        #: intermediate delta would silently corrupt every later
+        #: rebuild — the structure fingerprint cannot see value-level
+        #: base drift. The worker keeps up trivially (one device_put
+        #: per round); a deep queue is logged, never dropped.
+        self._pending: "queue.Queue" = queue.Queue()
+        #: published-but-not-yet-installed count — drain()'s real
+        #: completion signal (queue emptiness goes true the moment the
+        #: worker DEQUEUES the last item, before its install lands)
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._swap_loop,
+                                        daemon=True, name="serve-rollout")
+        self._worker.start()
+
+    # -- publish (trainer side: enqueue only, never block) -------------------
+    def publish(self, round_idx: int, payload, *,
+                rebase: bool = True) -> None:
+        """Hand the rollout one trained round: a full numpy model tree
+        or a compressed broadcast payload (``comm/compression.py``
+        dict). Called from the training server's round loop — must not
+        block, must not raise (pure observer).
+
+        ``rebase`` (full payloads only): True means this full IS the
+        sender's mirror rebase (a live full broadcast), so it
+        re-licenses delta decoding after a chain break. Checkpoint-fed
+        fulls pass False — a blob holds the exact GLOBAL, which under
+        lossy downlink is not the mirror the next delta is encoded
+        against."""
+        try:
+            with self._lock:
+                self.trained_round = max(self.trained_round,
+                                         int(round_idx))
+            self._mirror_staleness()
+            with self._lock:
+                self._inflight += 1
+            self._pending.put((int(round_idx), payload, bool(rebase)))
+            depth = self._pending.qsize()
+            if depth > 8:
+                logging.warning(
+                    "serve rollout swap queue depth %d — the swap worker "
+                    "is falling behind training", depth)
+        except Exception:
+            logging.warning("serve publish for round %s failed — the "
+                            "endpoint keeps its current model", round_idx,
+                            exc_info=True)
+
+    def _mirror_staleness(self) -> None:
+        st = self.staleness()
+        if self._timer is not None:
+            self._timer.gauge("serve_staleness_rounds", float(st))
+
+    def staleness(self) -> int:
+        """Trained-vs-serving round gap (0 while the endpoint is
+        current; requests past ``staleness_rounds`` get flagged)."""
+        with self._lock:
+            if self.trained_round < 0 or self.served_round < 0:
+                return 0
+            return max(0, self.trained_round - self.served_round)
+
+    def stale(self) -> bool:
+        return self.staleness() > self.staleness_rounds
+
+    # -- swap worker ---------------------------------------------------------
+    def _swap_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                round_idx, payload, rebase = self._pending.get(
+                    timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self._install(round_idx, payload, rebase=rebase)
+            except Exception:
+                with self._lock:
+                    kept = self.served_round
+                logging.warning("serve swap for round %d failed — "
+                                "endpoint keeps round %d", round_idx,
+                                kept, exc_info=True)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+
+    def _install(self, round_idx: int, payload, *,
+                 rebase: bool = True) -> None:
+        from fedml_tpu.comm.compression import decompress, is_compressed
+        if is_compressed(payload):
+            base = self._base
+            try:
+                if base is None:
+                    raise ValueError("no served base for a delta payload")
+                if self._chain_broken:
+                    # the base drifted off the sender's mirror at the
+                    # VALUE level (a refused/skipped delta) — the
+                    # fingerprint check below would pass and silently
+                    # rebuild a wrong model; refuse until a full lands
+                    raise ValueError(
+                        "delta chain broken by an earlier refusal — "
+                        "waiting for a full-model rebase")
+                import jax
+                # delta rebuild is device compute: hold the SAME mutex
+                # (or per-job gate) as every other dispatch — the server
+                # gates its identical decompress call, and an ungated
+                # decode here would be a second dispatch queue racing
+                # training (and would dodge the scheduler's fair-share
+                # accounting)
+                with self.endpoint._device_lock:
+                    full = jax.tree.map(np.asarray,
+                                        decompress(payload, base))
+                self.delta_swaps += 1
+            except Exception as exc:
+                # fingerprint/count mismatch, no base, or a broken
+                # chain: the silo-resync rule — fall back to a FULL
+                # model (checkpoint blob when one exists) rather than
+                # installing a wrong rebuild, and mark the chain broken
+                # so LATER deltas (encoded against the mirror we no
+                # longer track) are refused too
+                self.fallbacks += 1
+                self._chain_broken = True
+                logging.warning(
+                    "serve delta for round %d refused (%s) — falling "
+                    "back to a full checkpoint", round_idx, exc)
+                got = self._full_from_checkpoint()
+                if got is None:
+                    return  # keep serving the last good round
+                round_idx, full = got  # the blob's OWN round labels it
+                rebase = False  # a blob is the GLOBAL, not the mirror
+        else:
+            import jax
+            full = jax.tree.map(np.asarray, payload)
+            self.full_swaps += 1
+        with self._lock:
+            if int(round_idx) < self.served_round:
+                # a stale source (an old checkpoint blob after a
+                # fallback, a late re-publish) must never regress the
+                # endpoint to an earlier round. A refused DELTA or
+                # refused live rebase still moved the SENDER's mirror
+                # past our base — mark the chain broken so the next
+                # delta is refused instead of decoded against the
+                # wrong base; a refused stale blob touched neither
+                # side, so the chain state stands.
+                if is_compressed(payload) or rebase:
+                    self._chain_broken = True
+                logging.warning(
+                    "serve install for round %d refused — already "
+                    "serving round %d", round_idx, self.served_round)
+                return
+        self.endpoint.install(round_idx, full)
+        with self._lock:
+            self._base = full
+            # _chain_broken tracks ONE invariant: does _base equal the
+            # sender's current mirror? A LIVE full broadcast rebases
+            # the mirror to exactly this tree — intact again; a blob
+            # full (rebase=False) installs the exact GLOBAL, which
+            # under lossy downlink is NOT the mirror the next delta is
+            # encoded against — broken until the server's next full
+            # rebase lands (silo resync, failover restore, or FINISH).
+            # A decoded delta advanced base exactly as the mirror
+            # advanced, so it preserves whichever state held — and it
+            # only decodes at all when the chain was intact.
+            if not is_compressed(payload):
+                self._chain_broken = not rebase
+            self.served_round = int(round_idx)
+            self.trained_round = max(self.trained_round, int(round_idx))
+        self._mirror_staleness()
+
+    def _full_from_checkpoint(self):
+        """``(round_idx, global_model)`` from the newest complete blob,
+        or None."""
+        if self._ckpt is None:
+            return None
+        try:
+            snap = self._ckpt.load_latest()
+        except Exception:
+            logging.warning("serve checkpoint read failed", exc_info=True)
+            return None
+        if snap is None:
+            return None
+        return int(snap["round_idx"]), snap["global_model"]
+
+    # -- checkpoint-fed rollout (standalone / failover serving) --------------
+    def refresh_from_checkpoint(self) -> bool:
+        """Install the newest full checkpoint blob if it is newer than
+        what is serving. Returns True when a swap was enqueued. The
+        poll half of the ``watch_checkpoints`` loop; also the delta
+        path's fallback source."""
+        if self._ckpt is None:
+            return False
+        try:
+            latest = self._ckpt.latest_round()
+        except Exception:
+            logging.warning("serve checkpoint poll failed", exc_info=True)
+            return False
+        if latest is None:
+            return False
+        with self._lock:
+            self.trained_round = max(self.trained_round, int(latest))
+            have = self.served_round
+        self._mirror_staleness()
+        if latest <= have:
+            return False
+        got = self._full_from_checkpoint()
+        if got is None:
+            return False
+        blob_round, full = got
+        # blob = the exact GLOBAL, not the sender's mirror — it must
+        # not re-license delta decoding after a chain break
+        self.publish(blob_round, full, rebase=False)
+        return True
+
+    def watch_checkpoints(self, poll_s: float = 0.25) -> threading.Event:
+        """Background poller: follow the checkpoint directory until the
+        returned event is set. The standalone serving mode — survives
+        the training process dying entirely (blobs are durable)."""
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(poll_s):
+                self.refresh_from_checkpoint()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="serve-ckpt-watch").start()
+        return stop
+
+    # -- personalized variants (PR-6 tiered client-state store) --------------
+    def publish_personal(self, variant: str, round_idx: int,
+                         flat_delta: np.ndarray) -> None:
+        """Install one personalized variant: served global + delta.
+        Deltas ride the flat f32 layout (the EF-residual layout), so a
+        fine-tuning job can write them straight into the store."""
+        with self._lock:
+            base = self._base
+        if base is None:
+            raise RuntimeError("no global model served yet — personalized "
+                               "variants apply deltas to the served base")
+        with self.endpoint._device_lock:  # delta apply is device compute
+            rebuilt = _apply_flat_delta(base, flat_delta)
+        self.endpoint.install(round_idx, rebuilt, variant=str(variant))
+
+    def refresh_personalized(self, round_idx: Optional[int] = None
+                             ) -> int:
+        """Read every variant delta from the client-state store's
+        ``serve_delta`` field and (re)install the variants against the
+        CURRENT served global. Returns the number installed."""
+        if self._store is None:
+            return 0
+        with self._lock:
+            base_round = self.served_round
+        r = int(round_idx) if round_idx is not None else base_round
+        n = 0
+        for vid in sorted(self._store.known_ids(PERSONAL_FIELD)):
+            try:
+                delta = self._store.get(PERSONAL_FIELD, int(vid))
+                self.publish_personal(str(vid), r,
+                                      np.asarray(delta, np.float32))
+                n += 1
+            except (KeyError, ValueError, RuntimeError):
+                logging.warning("personal variant %s refused", vid,
+                                exc_info=True)
+        return n
+
+    # -- lifecycle / reporting ----------------------------------------------
+    def counters(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"served_round": int(self.served_round),
+                    "trained_round": int(self.trained_round),
+                    "staleness": int(max(0, self.trained_round
+                                         - self.served_round))
+                    if self.served_round >= 0 else 0,
+                    "delta_swaps": int(self.delta_swaps),
+                    "full_swaps": int(self.full_swaps),
+                    "fallbacks": int(self.fallbacks)}
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        """Block until every PUBLISHED swap has been fully applied
+        (tests and orderly shutdown; the live path never waits). Waits
+        on the in-flight count, not queue emptiness — the queue drains
+        one dequeue BEFORE the last install lands."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if self._inflight <= 0:
+                    return
+            # ft: allow[FT015] bounded shutdown drain — a wall-clock cap on how long close() waits for the swap worker
+            if _time.monotonic() >= deadline:
+                return
+            _time.sleep(0.01)
+
+    def close(self) -> None:
+        self.drain(timeout_s=5.0)
+        self._stop.set()
+        self._worker.join(timeout=5)

@@ -1,0 +1,353 @@
+"""The serving front: a threaded TCP/JSON endpoint + the tier bundle.
+
+Wire format reuses the ``comm/`` framing primitives verbatim
+(``tcp.send_frame`` / ``tcp.recv_frame``: u64 length-prefixed frames),
+with UTF-8 JSON payloads — a serving request is small and structured,
+so the zero-copy array codec would buy nothing while JSON keeps the
+endpoint curl-able from any language. One frame per request, one frame
+per reply, many requests per connection.
+
+Request::
+
+    {"op": "predict", "inputs": [[...], ...],      # [n, *feature_shape]
+     "variant": null | "<id>", "deadline_ms": 50}
+    {"op": "stats"}
+
+Reply::
+
+    {"status": "ok", "outputs": [[...], ...], "pred": [...],
+     "round": 7, "staleness": 0, "stale": false}
+    {"status": "shed", "reason": "..."}            # the 429 analogue
+    {"status": "error", "reason": "..."}
+
+``ServingTier`` is the bundle a launcher owns: endpoint + coalescer +
+rollout + (optionally) this TCP front, with ``publish_hook`` bound into
+the training server's round close and ``slo_report()`` as the SLO
+artifact. Serving is a PURE OBSERVER of training: it reads published
+model copies and shares the device mutex; it never writes training
+state, so trajectories are bit-exact with serving on or off (pinned in
+``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from fedml_tpu.comm.tcp import recv_frame, send_frame
+from fedml_tpu.serve.batcher import BatchCoalescer, ShedError
+from fedml_tpu.serve.endpoint import ModelEndpoint
+from fedml_tpu.serve.rollout import RolloutManager
+
+#: accept/read timeouts so shutdown is prompt and a half-open client
+#: can never pin a handler thread forever
+_ACCEPT_TIMEOUT_S = 0.5
+_CONN_TIMEOUT_S = 60.0
+
+
+class ServingServer:
+    """Threaded TCP front over a :class:`ServingTier`'s submit path."""
+
+    def __init__(self, tier: "ServingTier", host: str = "127.0.0.1",
+                 port: int = 0):
+        self._tier = tier
+        self._sock = socket.create_server((host, port))
+        self._sock.listen(64)
+        self._sock.settimeout(_ACCEPT_TIMEOUT_S)
+        self.address = self._sock.getsockname()
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="serve-accept")
+        self._accept_thread.start()
+        logging.info("serving endpoint listening on %s:%d", *self.address)
+
+    @property
+    def port(self) -> int:
+        return int(self.address[1])
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(_CONN_TIMEOUT_S)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                try:
+                    frame = recv_frame(conn)
+                except (ConnectionError, socket.timeout, OSError):
+                    break
+                reply = self._handle(bytes(frame))
+                try:
+                    send_frame(conn, json.dumps(reply).encode())
+                except OSError:
+                    break
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, frame: bytes) -> Dict[str, Any]:
+        try:
+            req = json.loads(frame.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return {"status": "error", "reason": "malformed JSON frame"}
+        op = req.get("op", "predict")
+        if op == "stats":
+            return {"status": "ok", **self._tier.slo_report()}
+        if op != "predict":
+            return {"status": "error", "reason": f"unknown op {op!r}"}
+        try:
+            return self._tier.handle_predict(req)
+        except ShedError as exc:
+            return {"status": "shed", "reason": str(exc)}
+        except Exception as exc:  # keep serving on a bad request
+            logging.debug("serve request failed: %r", exc)
+            return {"status": "error", "reason": str(exc)}
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ServeClient:
+    """Minimal blocking client over the same framing (tests, bench
+    traffic drivers, the smoke CLI)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 30.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+
+    def request(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        send_frame(self._sock, json.dumps(obj).encode())
+        return json.loads(bytes(recv_frame(self._sock)).decode())
+
+    def predict(self, inputs, variant: Optional[str] = None,
+                deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        req: Dict[str, Any] = {"op": "predict",
+                               "inputs": np.asarray(inputs).tolist()}
+        if variant is not None:
+            req["variant"] = variant
+        if deadline_ms is not None:
+            req["deadline_ms"] = float(deadline_ms)
+        return self.request(req)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.request({"op": "stats"})
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ServingTier:
+    """Endpoint + coalescer + rollout (+ TCP front), one bundle.
+
+    ``build_serving`` is the constructor every launcher shares; the
+    training server's round loop drives :meth:`publish_hook` and the
+    front (or an in-process caller) drives :meth:`submit`.
+    """
+
+    def __init__(self, module, task: str, sample_input, *,
+                 max_batch: int = 8, linger_us: int = 2000,
+                 queue_depth: int = 64, staleness_rounds: int = 2,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpointer=None, store=None,
+                 device_gate=None, timer=None, obs=None,
+                 port: Optional[int] = None, host: str = "127.0.0.1"):
+        self.timer = timer
+        self._obs = obs
+        self.endpoint = ModelEndpoint(module, task,
+                                      sample_input=sample_input,
+                                      max_batch=max_batch,
+                                      device_lock=device_gate,
+                                      timer=timer, obs=obs)
+        self.batcher = BatchCoalescer(self.endpoint.predict,
+                                      max_batch=max_batch,
+                                      linger_us=linger_us,
+                                      queue_depth=queue_depth,
+                                      timer=timer)
+        self.rollout = RolloutManager(self.endpoint,
+                                      staleness_rounds=staleness_rounds,
+                                      checkpoint_dir=checkpoint_dir,
+                                      checkpointer=checkpointer,
+                                      store=store, timer=timer, obs=obs)
+        self.server: Optional[ServingServer] = None
+        if port is not None:
+            self.server = ServingServer(self, host=host, port=port)
+
+    @property
+    def port(self) -> Optional[int]:
+        return self.server.port if self.server is not None else None
+
+    # -- trainer side --------------------------------------------------------
+    def publish_hook(self, round_idx: int, payload) -> None:
+        """Bound into the training server's round close/broadcast: hands
+        the rollout this round's model (full tree or compressed delta).
+        Non-blocking, never raises (pure observer)."""
+        self.rollout.publish(round_idx, payload)
+
+    # -- request side --------------------------------------------------------
+    def submit(self, x, variant: Optional[str] = None,
+               deadline_s: Optional[float] = None):
+        """In-process predict through the coalescer: ``(outputs,
+        served_round)``. Shape-checked HERE, before the queue: a
+        malformed request must fail alone — inside a coalesced batch
+        its concat error would fail every well-formed co-batched
+        request."""
+        x = np.asarray(x, self.endpoint.feature_dtype)
+        if x.shape[1:] != self.endpoint.feature_shape:
+            raise ValueError(
+                f"request features {x.shape[1:]} do not match the "
+                f"served model's input contract "
+                f"{self.endpoint.feature_shape}")
+        return self.batcher.submit(x, variant=variant,
+                                   deadline_s=deadline_s)
+
+    def handle_predict(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        inputs = np.asarray(req["inputs"], self.endpoint.feature_dtype)
+        if inputs.ndim == len(self.endpoint.feature_shape):
+            inputs = inputs[None]  # single-row convenience
+        deadline_ms = req.get("deadline_ms")
+        out, round_idx = self.submit(
+            inputs, variant=req.get("variant"),
+            deadline_s=(float(deadline_ms) / 1e3
+                        if deadline_ms is not None else None))
+        reply: Dict[str, Any] = {
+            "status": "ok",
+            "outputs": np.asarray(out).tolist(),
+            "round": int(round_idx),
+            "staleness": int(self.rollout.staleness()),
+            "stale": bool(self.rollout.stale()),
+        }
+        if np.asarray(out).ndim == 2:  # classification logits
+            reply["pred"] = np.argmax(out, axis=-1).astype(int).tolist()
+        return reply
+
+    # -- reporting -----------------------------------------------------------
+    def slo_report(self) -> Dict[str, Any]:
+        """The SLO/billing snapshot: coalescer counters + latency
+        quantiles + rollout/swap state. Mirrored into the registry
+        gauges and appended as a ``serve``/``slo`` flight record, so
+        ``obs report``'s serving section folds the same rows."""
+        snap = self.batcher.slo_snapshot()
+        snap.update(self.rollout.counters())
+        snap["swaps"] = int(self.endpoint.swaps)
+        if self.endpoint.last_swap_ms is not None:
+            snap["last_swap_ms"] = round(self.endpoint.last_swap_ms, 3)
+        snap["variants"] = self.endpoint.variants()
+        if self._obs is not None:
+            self._obs.recorder.append({
+                "kind": "serve", "event": "slo",
+                "round": int(max(0, self.rollout.served_round)), **snap})
+        return snap
+
+    def close(self) -> None:
+        """Orderly shutdown: flush one last SLO record, stop the front,
+        drain the swap worker, stop the coalescer."""
+        try:
+            self.slo_report()
+        except Exception:
+            logging.warning("final serve SLO snapshot failed",
+                            exc_info=True)
+        if self.server is not None:
+            self.server.stop()
+        self.rollout.close()
+        self.batcher.close()
+
+
+def build_serving(module, task: str, sample_input, **kw) -> ServingTier:
+    """The single serving constructor every launcher shares (mirrors
+    ``obs.build_observability``). ``sample_input`` is one batch row of
+    the model's input (``dataset.train_data_global[0][:1]``) — it pins
+    the feature shape/dtype the bucket warmup compiles."""
+    return ServingTier(module, task, sample_input, **kw)
+
+
+def drive_traffic(port: int, inputs, *, requests: int = 50,
+                  concurrency: int = 4,
+                  deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+    """Closed-loop synthetic traffic against a serving port (bench +
+    smoke): ``concurrency`` client connections issue ``requests`` total
+    single-row predicts as fast as replies land. Returns counts and
+    client-observed latency quantiles."""
+    import time
+    rows = np.asarray(inputs)
+    results: List[Dict[str, Any]] = []
+    lock = threading.Lock()
+    idx = [0]
+
+    def worker():
+        client = ServeClient(port=port)
+        try:
+            while True:
+                with lock:
+                    if idx[0] >= requests:
+                        return
+                    i = idx[0]
+                    idx[0] += 1
+                t0 = time.perf_counter()
+                rep = client.predict(rows[i % len(rows):i % len(rows) + 1],
+                                     deadline_ms=deadline_ms)
+                ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    results.append({"status": rep.get("status"),
+                                    "round": rep.get("round"),
+                                    "stale": rep.get("stale"),
+                                    "ms": ms})
+        finally:
+            client.close()
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    ok = [r for r in results if r["status"] == "ok"]
+    lat = [r["ms"] for r in ok]
+
+    def q(p):
+        # the obs stack's one quantile definition — the bench artifact,
+        # the SLO gauges, and the obs report must agree on p50/p99
+        from fedml_tpu.obs.tail import _quantile
+        v = _quantile(lat, p)
+        return round(v, 3) if v is not None else None
+
+    return {"requests": len(results), "ok": len(ok),
+            "shed": sum(1 for r in results if r["status"] == "shed"),
+            "errors": sum(1 for r in results
+                          if r["status"] not in ("ok", "shed")),
+            "stale_replies": sum(1 for r in ok if r.get("stale")),
+            "rounds_served": sorted({r["round"] for r in ok
+                                     if r["round"] is not None}),
+            "wall_s": round(wall, 4),
+            # ft: allow[FT015] divide-by-zero guard on a measured wall-clock duration (reporting arithmetic, not schedule state)
+            "requests_per_sec": (round(len(ok) / wall, 2) if wall > 0
+                                 else None),
+            "latency_p50_ms": q(0.50), "latency_p99_ms": q(0.99)}

@@ -16,3 +16,6 @@ def roll_up(timer, hit, name, seen):
     # legal, exactly as Observability.round_end mirrors the gauge
     timer.gauge("device_mem_peak_mb", 96.0)
     timer.gauge("mfu", 0.41)
+    # serving-tier names (fedml_tpu/serve) are registered
+    timer.count("serve_shed")
+    timer.gauge("serve_p99_ms", 12.5)

@@ -11,3 +11,5 @@ def roll_up(timer):
     # them are the same dead-series bug class
     timer.gauge("device_mem_peak_bytes", 1.0)  # registry: *_mb
     timer.gauge("mfu_frac", 0.5)               # registry: "mfu"
+    # serving-tier near-miss: the registry knows "serve_shed"
+    timer.count("serve_sheds")

@@ -179,9 +179,13 @@ class TestRouterCore:
         def reader():
             try:
                 _recv(a)
-            except AssertionError:
+            # stop() may close the socket mid-recv as an RST instead of
+            # a clean FIN under load (ConnectionResetError) — either way
+            # the client IS unblocked, which is what this test asserts
+            except (AssertionError, OSError):
                 pass
-            done.set()
+            finally:
+                done.set()
 
         t = threading.Thread(target=reader, daemon=True)
         t.start()

@@ -1,0 +1,747 @@
+"""Federated serving tier (fedml_tpu/serve): endpoint/batcher/rollout
+units, the pure-observer parity gate, delta-vs-full rollout bit-parity,
+and the crash/catch-up chaos scenario."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.serve import (BatchCoalescer, PERSONAL_FIELD,
+                             RolloutManager, ServeClient, ShedError,
+                             bucket_for, bucket_ladder, build_serving)
+
+
+def _fixture(workers=3, dim=8, classes=3, n=96, seed=5):
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+    ds = make_blob_federated(client_num=workers, dim=dim,
+                             class_num=classes, n_samples=n, seed=seed)
+    return ds, LogisticRegression(num_classes=classes), TrainConfig(
+        epochs=1, batch_size=8, lr=0.1)
+
+
+def _init_model(module, ds, seed=0):
+    import jax.numpy as jnp
+    return jax.tree.map(np.asarray, module.init(
+        jax.random.key(seed), jnp.asarray(ds.train_data_global[0][:1]),
+        train=False))
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder / endpoint
+# ---------------------------------------------------------------------------
+class TestEndpoint:
+    def test_bucket_ladder(self):
+        assert bucket_ladder(8) == [1, 2, 4, 8]
+        assert bucket_ladder(1) == [1]
+        assert bucket_ladder(6) == [1, 2, 4, 6]
+        assert bucket_for(3, [1, 2, 4, 8]) == 4
+        assert bucket_for(8, [1, 2, 4, 8]) == 8
+        with pytest.raises(ValueError):
+            bucket_for(9, [1, 2, 4, 8])
+        with pytest.raises(ValueError):
+            bucket_ladder(0)
+
+    def test_install_predict_and_swap(self):
+        ds, module, _ = _fixture()
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4)
+        try:
+            ep = tier.endpoint
+            with pytest.raises(RuntimeError):
+                ep.predict(ds.test_data_global[0][:2])
+            m0 = _init_model(module, ds, seed=0)
+            ep.install(0, m0)
+            out0, r0 = ep.predict(ds.test_data_global[0][:3])
+            assert r0 == 0 and out0.shape[0] == 3
+            # swap: a different model must change the outputs and round
+            m1 = jax.tree.map(lambda a: a + 1.0, m0)
+            ms = ep.install(1, m1)
+            out1, r1 = ep.predict(ds.test_data_global[0][:3])
+            assert r1 == 1
+            assert ms < 1000.0  # transfer+flip, never a compile
+            assert not np.array_equal(out0, out1)
+            assert ep.swaps == 2 and len(ep.swap_ms_history) == 2
+            # oracle: padded bucket predict equals a direct apply
+            direct = np.asarray(module.apply(
+                m1, ds.test_data_global[0][:3], train=False))
+            np.testing.assert_allclose(out1, direct, rtol=1e-6)
+        finally:
+            tier.close()
+
+    def test_shape_guard(self):
+        ds, module, _ = _fixture(dim=8)
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4)
+        try:
+            tier.endpoint.install(0, _init_model(module, ds))
+            with pytest.raises(ValueError):
+                tier.endpoint.predict(np.zeros((2, 5), np.float32))
+        finally:
+            tier.close()
+
+
+# ---------------------------------------------------------------------------
+# batch coalescer
+# ---------------------------------------------------------------------------
+class TestBatcher:
+    def test_coalesces_concurrent_requests(self):
+        calls = []
+
+        def predict(x, variant=None):
+            calls.append(int(np.shape(x)[0]))
+            return np.asarray(x) * 2.0, 7
+
+        b = BatchCoalescer(predict, max_batch=8, linger_us=20000,
+                           queue_depth=64)
+        try:
+            results = {}
+
+            def one(i):
+                out, r = b.submit(np.full((1, 2), float(i), np.float32))
+                results[i] = (out, r)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(results) == 16
+            for i, (out, r) in results.items():
+                assert r == 7
+                np.testing.assert_array_equal(
+                    out, np.full((1, 2), 2.0 * i, np.float32))
+            # the linger window must have coalesced SOME batches
+            assert b.batches < 16
+            assert sum(calls) == 16
+        finally:
+            b.close()
+
+    def test_full_queue_sheds(self):
+        release = threading.Event()
+
+        def predict(x, variant=None):
+            release.wait(10)
+            return np.asarray(x), 0
+
+        b = BatchCoalescer(predict, max_batch=1, linger_us=0,
+                           queue_depth=1)
+        try:
+            x = np.zeros((1, 2), np.float32)
+            first = threading.Thread(
+                target=lambda: b.submit(x, timeout_s=15))
+            first.start()
+            time.sleep(0.2)  # worker now blocked inside predict
+            second = threading.Thread(
+                target=lambda: b.submit(x, timeout_s=15))
+            second.start()
+            time.sleep(0.2)  # queue slot occupied by the second request
+            with pytest.raises(ShedError):
+                b.submit(x)
+            assert b.shed >= 1
+            release.set()
+            first.join(timeout=10)
+            second.join(timeout=10)
+        finally:
+            release.set()
+            b.close()
+
+    def test_mixed_variants_never_share_a_batch_and_never_wedge(self):
+        """The review-pass regression: a different-variant request
+        popped mid-drain is CARRIED as the next batch's head — never
+        pushed back into the (possibly full) shared queue, which would
+        deadlock the lone consumer, and never re-queued at the tail
+        behind everyone else."""
+        seen = []
+
+        def predict(x, variant=None):
+            seen.append((variant, int(np.shape(x)[0])))
+            return np.asarray(x), 0
+
+        b = BatchCoalescer(predict, max_batch=4, linger_us=5000,
+                           queue_depth=2)  # tiny queue: the wedge case
+        try:
+            results = []
+
+            def one(i):
+                v = "a" if i % 2 == 0 else "b"
+                out, _ = b.submit(np.full((1, 2), float(i), np.float32),
+                                  variant=v, timeout_s=30)
+                results.append((i, v, float(out[0, 0])))
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert len(results) == 12  # nobody wedged or was dropped
+            for i, _, val in results:
+                assert val == float(i)
+            assert all(v in ("a", "b") for v, _ in seen)
+        finally:
+            b.close()
+
+    def test_dead_deadline_sheds(self):
+        release = threading.Event()
+
+        def predict(x, variant=None):
+            release.wait(10)
+            return np.asarray(x), 0
+
+        b = BatchCoalescer(predict, max_batch=4, linger_us=0,
+                           queue_depth=8)
+        try:
+            x = np.zeros((1, 2), np.float32)
+            t1 = threading.Thread(target=lambda: b.submit(x, timeout_s=15))
+            t1.start()
+            time.sleep(0.2)
+            err = {}
+
+            def late():
+                try:
+                    b.submit(x, deadline_s=0.05, timeout_s=15)
+                except Exception as exc:
+                    err["e"] = exc
+
+            t2 = threading.Thread(target=late)
+            t2.start()
+            time.sleep(0.3)  # the deadline dies while queued
+            release.set()
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+            assert isinstance(err.get("e"), ShedError)
+        finally:
+            release.set()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# rollout: delta vs full bit-parity, fallback, personalization, staleness
+# ---------------------------------------------------------------------------
+class _StubEndpoint:
+    def __init__(self, block=None):
+        self.installs = []
+        self._block = block
+        self._device_lock = threading.RLock()
+
+    def install(self, round_idx, variables, variant=None):
+        if self._block is not None:
+            self._block.wait(10)
+        self.installs.append((int(round_idx), variables, variant))
+        return 0.0
+
+
+class TestRollout:
+    def test_delta_rollout_bit_equals_full_rollout(self):
+        """The acceptance invariant: a rollout fed the compression
+        mirror's delta chain serves params BIT-EQUAL to one fed the
+        same rounds' full models (the chain's decoded values — exactly
+        what the silos hold), round for round."""
+        from fedml_tpu.comm.compression import (compress_for_policy,
+                                                decompress)
+        from fedml_tpu.comm.policy import resolve_compression
+        ds, module, _ = _fixture(dim=6, classes=3)
+        pol = resolve_compression("delta_int8")
+        full_ep, delta_ep = _StubEndpoint(), _StubEndpoint()
+        full_r = RolloutManager(full_ep)
+        delta_r = RolloutManager(delta_ep)
+        try:
+            mirror = None
+            model = _init_model(module, ds)
+            for r in range(4):
+                model = jax.tree.map(
+                    lambda a, _r=r: a + 0.1 * (_r + 1), model)
+                if mirror is None:
+                    payload = jax.tree.map(np.asarray, model)
+                    mirror = payload
+                else:
+                    key = jax.random.key(100 + r)
+                    payload, _ = compress_for_policy(model, mirror, None,
+                                                     key, pol)
+                    mirror = jax.tree.map(
+                        np.asarray, decompress(payload, mirror))
+                delta_r.publish(r, payload)
+                full_r.publish(r, mirror)
+            delta_r.drain()
+            full_r.drain()
+            assert len(delta_ep.installs) == len(full_ep.installs) == 4
+            for (rd, vd, _), (rf, vf, _) in zip(delta_ep.installs,
+                                                full_ep.installs):
+                assert rd == rf
+                assert _leaves_equal(vd, vf)
+            assert delta_r.delta_swaps == 3 and delta_r.full_swaps == 1
+        finally:
+            delta_r.close()
+            full_r.close()
+
+    def test_fingerprint_mismatch_falls_back_to_checkpoint(self, tmp_path):
+        from fedml_tpu.comm.compression import compress_for_policy
+        from fedml_tpu.comm.policy import resolve_compression
+        from fedml_tpu.control import ServerControlCheckpointer
+        from flax import serialization as fser
+        ds, module, _ = _fixture(dim=6)
+        m0 = _init_model(module, ds)
+        m1 = jax.tree.map(lambda a: a + 1.0, m0)
+        ckpt = ServerControlCheckpointer(str(tmp_path))
+        ckpt.save({"round_idx": 9,
+                   "global_model": fser.to_state_dict(
+                       jax.tree.map(np.asarray, m1))})
+        ep = _StubEndpoint()
+        ro = RolloutManager(ep, checkpointer=ckpt)
+        try:
+            ro.publish(0, jax.tree.map(np.asarray, m0))
+            ro.drain()
+            pol = resolve_compression("delta_int8")
+            payload, _ = compress_for_policy(m1, m0, None,
+                                             jax.random.key(0), pol)
+            payload["fp"] = "0000deadbeef0000"  # structure-skewed frame
+            ro.publish(1, payload)
+            ro.drain()
+            time.sleep(0.2)
+            assert ro.fallbacks == 1
+            # the endpoint got the BLOB's model at the BLOB's round —
+            # never the corrupt rebuild
+            rounds = [r for r, _, _ in ep.installs]
+            assert rounds == [0, 9]
+            assert _leaves_equal(ep.installs[-1][1],
+                                 fser.to_state_dict(m1))
+            # the chain is now VALUE-broken: even a structurally-valid
+            # delta must be refused (the blob is the global, not the
+            # sender's mirror) — another fallback, no delta decode
+            good, _ = compress_for_policy(
+                jax.tree.map(lambda a: a + 0.5, m1),
+                fser.to_state_dict(m1), None, jax.random.key(1), pol)
+            ro.publish(10, good)
+            ro.drain()
+            time.sleep(0.2)
+            assert ro.delta_swaps == 0 and ro.fallbacks == 2
+            # a LIVE full rebase re-licenses the delta path
+            m2 = jax.tree.map(lambda a: a + 2.0, m1)
+            ro.publish(11, jax.tree.map(np.asarray, m2))
+            ro.drain()
+            delta2, _ = compress_for_policy(
+                jax.tree.map(lambda a: a + 0.25, m2),
+                jax.tree.map(np.asarray, m2), None,
+                jax.random.key(2), pol)
+            ro.publish(12, delta2)
+            ro.drain()
+            time.sleep(0.2)
+            assert ro.delta_swaps == 1
+            assert [r for r, _, _ in ep.installs][-2:] == [11, 12]
+            # a checkpoint-fed full (rebase=False) on an INTACT chain
+            # breaks it: the blob is the exact global, not the mirror
+            # the next delta is encoded against — that delta must be
+            # refused, never decoded against the blob values
+            ro.publish(13, jax.tree.map(np.asarray, m2), rebase=False)
+            ro.drain()
+            delta3, _ = compress_for_policy(
+                jax.tree.map(lambda a: a + 0.1, m2),
+                jax.tree.map(np.asarray, m2), None,
+                jax.random.key(3), pol)
+            ro.publish(14, delta3)
+            ro.drain()
+            time.sleep(0.2)
+            assert ro.delta_swaps == 1 and ro.fallbacks == 3
+        finally:
+            ro.close()
+
+    def test_staleness_bound_flags(self):
+        block = threading.Event()
+        ep = _StubEndpoint(block=block)
+        ro = RolloutManager(ep, staleness_rounds=2)
+        try:
+            ro.publish(0, {"params": np.zeros(3, np.float32)})
+            time.sleep(0.2)
+            block.set()
+            ro.drain()
+            time.sleep(0.2)
+            assert ro.staleness() == 0 and not ro.stale()
+            block.clear()
+            for r in (1, 2, 3, 4):  # swaps blocked: trained runs ahead
+                ro.publish(r, {"params": np.zeros(3, np.float32)})
+            assert ro.staleness() == 4
+            assert ro.stale()
+            block.set()
+            ro.drain()
+            time.sleep(0.3)
+            assert ro.staleness() == 0 and not ro.stale()
+        finally:
+            block.set()
+            ro.close()
+
+    def test_personalized_variants_from_state_store(self):
+        from fedml_tpu.state.store import ClientStateStore
+        ds, module, _ = _fixture(dim=6, classes=3)
+        store = ClientStateStore(None)
+        store.register_field(PERSONAL_FIELD, persist=False)
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4,
+                             store=store)
+        try:
+            m0 = _init_model(module, ds)
+            tier.rollout.publish(3, m0)
+            tier.rollout.drain()
+            time.sleep(0.2)
+            d = sum(int(np.prod(np.shape(l)))
+                    for l in jax.tree.leaves(m0))
+            rng = np.random.RandomState(1)
+            delta = rng.normal(size=d).astype(np.float32)
+            store.put(PERSONAL_FIELD, 0, delta)
+            assert tier.rollout.refresh_personalized() == 1
+            assert tier.endpoint.variants() == ["0"]
+            x = ds.test_data_global[0][:2]
+            out_v, r_v = tier.endpoint.predict(x, variant="0")
+            out_g, _ = tier.endpoint.predict(x)
+            assert r_v == 3
+            assert not np.array_equal(out_v, out_g)
+            # oracle: variant == apply(global + delta)
+            from fedml_tpu.serve.rollout import _apply_flat_delta
+            direct = np.asarray(module.apply(
+                _apply_flat_delta(m0, delta), x, train=False))
+            np.testing.assert_allclose(out_v, direct, rtol=1e-6)
+            # unknown variant falls back to the global model
+            out_u, _ = tier.endpoint.predict(x, variant="nope")
+            np.testing.assert_array_equal(out_u, out_g)
+        finally:
+            tier.close()
+
+
+# ---------------------------------------------------------------------------
+# TCP front
+# ---------------------------------------------------------------------------
+class TestTcpFront:
+    def test_predict_stats_and_errors_over_tcp(self):
+        ds, module, _ = _fixture()
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4,
+                             port=0)
+        try:
+            tier.rollout.publish(2, _init_model(module, ds))
+            tier.rollout.drain()
+            time.sleep(0.2)
+            client = ServeClient(port=tier.port)
+            rep = client.predict(ds.test_data_global[0][:2])
+            assert rep["status"] == "ok"
+            assert rep["round"] == 2 and rep["stale"] is False
+            assert len(rep["outputs"]) == 2 and len(rep["pred"]) == 2
+            stats = client.stats()
+            assert stats["status"] == "ok"
+            assert stats["requests"] >= 1 and stats["served_round"] == 2
+            assert client.request({"op": "nope"})["status"] == "error"
+            # malformed frame: server answers an error and keeps serving
+            from fedml_tpu.comm.tcp import recv_frame, send_frame
+            send_frame(client._sock, b"\x00not json")
+            bad = json.loads(bytes(recv_frame(client._sock)).decode())
+            assert bad["status"] == "error"
+            assert client.predict(
+                ds.test_data_global[0][:1])["status"] == "ok"
+            client.close()
+        finally:
+            tier.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: pure observer, checkpoint feed, chaos
+# ---------------------------------------------------------------------------
+class TestServingE2E:
+    def _run(self, ds, module, tcfg, *, rounds, tier=None, ckpt=None,
+             compression=None):
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        return run_fedavg_cross_silo(
+            ds, module, worker_num=ds.client_num, comm_round=rounds,
+            train_cfg=tcfg, seed=11, serving=tier,
+            server_checkpoint_dir=ckpt, compression=compression)
+
+    def test_serving_is_a_pure_observer(self):
+        """The acceptance gate: serving ON must not move training by a
+        single bit — identical history AND final model vs OFF."""
+        ds, module, tcfg = _fixture(workers=2, n=64)
+        model_off, hist_off = self._run(ds, module, tcfg, rounds=3)
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4,
+                             port=0)
+        try:
+            stop = threading.Event()
+
+            def pump():
+                while tier.rollout.served_round < 0 \
+                        and not stop.is_set():
+                    time.sleep(0.01)
+                client = ServeClient(port=tier.port)
+                while not stop.is_set():
+                    client.predict(ds.test_data_global[0][:2])
+                client.close()
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            model_on, hist_on = self._run(ds, module, tcfg, rounds=3,
+                                          tier=tier)
+            stop.set()
+            t.join(timeout=10)
+        finally:
+            tier.close()
+        assert hist_on == hist_off
+        assert _leaves_equal(model_on, model_off)
+        assert tier.endpoint.swaps >= 1
+        assert tier.batcher.requests >= 1
+
+    def test_endpoint_serves_final_checkpoint_model(self, tmp_path):
+        """Full-checkpoint feed: after the run, the served base equals
+        the newest ServerControlCheckpointer blob's global model
+        bit-for-bit (policy none: blob == broadcast == served)."""
+        from flax import serialization as fser
+        from fedml_tpu.control import ServerControlCheckpointer
+        ds, module, tcfg = _fixture(workers=2, n=64)
+        ckpt_dir = str(tmp_path / "ctrl")
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4,
+                             checkpoint_dir=ckpt_dir)
+        try:
+            model, _ = self._run(ds, module, tcfg, rounds=3, tier=tier,
+                                 ckpt=ckpt_dir)
+            tier.rollout.drain()
+            snap = ServerControlCheckpointer(ckpt_dir).load_latest()
+            assert snap is not None
+            assert _leaves_equal(tier.rollout._base,
+                                 snap["global_model"])
+            assert _leaves_equal(tier.rollout._base,
+                                 fser.to_state_dict(
+                                     jax.tree.map(np.asarray, model)))
+        finally:
+            tier.close()
+
+    def test_compressed_downlink_feeds_delta_rollout(self, tmp_path):
+        """With downlink compression on, the live publishes are mirror
+        DELTAS; the rollout's decoded chain must land on the same final
+        model as the federation's own (the last publish is full, so the
+        end state pins the whole chain decoded without a fallback)."""
+        ds, module, tcfg = _fixture(workers=2, dim=16, n=64)
+        ckpt_dir = str(tmp_path / "ctrl")
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4,
+                             checkpoint_dir=ckpt_dir)
+        try:
+            model, _ = self._run(ds, module, tcfg, rounds=4, tier=tier,
+                                 ckpt=ckpt_dir, compression="delta_int8")
+            tier.rollout.drain()
+            assert tier.rollout.delta_swaps >= 1, \
+                "downlink compression never fed the rollout a delta"
+            assert tier.rollout.fallbacks == 0
+            assert _leaves_equal(
+                tier.rollout._base, jax.tree.map(np.asarray, model))
+        finally:
+            tier.close()
+
+    def test_crash_keeps_serving_then_catches_up(self, tmp_path):
+        """The chaos scenario: the training server dies cold
+        mid-schedule (the simulated-SIGKILL crash class the failover
+        harness uses); the checkpoint-fed endpoint keeps answering with
+        its last good round inside the staleness bound, then catches up
+        once a restarted server finishes the schedule."""
+        import queue as _queue
+
+        from fedml_tpu.comm.inproc import InProcRouter
+        from fedml_tpu.control import failover_harness as fh
+        rounds, workers, crash_at = 6, 2, 3
+        ckpt_dir = str(tmp_path / "ctrl")
+        ds, module, _ = fh.build_fixture(workers)
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4,
+                             checkpoint_dir=ckpt_dir, port=0,
+                             staleness_rounds=rounds)
+        watch_stop = tier.rollout.watch_checkpoints(poll_s=0.05)
+        router = InProcRouter()
+        clients, client_threads = fh.start_silos("INPROC", workers,
+                                                 router=router)
+        try:
+            com1 = fh._make_com("INPROC", 0, workers + 1, router=router)
+            s1 = fh._build_server(
+                com1, workers, rounds, ckpt_dir,
+                server_cls=fh.make_crashing_server_cls(crash_at),
+                deadline_s=None, min_quorum_frac=0.5, pace=False,
+                join_rate_limit=0.0, max_deadline_extensions=25)
+            t1 = threading.Thread(target=s1.run, daemon=True)
+            t1.start()
+            s1.send_init_msg()
+            t1.join(timeout=180)
+            assert not t1.is_alive() and type(s1).crashed
+            # the trainer is DEAD; the endpoint must still answer from
+            # its last good round (the newest blob: crash_at)
+            deadline = time.time() + 30
+            while tier.rollout.served_round < crash_at \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            client = ServeClient(port=tier.port)
+            rep = client.predict(ds.test_data_global[0][:2])
+            assert rep["status"] == "ok"
+            assert rep["round"] == crash_at
+            assert rep["staleness"] <= rounds and rep["stale"] is False
+            client.close()
+            # restart: a fresh server restores and finishes; the
+            # endpoint catches up to the final round
+            q = router.mailbox(0)
+            while True:
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+            com2 = fh._make_com("INPROC", 0, workers + 1, router=router)
+            s2 = fh._build_server(com2, workers, rounds, ckpt_dir,
+                                  deadline_s=None, min_quorum_frac=0.5,
+                                  pace=False, join_rate_limit=0.0,
+                                  max_deadline_extensions=25)
+            t2 = threading.Thread(target=s2.run, daemon=True)
+            t2.start()
+            s2.send_init_msg()
+            t2.join(timeout=180)
+            assert not t2.is_alive() and s2.round_idx >= rounds
+            deadline = time.time() + 30
+            while tier.rollout.served_round < rounds \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            client = ServeClient(port=tier.port)
+            rep = client.predict(ds.test_data_global[0][:2])
+            assert rep["status"] == "ok" and rep["round"] >= rounds
+            client.close()
+        finally:
+            watch_stop.set()
+            tier.close()
+            for t in client_threads:
+                t.join(timeout=30)
+
+
+@pytest.mark.slow
+class TestServingSigkillChaos:
+    def test_real_sigkill_endpoint_keeps_serving(self, tmp_path):
+        """REAL SIGKILL of the training server subprocess mid-schedule
+        (the failover harness's TCP scenario) with a checkpoint-fed
+        endpoint watching in the parent: a sampler thread predicts
+        through the whole kill+restart window — every reply must
+        succeed, served rounds must be monotone, and the endpoint must
+        end on the full schedule's final round."""
+        from fedml_tpu.control import failover_harness as fh
+        rounds, workers = 6, 2
+        ckpt_dir = str(tmp_path / "ctrl")
+        ds, module, _ = fh.build_fixture(workers)
+        tier = build_serving(module, "classification",
+                             ds.train_data_global[0][:1], max_batch=4,
+                             checkpoint_dir=ckpt_dir, port=0,
+                             staleness_rounds=rounds)
+        watch_stop = tier.rollout.watch_checkpoints(poll_s=0.05)
+        samples, stop = [], threading.Event()
+
+        def sampler():
+            while tier.rollout.served_round < 0 and not stop.is_set():
+                time.sleep(0.05)
+            client = ServeClient(port=tier.port)
+            while not stop.is_set():
+                rep = client.predict(ds.test_data_global[0][:1])
+                samples.append((rep["status"], rep.get("round")))
+                time.sleep(0.05)
+            client.close()
+
+        t = threading.Thread(target=sampler, daemon=True)
+        t.start()
+        try:
+            res = fh.run_failover_scenario(
+                ckpt_dir, rounds=rounds, workers=workers,
+                kill_after_round=2, port_base=40310, deadline_s=2.0)
+            assert res["killed_at_round"] == 2
+            assert res["summary"].get("done") is True
+            deadline = time.time() + 30
+            while tier.rollout.served_round < rounds \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            watch_stop.set()
+            tier.close()
+        assert samples, "sampler never saw a served model"
+        assert all(s == "ok" for s, _ in samples), \
+            "a request failed across the SIGKILL window"
+        rounds_seen = [r for _, r in samples]
+        assert rounds_seen == sorted(rounds_seen), \
+            "served rounds went backwards across the failover"
+        assert tier.rollout.served_round >= rounds
+
+
+# ---------------------------------------------------------------------------
+# obs fold + report serving section
+# ---------------------------------------------------------------------------
+class TestServingObs:
+    def test_fold_and_report_serving_section(self, tmp_path):
+        from fedml_tpu.obs import build_observability, merge_flight_logs
+        from fedml_tpu.obs.report import summarize, to_markdown
+        obs_dir = str(tmp_path / "obs")
+        obs = build_observability(obs_dir, job_id="sj", rank=0,
+                                  role="server")
+        obs.recorder.append({"kind": "serve", "event": "swap",
+                             "round": 0, "variant": None,
+                             "swap_ms": 2.0})
+        obs.recorder.append({"kind": "serve", "event": "swap",
+                             "round": 1, "variant": None,
+                             "swap_ms": 4.0})
+        obs.recorder.append({"kind": "serve", "event": "slo", "round": 1,
+                             "requests": 40, "batches": 9, "shed": 1,
+                             "latency_p50_ms": 3.0,
+                             "latency_p99_ms": 11.0,
+                             "served_round": 1, "staleness": 1})
+        obs.close()
+        merged = merge_flight_logs([obs_dir])
+        assert [len(r["serve"]) for r in merged["rounds"]] == [1, 2]
+        rep = summarize([obs_dir])["jobs"]["sj"]
+        sv = rep["serving"]
+        assert sv["requests"] == 40 and sv["shed"] == 1
+        assert sv["latency_p50_ms"] == 3.0
+        assert sv["latency_p99_ms"] == 11.0
+        assert sv["swaps"] == 2
+        assert sv["swap_ms"]["max"] == 4.0
+        assert sv["served_round"] == 1
+        assert sv["staleness"]["max"] == 1
+        md = to_markdown({"jobs": {"sj": rep}})
+        assert "serving requests" in md and "serving latency" in md
+
+    def test_e2e_obs_report_carries_serving(self, tmp_path):
+        """A real serving run's flight log folds into the report's
+        serving section (live tail and offline report share
+        fold_records, so this pins both)."""
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        from fedml_tpu.obs.report import summarize
+        ds, module, tcfg = _fixture(workers=2, n=64)
+        obs_dir = str(tmp_path / "obs")
+        run_fedavg_cross_silo(ds, module, worker_num=2, comm_round=3,
+                              train_cfg=tcfg, seed=11, obs_dir=obs_dir,
+                              job_id="served", serve_port=0)
+        rep = summarize([obs_dir])["jobs"]["served"]
+        assert rep["serving"] is not None
+        assert rep["serving"]["swaps"] >= 1
+        assert rep["serving"]["served_round"] is not None
+
+
+class TestSchedulerServing:
+    def test_jobspec_serve_port_roundtrips(self):
+        from fedml_tpu.sched.jobs import spec_from_dict
+        spec = spec_from_dict({"id": "t", "serve_port": 0,
+                               "serve_staleness_rounds": 3})
+        assert spec.serve_port == 0
+        assert spec.serve_staleness_rounds == 3
+        assert spec.to_json()["serve_port"] == 0
