@@ -33,6 +33,13 @@ JAX_PLATFORMS=cpu python -m fedml_tpu.obs merge runs/obs_smoke/flight \
 # `obs report` renders one per-tenant summary from the shared obs dir
 rm -rf runs/sched_smoke
 JAX_PLATFORMS=cpu python -m fedml_tpu.sched smoke --root runs/sched_smoke
+# WAN churn smoke (fedml_tpu/wan, ~20 s): a small federation over TCP
+# through a diurnal trough + flap burst — exits non-zero unless the
+# FULL schedule completed (churn degrades, never stalls), >= 1 silo was
+# deadline-evicted AND >= 1 rejoined through the trace-gated JOIN path,
+# every sampled cohort member was trace-available, and re-running the
+# same trace seed produced a bit-identical round/cohort ledger
+JAX_PLATFORMS=cpu python -m fedml_tpu.wan --smoke
 # federated-serving smoke (fedml_tpu/serve, ~10 s): train a small
 # federation WITH the TCP/JSON inference endpoint attached, drive 50
 # closed-loop requests, and exit non-zero unless at least one hot swap

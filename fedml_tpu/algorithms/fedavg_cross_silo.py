@@ -155,6 +155,13 @@ class FedAvgAggregator:
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded = [False] * worker_num
         self._aggregate = jax.jit(aggregate_fn or pt.tree_weighted_mean)
+        #: optional cohort-draw override (``fedml_tpu/wan``: the WAN
+        #: world's availability-restricted sampler). None (default) =
+        #: the reference seeded stream, byte-identical legacy behavior.
+        #: Any override MUST stay a pure function of its arguments —
+        #: the silos' prefetch prediction and the failover replay both
+        #: re-derive cohorts from the round index alone.
+        self.sampler = None
 
     def add_local_trained_result(self, worker_idx: int, model_params,
                                  sample_num: float) -> None:
@@ -197,6 +204,9 @@ class FedAvgAggregator:
 
     def client_sampling(self, round_idx: int, client_num_in_total: int,
                         client_num_per_round: int) -> np.ndarray:
+        if self.sampler is not None:
+            return self.sampler(round_idx, client_num_in_total,
+                                client_num_per_round)
         return sample_clients(round_idx, client_num_in_total,
                               client_num_per_round)
 
@@ -227,7 +237,7 @@ class FedAvgServerManager(ServerManager):
                  min_quorum_frac: float = 0.5,
                  server_ckpt=None, pace=None, join_admission=None,
                  max_deadline_extensions: Optional[int] = 25,
-                 device_gate=None):
+                 device_gate=None, wan=None):
         super().__init__(rank, size, com_manager)
         #: the mutex every device-touching section holds. Default: the
         #: process-wide _DEVICE_LOCK (single-tenant, byte-identical
@@ -273,6 +283,28 @@ class FedAvgServerManager(ServerManager):
         self._pace = pace
         #: JOIN token bucket (None = admit every JOIN, legacy behavior)
         self._join_admission = join_admission
+        # -- WAN world model (fedml_tpu/wan/) -------------------------------
+        #: population dynamics driving this schedule (None = off, the
+        #: byte-identical legacy path): availability-restricted cohort
+        #: sampling, the trace-gated rejoin path, and per-round churn
+        #: telemetry. Deliberately NOT in the checkpoint manifest — the
+        #: world is a pure function of (seed, round), so a restored
+        #: server rebuilds the identical dynamics from its flags.
+        self._wan = wan
+        if wan is not None:
+            self.aggregator.sampler = wan.sample_cohort
+        #: worker -> (round, deferral count): the WAN rejoin gate's
+        #: anti-starvation ledger (transient telemetry, deliberately not
+        #: checkpointed — a restored server resets the counts and the
+        #: valve re-arms; see WanWorld.max_join_deferrals_per_round)
+        self._wan_join_deferrals: Dict[int, tuple] = {}
+        #: workers whose JOIN was WAN-deferred, awaiting their device's
+        #: trace to flip online: admitted in a batch at the next round
+        #: boundary (:meth:`_wan_admit_pending`) so the rejoin ROUND is
+        #: a pure function of the trace, not of the race between the
+        #: JOIN retry cadence and the other silos' replies. Transient —
+        #: a restored server loses it and the silos' retries rebuild it.
+        self._wan_pending_joins: set = set()
         #: below-quorum deadline-extension budget per round (None =
         #: the pre-control-plane forever-extend behavior)
         self._max_extensions = max_deadline_extensions
@@ -767,9 +799,19 @@ class FedAvgServerManager(ServerManager):
             if digest is not None:
                 obs_row["digest"] = digest
         if self._bcast_at is not None:
-            # the report-latency distribution pace steering feeds on
             latency = time.monotonic() - self._bcast_at
-            self.liveness.observe_report_latency(worker, latency)
+            if self._resynced_round.get(worker) == self.round_idx:
+                # churn-poisoning guard: a rejoin-resync reply's
+                # broadcast->reply latency measures the OUTAGE plus the
+                # resync detour, not the silo's report pace — a flap
+                # burst's worth of them would inflate the steered
+                # deadline (p90 x margin) for a full quantile-window
+                # width. Excluded from the steering evidence, counted;
+                # the flight row below still records the raw latency.
+                self.cp_counters["resync_latency_skips"] += 1
+            else:
+                # the report-latency distribution pace steering feeds on
+                self.liveness.observe_report_latency(worker, latency)
             if obs_row is not None:
                 obs_row["report_latency_s"] = round(latency, 6)
         if obs_row is not None:
@@ -860,15 +902,43 @@ class FedAvgServerManager(ServerManager):
         # wire_bytes_per_sec from exactly this).
         self._credit_wire_bytes()
         tm = getattr(self, "round_timer", None)
+        # availability extras ride the flight record only (never the
+        # ledger): rejoin/throttle trajectories and the deadline this
+        # round actually ran under feed the `obs report` availability
+        # section; wan_* adds the population-scale churn estimates
+        extra = {
+            "cohort": self._round_cohort,
+            "reported": [int(w) for w in reported],
+            "live": sorted(int(w)
+                           for w in self.liveness.live_workers()),
+            "partial": bool(partial),
+            "evictions": int(self.liveness.evictions),
+            "rejoins": int(self.liveness.rejoins),
+            "joins_throttled": int(self.cp_counters["joins_throttled"]),
+            "deadline_s": (float(self.round_deadline_s)
+                           if self.round_deadline_s else None),
+        }
+        if self._wan is not None and tm is not None:
+            # drain the world's sampling counters into THIS round's
+            # delta, then fold the population-scale churn estimate
+            # (mass JOIN wave vs the shadow admission bucket — all
+            # deterministic functions of (trace seed, round))
+            for k, v in self._wan.drain_counters().items():
+                tm.count(k, v)
+            joins, leaves, throttled = self._wan.mass_churn(self.round_idx)
+            if joins:
+                tm.count("wan_mass_joins", joins)
+            if leaves:
+                tm.count("wan_mass_leaves", leaves)
+            if throttled:
+                tm.count("wan_mass_join_throttled", throttled)
+            frac = self._wan.available_frac(self.round_idx)
+            if frac is not None:
+                tm.gauge("wan_available_frac", frac)
+                extra["wan_available_frac"] = round(frac, 4)
         round_rec = None
         if tm is not None:
-            round_rec = tm.end_round(self.round_idx, extra={
-                "cohort": self._round_cohort,
-                "reported": [int(w) for w in reported],
-                "live": sorted(int(w)
-                               for w in self.liveness.live_workers()),
-                "partial": bool(partial),
-                "evictions": int(self.liveness.evictions)})
+            round_rec = tm.end_round(self.round_idx, extra=extra)
         if self.obs is not None:
             # the record pass feeds the perf accountant (obs/perf.py):
             # the server derives wire bytes/s + memory watermarks per
@@ -924,10 +994,40 @@ class FedAvgServerManager(ServerManager):
         if self.round_idx == self.comm_round:
             self._finish_federation()
             return
+        self._wan_admit_pending()
         idxs = self.aggregator.client_sampling(
             self.round_idx, self.client_num_in_total, self.worker_num)
         self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL, idxs)
         self._arm_deadline()
+
+    def _wan_admit_pending(self) -> None:
+        """Round-boundary rejoin batching (WAN mode): silos whose JOIN
+        was deferred while their device's trace was offline are
+        re-admitted at the first round boundary where the trace flips
+        online — so the rejoin ROUND is a pure function of the trace
+        seed (the ledger-replay property), not of the race between the
+        JOIN retry cadence and the other silos' replies. The admitted
+        silo rides the regular next broadcast; its reported base is
+        poisoned so that broadcast falls back to FULL precision — the
+        same one-full-frame-per-rejoin coherence rule the direct JOIN
+        resync path uses."""
+        if self._wan is None or not self._wan_pending_joins:
+            return
+        for worker in sorted(self._wan_pending_joins):
+            if not self._wan.silo_online(worker + 1, self.round_idx):
+                continue
+            # ft: allow[FT009] transient WAN rejoin bookkeeping (see _wan_pending_joins)
+            self._wan_pending_joins.discard(worker)
+            self.liveness.admit(worker)
+            self._worker_base[worker] = (-3, "wan-rejoin")
+            # the first reply after an outage measures the outage, not
+            # the silo's pace — same steering exclusion as a resync
+            # ft: allow[FT008] keyed by SILO index (worker_num entries, tens) — the per-silo resync ledger, not per-client state
+            self._resynced_round[worker] = self.round_idx
+            logging.info(
+                "silo %d re-admitted at round %d (WAN trace back online; "
+                "deferred JOIN batch) — next broadcast full-rebases it",
+                worker + 1, self.round_idx)
 
     # -- fault-tolerance handlers (deadline / heartbeat / rejoin) -----------
     def handle_round_timeout(self, msg: Message) -> None:
@@ -943,6 +1043,26 @@ class FedAvgServerManager(ServerManager):
             return
         live = self.liveness.live_workers()
         reported = set(self.aggregator.model_dict)
+        if self._wan is not None:
+            # the trace IS the availability oracle: a live silo whose
+            # device is offline at this round can never report, so it
+            # must not sit in the quorum DENOMINATOR — a diurnal cliff
+            # under a steered-up quorum would otherwise extend straight
+            # into the stall cap (observed: 3 of 4 silos drop at the
+            # trough while steering holds quorum at p25 of the healthy
+            # past). Evict the known-dark non-reporters now; they
+            # rejoin through the trace-gated JOIN path like any other
+            # eviction. The WAN layer degrades schedules, it never
+            # deadlocks them.
+            for w in sorted(live - reported):
+                if not self._wan.silo_online(w + 1, self.round_idx) \
+                        and self.liveness.evict(w):
+                    self._worker_base.pop(w, None)
+                    logging.warning(
+                        "silo %d is trace-offline at the round-%d "
+                        "deadline — evicted from the quorum denominator "
+                        "(WAN availability oracle)", w + 1, self.round_idx)
+            live = self.liveness.live_workers()
         need = max(1, math.ceil(self.min_quorum_frac * max(1, len(live))))
         if self._pace is not None and len(live) > 1:
             # steering's no-deadlock invariant lives HERE, not in the
@@ -1037,26 +1157,76 @@ class FedAvgServerManager(ServerManager):
             # out the deadline with us — it is not lost, so no resync
             # (which would only trigger a redundant retrain)
             return
+        # WAN rejoin gate (fedml_tpu/wan): the silo's device is still
+        # offline in the availability trace — its JOIN is real protocol
+        # traffic, but the DEVICE it speaks for has not come back yet.
+        # Checked before admission so a deferred JOIN never burns a
+        # token. Anchoring rejoin to the trace (instead of to wall-clock
+        # luck) is also what makes a churn run's ledger replayable.
+        wan_offline = (self._wan is not None
+                       and not self._wan.silo_online(worker + 1,
+                                                     self.round_idx))
+        if wan_offline:
+            # remember the request: the round-boundary batch admit
+            # (_wan_admit_pending) re-admits this silo at the FIRST
+            # round its device's trace is online again — deterministic
+            # rejoin rounds, the ledger-replay property
+            # ft: allow[FT009] transient WAN rejoin bookkeeping — a restored server loses it and the silos' JOIN retries rebuild it; not schedule state
+            self._wan_pending_joins.add(worker)
+            # anti-starvation valve: the virtual clock advances only at
+            # round closes — if every live silo went dark, the round
+            # extends forever at a frozen trace and every JOIN would be
+            # deferred forever. Cap the deferrals-per-round and admit
+            # past the cap: the WAN layer degrades schedules, it never
+            # deadlocks them.
+            r, n = self._wan_join_deferrals.get(worker, (-1, 0))
+            n = n + 1 if r == self.round_idx else 1
+            # ft: allow[FT009] transient WAN anti-starvation counter — resets harmlessly on failover (the valve re-arms), so it stays out of the snapshot manifest by design
+            self._wan_join_deferrals[worker] = (self.round_idx, n)
+            if n > self._wan.max_join_deferrals_per_round:
+                logging.warning(
+                    "silo %d JOIN deferred %d times inside round %d with "
+                    "the trace frozen — admitting anyway (WAN "
+                    "anti-starvation valve)", worker + 1, n - 1,
+                    self.round_idx)
+                # the force must reach the silo's OWN agent too (shared
+                # world): a server-side admit alone would resync a silo
+                # whose agent still drops every broadcast against the
+                # frozen trace — the stall would persist
+                self._wan.force_online(worker + 1)
+                # ft: allow[FT009] transient WAN rejoin bookkeeping (see above)
+                self._wan_pending_joins.discard(worker)
+                wan_offline = False
         # admission control: a mass rejoin after a partition heals must
         # not stampede the full-precision resync path — throttled JOINs
         # get a BACKPRESSURE reply and the silo defers its next attempt
         # (its heartbeats keep beating the liveness table meanwhile)
-        if self._join_admission is not None \
-                and not self._join_admission.try_acquire():
-            self.cp_counters["joins_throttled"] += 1
+        if wan_offline or (self._join_admission is not None
+                           and not self._join_admission.try_acquire()):
+            if wan_offline:
+                tm = getattr(self, "round_timer", None)
+                if tm is not None:
+                    tm.count("wan_join_deferred")
+                retry = float(self._wan.join_retry_s)
+            else:
+                self.cp_counters["joins_throttled"] += 1
+                retry = float(self._join_admission.retry_after_s())
             out = Message(MSG_TYPE_S2C_JOIN_BACKPRESSURE, self.rank,
                           worker + 1)
-            out.add(MSG_ARG_KEY_RETRY_AFTER,
-                    float(self._join_admission.retry_after_s()))
+            out.add(MSG_ARG_KEY_RETRY_AFTER, retry)
             try:
                 self.send_message(out)
             except OSError as exc:
                 logging.debug("backpressure reply to silo %d failed: %r",
                               worker + 1, exc)
-            logging.info("silo %d JOIN throttled (admission token bucket "
-                         "empty) — backpressure sent", worker + 1)
+            logging.info("silo %d JOIN %s — backpressure sent", worker + 1,
+                         "deferred (device offline in the WAN trace)"
+                         if wan_offline
+                         else "throttled (admission token bucket empty)")
             return
         self.liveness.admit(worker)
+        # ft: allow[FT009] transient WAN rejoin bookkeeping (see _wan_pending_joins)
+        self._wan_pending_joins.discard(worker)
         self._worker_base.pop(worker, None)
         if not self._evict_on_deadline:
             # strict-barrier server: JOIN is proof of life only (a resync
@@ -1077,8 +1247,16 @@ class FedAvgServerManager(ServerManager):
         else:
             with self._device_lock:  # D2H transfer is a device dispatch
                 payload = _to_numpy(self.global_model)
-        idxs = self.aggregator.client_sampling(
-            self.round_idx, self.client_num_in_total, self.worker_num)
+        if self._wan is not None:
+            # a REDRAW of this round's already-counted cohort — same
+            # pure draw, telemetry-silent (the broadcast's draw owns the
+            # per-round sampling counters; see sample_cohort(record=))
+            idxs = self._wan.sample_cohort(
+                self.round_idx, self.client_num_in_total,
+                self.worker_num, record=False)
+        else:
+            idxs = self.aggregator.client_sampling(
+                self.round_idx, self.client_num_in_total, self.worker_num)
         out = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, worker + 1)
         out.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
         out.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker]))
@@ -1170,9 +1348,16 @@ class FedAvgClientManager(ClientManager):
                  heartbeat_s: float = 0.0,
                  rejoin_idle_s: Optional[float] = None,
                  join_on_start: bool = False,
-                 obs=None, device_gate=None):
+                 obs=None, device_gate=None, wan_agent=None):
         super().__init__(rank, size, com_manager)
         self.dataset = dataset
+        #: WAN world agent (fedml_tpu/wan): when set, this silo embodies
+        #: a churning, heterogeneous device — trace-offline rounds drop
+        #: the reply and silence heartbeats (the server deadline-evicts
+        #: us through the real path), online rounds sleep the embodied
+        #: client's profiled report delay before replying. None
+        #: (default) = the byte-identical legacy silo.
+        self._wan_agent = wan_agent
         #: device mutex (see FedAvgServerManager): the process-wide
         #: _DEVICE_LOCK by default, a per-job fair-share gate under the
         #: federation scheduler
@@ -1267,9 +1452,18 @@ class FedAvgClientManager(ClientManager):
 
     def _predict_next(self, key):
         """Successor key: next round's sampled client for this silo under
-        the server's deterministic stream (FedAVGAggregator.py:89-97)."""
+        the server's deterministic stream (FedAVGAggregator.py:89-97).
+        Under a WAN world the server samples availability-restricted
+        cohorts instead — the SAME pure function of the round index, so
+        speculation stays exact (telemetry-silent: the server owns the
+        sampling counters)."""
         r = key[0] + 1
-        idxs = sample_clients(r, self.dataset.client_num, self.size - 1)
+        if self._wan_agent is not None:
+            idxs = self._wan_agent.world.sample_cohort(
+                r, self.dataset.client_num, self.size - 1, record=False)
+        else:
+            idxs = sample_clients(r, self.dataset.client_num,
+                                  self.size - 1)
         if self.rank - 1 >= len(idxs):
             return (r, None)
         return (r, int(idxs[self.rank - 1]))
@@ -1361,6 +1555,12 @@ class FedAvgClientManager(ClientManager):
         been silent past ``rejoin_idle_s`` (we were evicted, or the
         server restarted and forgot us)."""
         while not self._hb_stop.wait(self.heartbeat_s):
+            if self._wan_agent is not None \
+                    and not self._wan_agent.online_now():
+                # the embodied device is dark: no beats (the server's
+                # deadline eviction is the real removal path), no JOIN
+                # escalation (rejoin waits for the trace to flip back)
+                continue
             with self._hb_lock:  # snapshot the receive-thread flags
                 idle = time.monotonic() - self._last_s2c
                 busy = self._busy
@@ -1449,10 +1649,34 @@ class FedAvgClientManager(ClientManager):
                 self._busy = False
                 self._last_s2c = time.monotonic()
 
+    def _wan_payload_bytes(self) -> float:
+        """Rough model frame size for the WAN bandwidth model: the held
+        model's f32 bytes (0 before the first broadcast lands)."""
+        if self._held is None:
+            return 0.0
+        return 4.0 * sum(int(np.prod(np.shape(leaf)))
+                         for leaf in jax.tree.leaves(self._held))
+
     def _train_and_reply(self, msg: Message) -> None:
         t0 = time.perf_counter()
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = msg.get(MSG_ARG_KEY_ROUND)
+        wan_delay = 0.0
+        if self._wan_agent is not None:
+            # decided BEFORE the broadcast applies: an offline device
+            # never received the frame, so its held model goes stale and
+            # the server's next broadcast to it full-rebases (the same
+            # coherence rule every other loss path uses)
+            nbytes = self._wan_payload_bytes()
+            drop, wan_delay = self._wan_agent.on_round(
+                round_idx, int(client_idx), up_bytes=nbytes,
+                down_bytes=nbytes)
+            if drop:
+                logging.info(
+                    "silo %d: device offline in the WAN trace at round "
+                    "%s — dropping the broadcast (no training, no "
+                    "reply)", self.rank, round_idx)
+                return
         variables = self._apply_broadcast(msg)
         packed = None
         if self._prefetch is not None:
@@ -1527,6 +1751,13 @@ class FedAvgClientManager(ClientManager):
                 {"kind": "round", "round": int(round_idx),
                  "client_idx": int(client_idx),
                  "train_s": round(time.perf_counter() - t0, 6)})
+        if wan_delay > 0:
+            # injected WAN report latency (the embodied client's compute
+            # + bandwidth profile) — outside the device lock, on this
+            # silo's own receive thread: a straggler straggles alone.
+            # The _busy flag is still up (handle_message_init), so the
+            # heartbeat thread cannot mistake the sleep for an eviction.
+            time.sleep(wan_delay)
         try:
             self.send_message(reply)
         except OSError as exc:
@@ -1575,7 +1806,11 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           device_gate=None,
                           serve_port: Optional[int] = None,
                           serve_staleness_rounds: int = 2,
-                          serving=None):
+                          serving=None,
+                          wan_trace=None,
+                          wan_profiles=None,
+                          wan_round_s: float = 60.0,
+                          wan=None):
     """Launch server + ``worker_num`` client actors (threads; one per silo)
     and run the full protocol. Returns (final global model, round history).
 
@@ -1613,6 +1848,15 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     ledger, anomaly-armed one-shot profiling under ``obs_dir/profiles``.
     Pure observer: trajectories are bit-exact vs ``obs_dir=None``.
 
+    WAN realism (fedml_tpu/wan): ``wan_trace``/``wan_profiles``/
+    ``wan_round_s`` (or a prebuilt ``wan`` WanWorld) drive the schedule
+    through seeded diurnal churn and heterogeneous stragglers — cohorts
+    sample only trace-available clients, trace-offline silos get
+    deadline-evicted and rejoin through a trace-gated JOIN path, and
+    profiled report delays feed the pace steerer. Pure function of the
+    trace seed: one seed replays a bit-identical ledger. Unset = off,
+    byte-identical legacy behavior (README "WAN-realistic federation").
+
     Serving (fedml_tpu/serve): ``serve_port`` attaches a serving tier —
     each broadcast's model hot-swaps into a jitted, batch-coalescing
     TCP/JSON inference endpoint on that port (0 = ephemeral) that
@@ -1630,6 +1874,19 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     if checkpoint_dir:
         from fedml_tpu.utils.checkpoint import CheckpointManager
         checkpoint_mgr = CheckpointManager(checkpoint_dir)
+    # WAN world model (fedml_tpu/wan): population dynamics driving this
+    # schedule — availability-restricted sampling, trace-gated rejoin,
+    # per-silo churn/straggler agents. A prebuilt world (``wan=``) wins;
+    # otherwise specs build one. The shadow mass-JOIN bucket runs at the
+    # same rate as the real admission controller, so the population wave
+    # is measured against the configured policy.
+    if wan is None:
+        from fedml_tpu.wan import build_wan_world
+        wan = build_wan_world(wan_trace, wan_profiles, wan_round_s,
+                              population=dataset.client_num,
+                              mass_join_rate=join_rate_limit)
+    elif wan.population is None:
+        wan.population = dataset.client_num
     # resolve ONCE and hand the instance to both sides, so the server's
     # downlink and the silos' uplink can never disagree about the policy
     policy = resolve_compression(compression, compress=compress)
@@ -1648,7 +1905,7 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                       compression=policy,
                       round_deadline_s=round_deadline_s,
                       min_quorum_frac=min_quorum_frac,
-                      device_gate=device_gate, **control)
+                      device_gate=device_gate, wan=wan, **control)
         if server_optimizer:
             return FedOptServerManager(
                 0, size, server_com, aggregator, comm_round,
@@ -1678,7 +1935,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         obs_dir=obs_dir, job_id=job_id,
         comm_factory=comm_factory, device_gate=device_gate,
         serve_port=serve_port,
-        serve_staleness_rounds=serve_staleness_rounds, serving=serving)
+        serve_staleness_rounds=serve_staleness_rounds, serving=serving,
+        wan=wan)
     return model, history
 
 
@@ -1703,7 +1961,8 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       device_gate=None,
                       serve_port: Optional[int] = None,
                       serve_staleness_rounds: int = 2,
-                      serving=None):
+                      serving=None,
+                      wan=None):
     """Shared federation scaffolding for every server flavor (sync,
     FedOpt, quorum, FedAsync): init the global model, build the
     per-round eval hook, wire comm managers + client silos, run the
@@ -1859,7 +2118,8 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                            if client_state_dir else None),
                 resume=resume, prefetch_depth=prefetch_depth,
                 heartbeat_s=heartbeat_s, obs=silo_obs,
-                device_gate=device_gate))
+                device_gate=device_gate,
+                wan_agent=(wan.agent(rank) if wan is not None else None)))
     except BaseException:
         # a silo endpoint/manager that fails to construct (port already
         # bound, bad address, state-dir OSError) raises BEFORE the main
@@ -2012,8 +2272,21 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
     # the ft_* family
     cpc = getattr(server, "cp_counters", {})
     for key in ("checkpoints", "restores", "deadline_adjustments",
-                "joins_throttled"):
+                "joins_throttled", "resync_latency_skips"):
         tmr.count(f"cp_{key}", int(cpc.get(key, 0)))
+    # WAN-world roll-up (fedml_tpu/wan): the server drains the world's
+    # sampling counters at every round close; this picks up the
+    # remainder plus every silo agent's offline-drop / injected-delay
+    # totals. Keys only exist when a world ran — wan off leaves the
+    # timer byte-identical.
+    if wan is not None:
+        for k, v in wan.drain_counters().items():
+            tmr.count(k, int(v))
+        for c in clients:
+            agent = getattr(c, "_wan_agent", None)
+            if agent is not None:
+                for k, v in agent.counters.items():
+                    tmr.count(k, int(v))
     if getattr(server, "_pace", None) is not None \
             and getattr(server, "round_deadline_s", None):
         tmr.gauge("cp_steered_deadline_s", float(server.round_deadline_s))

@@ -169,6 +169,26 @@ def parse_fault_plan(spec: Union[None, str, dict, list, FaultPlan],
     return FaultPlan(seed=seed, rules=rules)
 
 
+def merge_plans(a: Union[None, str, dict, list, FaultPlan],
+                b: Union[None, str, dict, list, FaultPlan]
+                ) -> Optional[FaultPlan]:
+    """Compose two fault plans into ONE schedule — message-level chaos
+    and population-level churn running together (the WAN layer,
+    ``fedml_tpu/wan``, merges its trace-driven outage rules into a
+    user's ``--fault_plan`` through here). Rules concatenate in order
+    (first plan's rules match first, same as within one plan); the
+    first non-empty plan's seed keys every endpoint's RNG stream.
+    ``None``/empty operands pass through, so composing with nothing is
+    the identity."""
+    a = parse_fault_plan(a)
+    b = parse_fault_plan(b)
+    if a is None or a.empty:
+        return b
+    if b is None or b.empty:
+        return a
+    return FaultPlan(seed=a.seed, rules=(*a.rules, *b.rules))
+
+
 def _plan_from_obj(obj, seed: int) -> FaultPlan:
     if isinstance(obj, list):
         obj = {"rules": obj}

@@ -31,6 +31,27 @@ static values — with steering off (the default) behavior is
 byte-identical to the static flags. The steerer's windows are part of
 the server control-plane snapshot (``state()`` / ``load_state()``), so a
 restored server steers from the SAME evidence as the unkilled one.
+
+**Window semantics (churn hardening).** The latency evidence is a
+bounded fleet-wide sliding window (``SlidingQuantileTracker``, default
+128 observations): every accepted observation stays until 128 newer ones
+push it out, so any burst of outliers inflates the p90 — and therefore
+the steered deadline — for up to a full window width. Three guards keep
+churn from poisoning the schedule:
+
+1. **rejoin-resync replies are excluded** at the observation site
+   (``fedavg_cross_silo.handle_message_receive_model_from_client``): a
+   silo resynced mid-round reports ``broadcast -> reply`` latency that
+   measures its OUTAGE plus the resync detour, not its report pace. A
+   flap burst produces a burst of exactly these; they are skipped and
+   counted (``cp_resync_latency_skips``). Regression-tested with an
+   injected flap burst (tests/test_wan.py).
+2. **the clamp** bounds any residual excursion to
+   ``[min_deadline_s, max_deadline_s]`` (default base/4 .. base*4) — a
+   poisoned window can never stretch the deadline unboundedly.
+3. **recovery is automatic**: excluded-or-not, the window is sliding —
+   once healthy reports resume, 128 of them restore the steady-state
+   quantiles; nothing is latched.
 """
 
 from __future__ import annotations

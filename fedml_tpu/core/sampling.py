@@ -185,6 +185,107 @@ def _sample_clients_floyd(round_idx: int, total: int, per_round: int,
     return out
 
 
+def sample_clients_available(
+    round_idx: int,
+    client_num_in_total: int,
+    client_num_per_round: int,
+    is_available,
+    threshold: Optional[int] = None,
+    stats: Optional[dict] = None,
+) -> np.ndarray:
+    """Availability-restricted cohort draw — ``sample_clients`` composed
+    with a WAN availability trace (``fedml_tpu/wan``): cohorts come only
+    from clients ``is_available`` marks online, and the draw stays
+    bit-reproducible under a fixed ``round_idx`` seed.
+
+    ``is_available(cids: int64[n]) -> bool[n]`` must be a PURE vectorized
+    predicate (the trace is a pure function of ``(seed, cid, t)``), so
+    the whole draw is a pure function of ``(round_idx, predicate)``.
+
+    Two regimes, split at the same :data:`VIRTUAL_SAMPLE_THRESHOLD` the
+    unrestricted sampler uses:
+
+    - **at or below**: the available set is enumerated exactly (O(N),
+      fine at resident scale) and the cohort drawn from it with the
+      seeded global stream. Fewer available clients than the cohort
+      means every one participates and the remainder is filled by seeded
+      draws WITH replacement from the available set (a shrunken live
+      population re-samples its members more often — the cross-device
+      semantic);
+    - **above**: seeded REJECTION sampling over uniform ids — expected
+      O(k / availability) time and memory, so a 10^6-client population
+      still samples in microseconds and no per-client array exists.
+
+    **Graceful degradation**: a (near-)fully-dark population must degrade
+    the schedule, never stall it — when the draw cannot find enough
+    distinct available clients inside its budget, the remainder comes
+    from the unrestricted stream and ``stats['forced']`` counts it
+    (surfaced as ``wan_forced_cohorts``). ``stats['rejected']`` counts
+    unavailable candidates skipped along the way.
+    """
+    if threshold is None:
+        threshold = _virtual_sample_threshold()
+    total = int(client_num_in_total)
+    k = min(int(client_num_per_round), total)
+    if stats is None:
+        stats = {}
+    if total <= threshold:
+        avail = np.zeros(0, dtype=np.int64)
+        for lo in range(0, total, 1 << 17):
+            ids = np.arange(lo, min(lo + (1 << 17), total), dtype=np.int64)
+            on = ids[np.asarray(is_available(ids), dtype=bool)]
+            avail = np.concatenate([avail, on])
+        stats["rejected"] = stats.get("rejected", 0) + int(total
+                                                          - len(avail))
+        with _GLOBAL_RNG_LOCK:  # seed+draw atomic, same contract as always
+            np.random.seed(round_idx)
+            if len(avail) >= k:
+                return np.random.choice(avail, k, replace=False)
+            if len(avail) == 0:
+                # fully dark population: unrestricted fallback — the
+                # schedule degrades (stale cohorts) instead of stalling
+                stats["forced"] = stats.get("forced", 0) + k
+                return np.random.choice(total, k, replace=False)
+            stats["forced"] = stats.get("forced", 0) + (k - len(avail))
+            fill = np.random.choice(avail, k - len(avail), replace=True)
+            return np.concatenate([avail, fill])
+    # -- virtual regime: seeded rejection, O(k / availability) --------------
+    out: list = []
+    seen: set = set()
+    rejected = 0
+    batch = max(4 * k, 64)
+    budget = max(64 * k, 4096)  # total candidate draws before giving up
+    with _GLOBAL_RNG_LOCK:
+        np.random.seed(round_idx)
+        while len(out) < k and budget > 0:
+            cand = np.random.randint(0, total, size=min(batch, budget))
+            budget -= len(cand)
+            ok = np.asarray(is_available(cand), dtype=bool)
+            for c, on in zip(cand.tolist(), ok.tolist()):
+                if not on:
+                    rejected += 1
+                    continue
+                if c in seen:
+                    continue
+                seen.add(c)
+                out.append(c)
+                if len(out) == k:
+                    break
+        forced = k - len(out)
+        while len(out) < k:
+            # budget exhausted (population nearly dark): fill from the
+            # unrestricted stream — degrade, don't stall
+            c = int(np.random.randint(0, total))
+            if c in seen:
+                continue
+            seen.add(c)
+            out.append(c)
+    stats["rejected"] = stats.get("rejected", 0) + rejected
+    if forced:
+        stats["forced"] = stats.get("forced", 0) + forced
+    return np.asarray(out, dtype=np.int64)
+
+
 def eval_subsample(x, y, limit: Optional[int], seed: int):
     """Seeded eval-set subsample, ONE formula for every driver.
 

@@ -176,6 +176,12 @@ def _validate_before_sink(args, ds):
         logging.warning("--serve_port is only wired for --algo "
                         "fedavg_cross_silo (the serving tier rides its "
                         "broadcast publishes); ignoring for %r", args.algo)
+    if getattr(args, "wan_trace", None) \
+            and args.algo != "fedavg_cross_silo":
+        logging.warning("--wan_trace/--wan_profiles are only wired for "
+                        "--algo fedavg_cross_silo (the WAN world drives "
+                        "the actor protocol's liveness/admission paths); "
+                        "ignoring for %r", args.algo)
     if (getattr(args, "prefetch_depth", 2) != 2
             and args.algo in _CUSTOM_LOOP_ALGOS):
         # the async round pipeline rides FedAvgAPI._host_round_inputs;
@@ -245,6 +251,12 @@ def run_algo(args):
             serve_port=getattr(args, "serve_port", None),
             serve_staleness_rounds=getattr(args, "serve_staleness_rounds",
                                            2),
+            # WAN world model (fedml_tpu/wan): diurnal churn +
+            # heterogeneous stragglers driving the liveness/admission/
+            # steering machinery (README "WAN-realistic federation")
+            wan_trace=getattr(args, "wan_trace", None),
+            wan_profiles=getattr(args, "wan_profiles", None),
+            wan_round_s=getattr(args, "wan_round_s", 60.0),
             # flight recorder (fedml_tpu/obs): previously only the
             # main_fedavg runners threaded these — the fed_launch
             # cross-silo path silently dropped --obs_dir/--job_id

@@ -114,6 +114,51 @@ METRICS: Dict[str, Dict[str, str]] = {
                              "admission control"),
     "cp_steered_deadline_s": _m(KIND_GAUGE, "control plane",
                                 "largest pace-steered round deadline"),
+    "cp_resync_latency_skips": _m(KIND_COUNTER, "control plane",
+                                  "rejoin-resync reply latencies excluded "
+                                  "from the pace-steering window (they "
+                                  "measure the outage, not the silo's "
+                                  "pace — the churn-poisoning guard)"),
+    # -- WAN world model (fedml_tpu/wan/) -----------------------------------
+    "wan_cohort_rejections": _m(KIND_COUNTER, "wan",
+                                "cohort-draw candidates skipped because "
+                                "the availability trace marked them "
+                                "offline"),
+    "wan_forced_cohorts": _m(KIND_COUNTER, "wan",
+                             "cohort slots filled from the unrestricted "
+                             "stream because the available population "
+                             "was exhausted (graceful degradation, "
+                             "never a stall)"),
+    "wan_offline_drops": _m(KIND_COUNTER, "wan",
+                            "broadcasts a silo dropped because its "
+                            "embodied device was trace-offline (no "
+                            "training, no reply — the deadline eviction "
+                            "path removes it)"),
+    "wan_delay_injected_ms": _m(KIND_COUNTER, "wan",
+                                "total injected report delay across the "
+                                "fleet (the heterogeneous straggler "
+                                "profiles), milliseconds"),
+    "wan_join_deferred": _m(KIND_COUNTER, "wan",
+                            "JOINs answered with BACKPRESSURE because "
+                            "the silo's device was still trace-offline "
+                            "(the deterministic rejoin gate)"),
+    "wan_mass_joins": _m(KIND_COUNTER, "wan",
+                         "estimated population-scale device arrivals "
+                         "per round (the trace's churn wave, "
+                         "sample-scaled)"),
+    "wan_mass_leaves": _m(KIND_COUNTER, "wan",
+                          "estimated population-scale device departures "
+                          "per round"),
+    "wan_mass_join_throttled": _m(KIND_COUNTER, "wan",
+                                  "population JOIN-wave arrivals the "
+                                  "shadow admission bucket (same rate as "
+                                  "--join_rate_limit, sim clock) would "
+                                  "have throttled"),
+    "wan_available_frac": _m(KIND_GAUGE, "wan",
+                             "highest per-round population availability "
+                             "fraction observed (the per-round "
+                             "trajectory rides the round records' "
+                             "wan_available_frac field)"),
     # -- federation scheduler (fedml_tpu/sched/) ---------------------------
     "sched_device_time": _m(KIND_PHASE, "scheduler",
                             "wall-clock this job held the shared device "

@@ -119,6 +119,73 @@ def _serving_section(rounds: List[Dict[str, Any]]
     }
 
 
+def _availability_section(rounds: List[Dict[str, Any]]
+                          ) -> Optional[Dict[str, Any]]:
+    """The churn/availability summary, folded from the server round
+    records' existing fields (live set, cumulative eviction/rejoin/
+    throttle counters, the per-round deadline, the WAN availability
+    fraction): live-set size timeline, per-round eviction/rejoin
+    deltas, admission throttles, and the steered-deadline trajectory.
+    None when the job never ran the fault-tolerant path (no record
+    carries a live set)."""
+    live_sizes: List[int] = []
+    evict_deltas: List[int] = []
+    rejoin_deltas: List[int] = []
+    throttle_deltas: List[int] = []
+    deadlines: List[float] = []
+    wan_fracs: List[float] = []
+    prev_ev = prev_rj = prev_th = 0
+    saw_live = False
+    for row in rounds:
+        srv = row.get("server") or {}
+        live = srv.get("live")
+        if live is None:
+            continue
+        saw_live = True
+        live_sizes.append(len(live))
+        ev = int(srv.get("evictions") or 0)
+        rj = int(srv.get("rejoins") or 0)
+        th = int(srv.get("joins_throttled") or 0)
+        evict_deltas.append(max(0, ev - prev_ev))
+        rejoin_deltas.append(max(0, rj - prev_rj))
+        throttle_deltas.append(max(0, th - prev_th))
+        prev_ev, prev_rj, prev_th = ev, rj, th
+        if srv.get("deadline_s") is not None:
+            deadlines.append(float(srv["deadline_s"]))
+        if srv.get("wan_available_frac") is not None:
+            wan_fracs.append(float(srv["wan_available_frac"]))
+    if not saw_live:
+        return None
+    out: Dict[str, Any] = {
+        "live_set": {
+            "first": live_sizes[0],
+            "min": min(live_sizes),
+            "last": live_sizes[-1],
+            "series": live_sizes,
+        },
+        "evictions": sum(evict_deltas),
+        "rejoins": sum(rejoin_deltas),
+        "admission_throttles": sum(throttle_deltas),
+        "evictions_per_round": evict_deltas,
+        "rejoins_per_round": rejoin_deltas,
+    }
+    if deadlines:
+        out["deadline_s"] = {
+            "first": round(deadlines[0], 6),
+            "last": round(deadlines[-1], 6),
+            "min": round(min(deadlines), 6),
+            "max": round(max(deadlines), 6),
+            "series": [round(d, 6) for d in deadlines],
+        }
+    if wan_fracs:
+        out["wan_available_frac"] = {
+            "min": round(min(wan_fracs), 4),
+            "max": round(max(wan_fracs), 4),
+            "series": wan_fracs,
+        }
+    return out
+
+
 def summarize_job(merged: Dict[str, Any], job_id: str) -> Dict[str, Any]:
     """One job's summary from that job's OWN merged timeline (the
     caller merges per job — round rows are keyed by round index, so two
@@ -166,6 +233,7 @@ def summarize_job(merged: Dict[str, Any], job_id: str) -> Dict[str, Any]:
                                       / len(table), 1) if table else None),
         },
         "counters": rollup,
+        "availability": _availability_section(rounds),
         "serving": _serving_section(rounds),
         "anomaly_count": len(anomalies),
         "anomalies": anomalies,
@@ -227,6 +295,25 @@ def to_markdown(report: Dict[str, Any]) -> str:
              f"({wire.get('bytes_per_round')} B/round)"),
             ("anomalies", s.get("anomaly_count", 0)),
         ]
+        avail = s.get("availability")
+        if avail:
+            ls = avail.get("live_set") or {}
+            rows.append(("live set (first/min/last)",
+                         f"{ls.get('first', '-')}/{ls.get('min', '-')}/"
+                         f"{ls.get('last', '-')}"))
+            rows.append(("evictions / rejoins / throttles",
+                         f"{avail.get('evictions', 0)}/"
+                         f"{avail.get('rejoins', 0)}/"
+                         f"{avail.get('admission_throttles', 0)}"))
+            dl = avail.get("deadline_s")
+            if dl:
+                rows.append(("steered deadline first->last (min..max s)",
+                             f"{dl.get('first')} -> {dl.get('last')} "
+                             f"({dl.get('min')}..{dl.get('max')})"))
+            wf = avail.get("wan_available_frac")
+            if wf:
+                rows.append(("WAN availability (min..max)",
+                             f"{wf.get('min')}..{wf.get('max')}"))
         serving = s.get("serving")
         if serving:
             sw = serving.get("swap_ms") or {}

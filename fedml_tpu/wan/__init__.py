@@ -1,0 +1,27 @@
+"""WAN-realistic cross-device federation: seeded diurnal availability
+traces, heterogeneous client profiles, and availability-restricted
+cohort sampling driving the real federation stack (README
+"WAN-realistic federation").
+
+The layer is a *world model*, not a driver: a :class:`WanWorld` is
+handed to the existing cross-silo launch (``--wan_trace`` /
+``--wan_profiles`` / ``--wan_round_s``) and the protocol's own
+machinery — deadline eviction, JOIN + admission control, pace steering,
+the chaos harness — experiences the population dynamics. Everything
+population-side is a pure function of ``(seed, client_id, round)``:
+1M clients cost O(cohort) per round and a churn run replays
+bit-identically under one seed.
+"""
+
+from fedml_tpu.wan.profiles import (ClientProfiles, ProfileConfig,
+                                    parse_wan_profiles)
+from fedml_tpu.wan.trace import (AvailabilityTrace, FlapBurst, TraceConfig,
+                                 parse_wan_trace)
+from fedml_tpu.wan.world import (WanAgent, WanWorld, build_wan_world,
+                                 compose_fault_plan)
+
+__all__ = [
+    "AvailabilityTrace", "ClientProfiles", "FlapBurst", "ProfileConfig",
+    "TraceConfig", "WanAgent", "WanWorld", "build_wan_world",
+    "compose_fault_plan", "parse_wan_profiles", "parse_wan_trace",
+]

@@ -13,3 +13,13 @@ def pick_restore_candidates(directory):
 
 def complete_names(directory):
     return set(os.listdir(directory))
+
+
+def pick_wan_trace_specs(trace_dir):
+    """WAN-flavored negative: spec enumeration is sorted, so burst
+    composition order is one thing everywhere."""
+    bursts = []
+    for fn in sorted(os.listdir(trace_dir)):
+        if fn.endswith(".json"):
+            bursts.append(fn)
+    return bursts

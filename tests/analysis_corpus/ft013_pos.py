@@ -10,3 +10,13 @@ def pick_restore_candidates(directory):
         if fn.startswith("round_"):
             out.append(fn)
     return out
+
+
+def pick_wan_trace_specs(trace_dir):
+    """WAN-flavored positive: flap-burst spec files consumed in raw
+    directory order — two hosts would compose the bursts differently."""
+    bursts = []
+    for fn in os.listdir(trace_dir):
+        if fn.endswith(".json"):
+            bursts.append(fn)
+    return bursts

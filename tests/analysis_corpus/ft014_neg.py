@@ -10,3 +10,15 @@ def weighted_total(reported_updates):
     for worker in sorted(pending):
         total += float(worker) * 0.5
     return total
+
+
+def rejoin_admit_weight(deferred):
+    """WAN-flavored negative: the pending set is folded in sorted
+    order — the admit sequence is a pure function of its contents."""
+    pending_joins = set()
+    for entry in deferred:
+        pending_joins.add(entry)
+    order_weight = 0.0
+    for entry in sorted(pending_joins):
+        order_weight = order_weight * 0.5 + float(entry)
+    return order_weight

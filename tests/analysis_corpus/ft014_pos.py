@@ -11,3 +11,16 @@ def weighted_total(reported_updates):
     for worker in pending:
         total += float(worker) * 0.5
     return total
+
+
+def rejoin_admit_weight(deferred):
+    """WAN-flavored positive: deferred-JOIN batch admission folding a
+    raw set in iteration order — the admit sequence (and so the ledger)
+    would depend on hash seeding."""
+    pending_joins = set()
+    for entry in deferred:
+        pending_joins.add(entry)
+    order_weight = 0.0
+    for entry in pending_joins:
+        order_weight = order_weight * 0.5 + float(entry)
+    return order_weight

@@ -17,3 +17,14 @@ def watchdog_poll(last_beat, timeout_s):
     if time.monotonic() - last_beat > timeout_s:
         return "stalled"
     return "ok"
+
+
+def wan_client_available(cid, round_idx, round_s, duty_cycle):
+    """WAN-flavored negative: the trace's clock is SIMULATED — sim time
+    derives from the round index, so availability replays bit-identically
+    (wall time may still feed telemetry)."""
+    sim_t = round_idx * round_s
+    phase = sim_t % 86400.0
+    if phase / 86400.0 < duty_cycle:
+        return True
+    return cid % 2 == 0

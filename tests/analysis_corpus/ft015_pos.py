@@ -12,3 +12,13 @@ def close_round_if_late(round_started_at, pending):
         if not pending:
             return "close_full"
     return "extend"
+
+
+def wan_client_available(cid, duty_cycle):
+    """WAN-flavored positive: an availability trace branching on the
+    WALL clock — the schedule would never replay (trace code must use
+    simulated time only)."""
+    phase = time.time() % 86400.0
+    if phase / 86400.0 < duty_cycle:
+        return True
+    return cid % 2 == 0
