@@ -1634,6 +1634,113 @@ def bench_parallel_axes() -> dict:
     }
 
 
+def bench_mesh_scaling() -> dict:
+    """Measured multi-chip SPMD federation scaling (parallel/mesh.py):
+    fused federated rounds/sec + MFU + collective bytes for the
+    transformer and resnet18_gn workloads at named-mesh sizes
+    {1, 2, 4, 8}. Each (workload, mesh) point runs in its OWN
+    subprocess so the device count is real: on a chip host the mesh
+    spans the chips; on a CPU host each leg forces
+    ``--xla_force_host_platform_device_count=N`` virtual devices — the
+    same mechanism the collective-signature audit uses to verify
+    device-count-independent lowerings, so real-chip rows drop in
+    unchanged.
+
+    This supersedes the dryrun-only ``MULTICHIP_r*.json`` lineage
+    ("dryrun_multichip(8) ok" proved the program builds at 8 devices;
+    these rows MEASURE it). Honesty caveats, same contract as
+    ci/parallel_scaling_cpu.py: this bench host has ONE physical core,
+    so virtual-device rows cannot show wall-clock parallel speedup —
+    the measured mesh8/mesh1 ratio reflects per-device program
+    efficiency only, and the ``scaling_note`` says so. ``mfu`` is None
+    on CPU (the peak table never guesses); CPU rows instead carry
+    ``mfu_vs_measured_host_peak`` against a measured host GEMM peak,
+    explicitly labeled.
+    """
+    import subprocess
+
+    import jax
+
+    tpu = _is_tpu()
+    n_avail = len(jax.devices())
+    sizes = [n for n in (1, 2, 4, 8) if (not tpu) or n <= n_avail]
+    workloads = ("transformer_flash_s2048", "resnet18_gn")
+
+    def leg(workload: str, n: int, timeout_s: int = 300) -> dict:
+        # resnet rounds are ~20x a transformer round on the CPU smoke
+        # shapes — fewer timed rounds keep the stage inside its budget
+        rounds, disp = ((4, 2) if workload.startswith("transformer")
+                        else (2, 1))
+        cmd = [sys.executable, "-m", "fedml_tpu.parallel.mesh",
+               "--bench-worker", "--workload", workload,
+               "--mesh", f"data={n}",
+               "--rounds", str(rounds), "--dispatches", str(disp)]
+        env = dict(os.environ)
+        if not tpu:
+            # forced-host virtual devices: the worker also pins the cpu
+            # platform itself (axon sitecustomize overrides env alone)
+            cmd.append("--force-host")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count={n}"
+                                ).strip()
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s, env=env)
+        except subprocess.TimeoutExpired:
+            return {"error": f"mesh leg {workload}@{n} hung for "
+                             f"{timeout_s}s"}
+        if proc.returncode != 0:
+            return {"error": f"mesh leg {workload}@{n} failed: "
+                             f"{proc.stderr[-500:]}"}
+        try:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return {"error": f"mesh leg {workload}@{n} unparseable: "
+                             f"{proc.stdout[-300:]}"}
+
+    curves: dict = {w: {} for w in workloads}
+    for w in workloads:
+        for n in sizes:
+            curves[w][str(n)] = leg(w, n)
+
+    def rps(w, n):
+        row = curves[w].get(str(n), {})
+        return row.get("rounds_per_sec")
+
+    tf, rn = workloads
+    top = rps(tf, max(sizes))
+    ratio = (round(rps(tf, max(sizes)) / rps(tf, 1), 3)
+             if rps(tf, 1) and rps(tf, max(sizes)) else None)
+    out = {
+        "workloads": list(workloads),
+        "mesh_sizes": sizes,
+        "curves": curves,
+        # the trend-gated headline: the fused transformer stage at the
+        # widest mesh — the row the ≥2x scaling criterion reads
+        "rounds_per_sec": top,
+        "transformer_scaling_ratio": ratio,
+        "scaling_ratio_meshes": [1, max(sizes)],
+        "resnet_scaling_ratio": (round(rps(rn, max(sizes)) / rps(rn, 1), 3)
+                                 if rps(rn, 1) and rps(rn, max(sizes))
+                                 else None),
+        "supersedes": "runs/MULTICHIP_r*.json (dryrun-only lineage)",
+        "scaling_note": (
+            "measured on real chips; ratio = ICI strong scaling" if tpu
+            else "forced-host XLA:CPU devices on a host with ONE physical "
+                 "core: all virtual devices timeshare one core, so the "
+                 "mesh8/mesh1 ratio reflects per-device program "
+                 "efficiency (smaller per-device shapes compile to "
+                 "faster total programs), NOT parallel speedup — the "
+                 "ci/parallel_scaling_cpu.py contract. The >=2x strong-"
+                 "scaling claim is a chip-host claim; real-chip rows "
+                 "drop in unchanged and are tagged by device_kind."),
+    }
+    _write_artifact("mesh_scaling.json", out)
+    return out
+
+
 def bench_time_to_target_mnist_lr() -> dict:
     """Time-to-target at the REFERENCE ANCHOR shape (BASELINE.md row 1:
     MNIST + LR, 1000 power-law clients, 10/round, B=10, SGD lr=0.03, E=1,
@@ -2231,6 +2338,8 @@ _STAGES = (
      lambda: bench_fused_device_sampling(), ("fused_device",)),
     ("federated_parallel_axes", "federated_parallel_axes",
      lambda: bench_parallel_axes(), ("parallel_axes", "axes")),
+    ("mesh_scaling", "mesh_scaling",
+     lambda: bench_mesh_scaling(), ("mesh", "scaling", "multichip")),
     ("time_to_target_mnist_lr", "time_to_target_mnist_lr",
      lambda: bench_time_to_target_mnist_lr(), ("tta_mnist",)),
     ("time_to_target_acc", "time_to_target",

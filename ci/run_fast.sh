@@ -46,6 +46,16 @@ JAX_PLATFORMS=cpu python -m fedml_tpu.wan --smoke
 # landed, ZERO requests were shed, and the SLO report carries measured
 # latency quantiles + the served round
 JAX_PLATFORMS=cpu python -m fedml_tpu.serve --smoke
+# named-mesh smoke (fedml_tpu/parallel/mesh, ~5 s, <= 20 s budget): a
+# real 2-device data-mesh federation with the flight recorder ON — 3
+# host rounds + one fused 2-round block through the named-mesh scan,
+# the mesh entry points' collective signatures audited against
+# ci/collective_baseline.json, and the flight log rebuilt by
+# `obs merge --ledger` at rc 0 (artifact under runs/mesh_smoke/)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m fedml_tpu.parallel.mesh --smoke --force-host \
+    --out runs/mesh_smoke
 # slowest-20 artifact (tests/conftest.py sessionfinish hook): fast-lane
 # time creep becomes a diffable runs/ number instead of a README
 # anecdote — AND a trend-ledger row, so creep regresses like a bench

@@ -48,6 +48,7 @@ ENTRY_POINT_MODULES = (
     "fedml_tpu.algorithms.fedavg",
     "fedml_tpu.algorithms.fedopt",
     "fedml_tpu.parallel.spmd",
+    "fedml_tpu.parallel.mesh",
     "fedml_tpu.ops.flash_attention",
     "fedml_tpu.ops.sparsify",
 )

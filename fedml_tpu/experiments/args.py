@@ -57,6 +57,14 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "(ZeRO-3, any model)")
     parser.add_argument("--mp_size", type=int, default=1,
                         help="devices per client slot for --model_parallel")
+    parser.add_argument("--mesh_shape", type=str, default=None,
+                        help="spmd backend: named data x fsdp x tp "
+                             "federation mesh, e.g. 'data=4,fsdp=2' — "
+                             "sampled clients ride the data axis while "
+                             "every client's model carries the canonical "
+                             "SpecLayout fsdp/tp parameter layout "
+                             "(parallel/mesh.py); supersedes "
+                             "--model_parallel/--mp_size")
     parser.add_argument("--prefetch_depth", type=int, default=2,
                         help="async round pipeline: pack + upload the "
                              "next round's cohort (or fused block window) "

@@ -85,12 +85,17 @@ def run_spmd(args, ds, model, task, sink):
     from fedml_tpu.parallel.spmd import (DistributedFedAvgAPI,
                                          DistributedFedAvgConfig)
 
+    mesh_shape = getattr(args, "mesh_shape", None)
+    if mesh_shape:
+        from fedml_tpu.parallel.mesh import parse_mesh_shape
+        mesh_shape = parse_mesh_shape(mesh_shape)
     cfg = DistributedFedAvgConfig(
         comm_round=args.comm_round,
         client_num_per_round=args.client_num_per_round,
         frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
         model_parallel=getattr(args, "model_parallel", None),
         mp_size=getattr(args, "mp_size", 1),
+        mesh_shape=mesh_shape,
         prefetch_depth=getattr(args, "prefetch_depth", 2),
         obs_dir=getattr(args, "obs_dir", None),
         job_id=getattr(args, "job_id", None),

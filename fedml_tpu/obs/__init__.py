@@ -185,14 +185,17 @@ def build_observability(obs_dir: Optional[str], *,
                         anomaly_factor: float = 3.0,
                         profile_on_anomaly: bool = True,
                         perf_accounting: bool = True,
-                        perf_device_count: int = 1
+                        perf_device_count: int = 1,
+                        perf_device=None
                         ) -> Optional[Observability]:
     """The single constructor every launcher shares. ``obs_dir`` None
     (the default everywhere) returns None — observability fully off,
     byte-identical legacy behavior. Servers (``role="server"``) get the
     detector + profiler plus the roofline/MFU accountant
     (``obs/perf.py``; ``perf_device_count`` scales the per-device peak
-    to the mesh the round program spans); silos only record."""
+    to the WHOLE mesh the round program spans — all axes, not just the
+    federation axis — and ``perf_device`` pins which device's kind
+    rates the per-device peak); silos only record."""
     if not obs_dir:
         return None
     recorder = FlightRecorder(obs_dir, job_id=job_id, rank=rank,
@@ -205,6 +208,7 @@ def build_observability(obs_dir: Optional[str], *,
             os.path.join(obs_dir, "profiles") if profile_on_anomaly
             else None)
         if perf_accounting:
-            perf = PerfAccountant(device_count=perf_device_count)
+            perf = PerfAccountant(device_count=perf_device_count,
+                                  device=perf_device)
     return Observability(recorder, detector=detector, profiler=profiler,
                          perf=perf)

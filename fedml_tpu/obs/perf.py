@@ -176,15 +176,18 @@ class PerfAccountant:
     record into a ``perf`` record.
 
     ``device_count`` scales the per-device peak to the fleet the round
-    program actually spans (the mesh driver passes its mesh size; the
-    single-device sim drivers pass 1)."""
+    program actually spans (the mesh driver passes its WHOLE mesh size —
+    data x fsdp x tp, so an fsdp/tp round can never report single-chip
+    MFU; the single-device sim drivers pass 1). ``device`` pins which
+    device's kind rates the per-device peak (a mesh device, so a mixed
+    host rates the mesh, not the coordinator)."""
 
     def __init__(self, *, peak_flops: Optional[float] = None,
-                 device_count: int = 1,
+                 device_count: int = 1, device=None,
                  memory_fn: Optional[Callable[[], Optional[Dict]]]
                  = device_memory_gauges):
         per_dev = (peak_flops if peak_flops is not None
-                   else device_peak_flops())
+                   else device_peak_flops(device))
         self.peak_flops = (per_dev * max(1, int(device_count))
                            if per_dev else None)
         self.round_flops: Optional[float] = None

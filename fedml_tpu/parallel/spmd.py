@@ -350,6 +350,13 @@ class DistributedFedAvgConfig:
     # (ZeRO-3, any model) with mp_size devices per client
     model_parallel: Optional[str] = None
     mp_size: int = 1
+    # named data x fsdp x tp mesh (parallel/mesh.py): e.g.
+    # {"data": 4, "fsdp": 2}. Supersedes model_parallel/mp_size — ONE
+    # mesh carries the federation axis AND the canonical SpecLayout
+    # parameter layout, so fused block scans and fsdp/tp rounds compose
+    # instead of living on disjoint 1-D meshes. Mutually exclusive with
+    # model_parallel.
+    mesh_shape: Optional[Dict[str, int]] = None
 
 
 class DistributedFedAvgAPI:
@@ -369,9 +376,15 @@ class DistributedFedAvgAPI:
         self.task = task
         self.config = config or DistributedFedAvgConfig()
         mp = self.config.model_parallel
+        mesh_shape = getattr(self.config, "mesh_shape", None)
         if mp and mp not in ("tp", "fsdp"):
             raise ValueError(f"unknown model_parallel: {mp!r}")
-        if mp and self.config.train.lr_decay_round != 1.0:
+        if mp and mesh_shape:
+            raise ValueError(
+                "mesh_shape supersedes model_parallel — declare the mp "
+                "axis on the named mesh instead, e.g. "
+                "mesh_shape={'data': n, 'tp': k}")
+        if (mp or mesh_shape) and self.config.train.lr_decay_round != 1.0:
             raise NotImplementedError(
                 "lr_decay_round is not threaded through the model-parallel "
                 "(gspmd) round; use the flat clients-axis mesh")
@@ -388,14 +401,39 @@ class DistributedFedAvgAPI:
                     f"mp_size {k} must divide device count {len(devs)}")
             mesh = Mesh(np.asarray(devs).reshape(len(devs) // k, k),
                         ("clients", mp))
+        # named data x fsdp x tp mesh (parallel/mesh.py): the canonical
+        # SpecLayout drives both the round programs and parameter
+        # placement; the federation axis is 'data' instead of 'clients'
+        self._layout = None
+        self._data_axis = "clients"
+        if mesh_shape:
+            from fedml_tpu.parallel.mesh import (DEFAULT_LAYOUT,
+                                                 build_named_mesh)
+            if mesh is None:
+                mesh = build_named_mesh(dict(mesh_shape))
+            self._layout = DEFAULT_LAYOUT
+            self._data_axis = DEFAULT_LAYOUT.data_axis
+            if self._data_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"named federation mesh needs a {self._data_axis!r} "
+                    f"axis; got axes {mesh.axis_names}")
         self.mesh = mesh or build_mesh({"clients": len(jax.devices())})
         if mp and mp not in self.mesh.axis_names:
             raise ValueError(
                 f"model_parallel={mp!r} needs a mesh axis named {mp!r}; "
                 f"got axes {self.mesh.axis_names}")
-        # round/eval slots pad to the CLIENTS axis (== all devices when 1-D)
-        self.n_dev = int(self.mesh.shape["clients"])
-        if mp:
+        # round/eval slots pad to the FEDERATION axis ('clients', or
+        # 'data' on the named mesh — == all devices when 1-D)
+        self.n_dev = int(self.mesh.shape[self._data_axis])
+        if self._layout is not None:
+            from fedml_tpu.parallel.mesh import (make_mesh_eval,
+                                                 make_mesh_federated_round)
+            self._round_fn, self._shard_params = make_mesh_federated_round(
+                module, task, self.config.train, self.mesh, self._layout,
+                donate=True)
+            self._eval_fn = make_mesh_eval(module, task, self.mesh,
+                                           self._layout)
+        elif mp:
             from fedml_tpu.parallel.gspmd_round import (
                 make_gspmd_eval, make_sharded_federated_round)
             if mp == "tp":
@@ -425,7 +463,7 @@ class DistributedFedAvgAPI:
                                               check_vma=self._check_vma)
         self._n_pad = dataset.padded_len(self.config.train.batch_size)
         self._base_key = jax.random.key(self.config.seed)
-        self._data_sharding = NamedSharding(self.mesh, P("clients"))
+        self._data_sharding = NamedSharding(self.mesh, P(self._data_axis))
         sample_x = dataset.train_data_global[0][:1]
         self.variables = module.init(jax.random.key(self.config.seed),
                                      jnp.asarray(sample_x), train=False)
@@ -443,7 +481,13 @@ class DistributedFedAvgAPI:
             # job ids must not collide in a shared obs dir
             job_id=(getattr(self.config, "job_id", None)
                     or default_job_id("spmd")),
-            rank=0, role="server", perf_device_count=self.n_dev)
+            rank=0, role="server",
+            # fleet MFU denominator: the WHOLE mesh (data x fsdp x tp),
+            # not just the federation axis — an fsdp/tp round must never
+            # report single-chip MFU. Kind read from a mesh device so a
+            # mixed host (CPU coordinator + TPU mesh) rates the mesh.
+            perf_device_count=int(self.mesh.size),
+            perf_device=self.mesh.devices.flat[0])
         if self._obs is not None:
             self._obs.bind_timer(self.timer)
         # same-cohort device cache as FedAvgAPI._pack_cache: full
@@ -612,7 +656,7 @@ class DistributedFedAvgAPI:
             # one-shot roofline probe (obs/perf.py): trace the sharded
             # round program at GLOBAL shapes — analytic_flops then counts
             # the whole-mesh FLOPs, matching the fleet peak the perf
-            # accountant was built with (perf_device_count=n_dev).
+            # accountant was built with (perf_device_count=mesh.size).
             # Traced before dispatch so donation can't invalidate inputs.
             from fedml_tpu.utils.flops import analytic_flops
             args = ((self.variables, xd, yd, maskd, keysd, wd,
@@ -663,8 +707,13 @@ class DistributedFedAvgAPI:
         N = self.dataset.client_num
         if cfg.model_parallel:
             raise ValueError(
-                "fused mesh rounds support the flat 'clients' mesh only")
-        if cfg.client_num_per_round != N:
+                "fused mesh rounds support the flat 'clients' mesh or a "
+                "named mesh_shape mesh; legacy model_parallel does not "
+                "compose with the fused scan")
+        if self._layout is not None or cfg.client_num_per_round != N:
+            # named mesh: the GSPMD block scan serves full AND sampled
+            # participation (the resident full-federation fast path below
+            # is a shard_map program on the 'clients' axis only)
             return self._run_block_fused(r0, rounds,
                                          next_window=next_window)
         if (getattr(self, "_fused_data", None) is None
@@ -720,7 +769,7 @@ class DistributedFedAvgAPI:
         with self.timer.phase("upload"):
             put = lambda a: jax.device_put(
                 jnp.asarray(a), NamedSharding(self.mesh,
-                                              P(None, "clients")))
+                                              P(None, self._data_axis)))
             args = (put(x.reshape(lead + x.shape[1:])),
                     put(y.reshape(lead + y.shape[1:])),
                     put(mask.reshape(lead + mask.shape[1:])),
@@ -770,9 +819,15 @@ class DistributedFedAvgAPI:
         if getattr(self, "_block_fn", None) is None:
             # one jitted program; jit's own shape-keyed trace cache
             # specializes per (R, P_pad, n_pad) block shape
-            self._block_fn = make_spmd_block_multiround(
-                self.module, self.task, self.config.train, self.mesh,
-                check_vma=getattr(self, "_check_vma", True))
+            if self._layout is not None:
+                from fedml_tpu.parallel.mesh import make_mesh_block_multiround
+                self._block_fn = make_mesh_block_multiround(
+                    self.module, self.task, self.config.train, self.mesh,
+                    self._layout, donate=True)
+            else:
+                self._block_fn = make_spmd_block_multiround(
+                    self.module, self.task, self.config.train, self.mesh,
+                    check_vma=getattr(self, "_check_vma", True))
         with self.timer.phase("dispatch"):
             self.variables, stats = self._block_fn(
                 self.variables, *args, self._base_key, jnp.uint32(r0))
