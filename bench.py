@@ -795,6 +795,160 @@ def bench_cross_silo_compression() -> dict:
     }
 
 
+def bench_fanout_agg() -> dict:
+    """The server round hot path: (a) parallel writer-thread fan-out vs
+    the blocking sequential loop under ONE stalled peer (real TCP,
+    kernel backpressure), (b) streaming-fold round close vs the legacy
+    buffer-all close, and (c) a trend-gated federation round rate with
+    a chaos-delayed straggler silo. Artifact: runs/fanout_agg.json."""
+    import threading
+
+    import jax
+
+    from fedml_tpu.algorithms.fedavg_cross_silo import (
+        FedAvgAggregator, run_fedavg_cross_silo)
+    from fedml_tpu.comm.fanout_smoke import _HOST, _RawPeer
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.serialization import SharedPayload
+    from fedml_tpu.comm.tcp import TcpCommManager
+    from fedml_tpu.core import pytree as pt
+    from fedml_tpu.data.synthetic import make_blob_federated
+    from fedml_tpu.models.lr import LogisticRegression
+    from fedml_tpu.trainer.functional import TrainConfig
+    from fedml_tpu.utils.tracing import RoundTimer
+
+    stall_s = 0.75
+    payload_mb = 4
+    port = [40720]
+
+    def fanout_leg(n_peers: int, parallel: bool) -> dict:
+        """Broadcast one shared payload to ``n_peers``; the FIRST
+        destination stalls its reads for ``stall_s`` (head-of-line for
+        the sequential loop — any stalled position delays every LATER
+        peer there, so first is the honest worst case)."""
+        base = port[0]
+        port[0] += n_peers + 1
+        addresses = {r: (_HOST, base + r) for r in range(n_peers + 1)}
+        peers = {r: _RawPeer(base + r,
+                             stall_s=stall_s if r == 1 else 0.0)
+                 for r in range(1, n_peers + 1)}
+        com = TcpCommManager(0, addresses)
+        rng = np.random.default_rng(0)
+        shared = SharedPayload({"w": rng.standard_normal(
+            (payload_mb * (1 << 20) // 4,)).astype(np.float32)})
+        msgs = []
+        for r in range(1, n_peers + 1):
+            msgs.append(Message(2, 0, r).add("model_params", shared)
+                        .add("round_idx", 0))
+        errors = []
+        t0 = time.perf_counter()
+        if parallel:
+            com.broadcast(msgs,
+                          on_error=lambda r, e: errors.append((r, e)))
+        else:
+            for msg in msgs:  # the pre-writer-thread behavior: each
+                com.send_message(msg)  # send blocks through the queue
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        deadline = time.monotonic() + stall_s + 30.0
+        while any(p.done_t is None for p in peers.values()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        com.stop_receive_message()
+        assert not errors and all(p.done_t is not None
+                                  for p in peers.values())
+        return {"peers": n_peers, "broadcast_wall_ms": round(wall_ms, 2),
+                "payload_encodes": shared.encode_count}
+
+    fanout = {"parallel": [], "sequential": []}
+    for n in (2, 4, 8):
+        fanout["sequential"].append(fanout_leg(n, parallel=False))
+        fanout["parallel"].append(fanout_leg(n, parallel=True))
+    speedups = [round(s["broadcast_wall_ms"]
+                      / max(0.01, p["broadcast_wall_ms"]), 1)
+                for s, p in zip(fanout["sequential"], fanout["parallel"])]
+
+    # -- round-close latency: streaming fold vs legacy buffer-all close --
+    n_workers, leaf = 16, (1 << 20)
+    rng = np.random.default_rng(1)
+    reports = [({"w": rng.standard_normal((leaf,)).astype(np.float32)},
+                float(10 + i)) for i in range(n_workers)]
+
+    def agg_leg(streaming: bool) -> dict:
+        agg = FedAvgAggregator(
+            n_workers,
+            aggregate_fn=None if streaming else pt.tree_weighted_mean)
+        out = {}
+        for _warm in range(2):  # round 0 pays the jit; round 1 measures
+            t_add = 0.0
+            for i, (m, w) in enumerate(reports):
+                t0 = time.perf_counter()
+                agg.add_local_trained_result(i, m, w)
+                t_add += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            model = agg.aggregate()
+            jax.block_until_ready(model)
+            close_ms = (time.perf_counter() - t0) * 1e3
+            out = {"adds_total_ms": round(t_add * 1e3, 2),
+                   "close_ms": round(close_ms, 2),
+                   "total_ms": round(t_add * 1e3 + close_ms, 2)}
+        return out
+
+    agg_buffered = agg_leg(streaming=False)
+    agg_streaming = agg_leg(streaming=True)
+
+    # -- trend-gated leg: federation round rate with one straggler silo --
+    delay_ms, rounds, workers = 300.0, 6, 4
+    ds = make_blob_federated(client_num=workers, dim=8, class_num=3,
+                             n_samples=128, seed=11)
+    base = port[0]
+    addresses = {r: (_HOST, base + r) for r in range(workers + 1)}
+    timer = RoundTimer()
+    t0 = time.perf_counter()
+    _, history = run_fedavg_cross_silo(
+        ds, LogisticRegression(num_classes=3), worker_num=workers,
+        comm_round=rounds, train_cfg=TrainConfig(epochs=1, batch_size=8,
+                                                 lr=0.1),
+        backend="TCP", addresses=addresses, timer=timer,
+        fault_plan=(f"seed=3;delay:p=1.0,delay_ms={delay_ms:.0f},"
+                    f"msg_type=2,receiver={workers},direction=recv"),
+        round_deadline_s=30.0, min_quorum_frac=0.5)
+    wall = time.perf_counter() - t0
+    out = {
+        "rounds_per_sec": round(rounds / wall, 3),
+        "fanout_one_stalled_peer": fanout,
+        "fanout_speedup_x_by_peers": speedups,
+        "agg_close_buffered": agg_buffered,
+        "agg_close_streaming": agg_streaming,
+        "close_latency_drop_x": round(
+            agg_buffered["close_ms"] / max(0.01,
+                                           agg_streaming["close_ms"]), 1),
+        "straggler_federation": {
+            "workers": workers, "rounds": len(history),
+            "injected_recv_delay_ms": delay_ms,
+            "bcast_fanout_ms": timer.gauges.get("bcast_fanout_ms"),
+            "agg_fold_ms": timer.gauges.get("agg_fold_ms"),
+            "agg_buffered_peak": timer.gauges.get("agg_buffered_peak"),
+        },
+        "note": "CPU host, loopback TCP. Fan-out legs: one peer stalls "
+                f"its reads {stall_s}s against a {payload_mb} MB "
+                "payload; the sequential leg reconstructs the "
+                "pre-writer-thread path (stalled peer first = "
+                "head-of-line worst case), so its wall time is "
+                "stall-bound while the parallel enqueue stays ~flat in "
+                "peer count — the sublinearity claim, capped by this "
+                "host's loopback. Close legs: the streaming fold "
+                "spreads per-report device adds across arrivals, so "
+                "ROUND-CLOSE latency drops vs the buffer-all "
+                "stack+reduce; total aggregate compute is similar and "
+                "the fold matches the old stacked reduce only to ~1e-6 "
+                "relative (XLA reassociates the stacked sum). The "
+                "trend-gated rounds/sec carries a 300 ms recv-delayed "
+                "straggler: training time dominates it on this host.",
+    }
+    _write_artifact("fanout_agg.json", out)
+    return out
+
+
 def bench_serving() -> dict:
     """The train->serve axis (fedml_tpu/serve): the same federation run
     (a) baseline, no serving, and (b) with the serving tier attached
@@ -2321,6 +2475,9 @@ _STAGES = (
     ("cross_silo_faults", "cross_silo_faults",
      lambda: bench_cross_silo_faults(),
      ("faults", "chaos", "fault_tolerance")),
+    ("fanout_agg", "fanout_agg",
+     lambda: bench_fanout_agg(),
+     ("fanout", "hotpath", "round_hot_path")),
     ("serving", "serving",
      lambda: bench_serving(), ("serve", "inference")),
     ("server_failover", "server_failover",

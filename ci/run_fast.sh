@@ -40,6 +40,14 @@ JAX_PLATFORMS=cpu python -m fedml_tpu.sched smoke --root runs/sched_smoke
 # every sampled cohort member was trace-available, and re-running the
 # same trace seed produced a bit-identical round/cohort ledger
 JAX_PLATFORMS=cpu python -m fedml_tpu.wan --smoke
+# round-hot-path fan-out smoke (fedml_tpu/comm, ~15 s): a real-TCP
+# broadcast against a peer that stalls its reads (kernel backpressure)
+# plus a 4-silo federation with a chaos-delayed silo — exits non-zero
+# unless the round-open broadcast returns in a fraction of the stall,
+# fast peers drain while the slow peer is still wedged, the payload
+# was encoded exactly once, and the chaos run's ledger + final model
+# are bit-identical to the fault-free reference
+JAX_PLATFORMS=cpu python -m fedml_tpu.comm.fanout_smoke
 # federated-serving smoke (fedml_tpu/serve, ~10 s): train a small
 # federation WITH the TCP/JSON inference endpoint attached, drive 50
 # closed-loop requests, and exit non-zero unless at least one hot swap

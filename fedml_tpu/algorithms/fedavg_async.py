@@ -91,11 +91,11 @@ class QuorumFedAvgServerManager(FedAvgServerManager):
             import time as _time
             self.liveness.observe_report_latency(
                 worker, _time.monotonic() - self._bcast_at)
-        with self._device_lock:  # delta decompression is device compute
+        with self._device_lock:  # decompression AND the streaming fold
             payload = self._decode_model_payload(
                 msg.get(MSG_ARG_KEY_MODEL_PARAMS))
-        self.aggregator.add_local_trained_result(
-            worker, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES))
+            self.aggregator.add_local_trained_result(
+                worker, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES))
         if self.aggregator.check_whether_all_receive():
             # all reported: aggregate_available == aggregate, and the
             # flag array was just reset by the barrier check

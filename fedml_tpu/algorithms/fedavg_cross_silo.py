@@ -51,6 +51,7 @@ from fedml_tpu.comm import (ClientManager, Message, ServerManager,
                             create_comm_manager)
 from fedml_tpu.comm.inproc import InProcRouter
 from fedml_tpu.comm.policy import resolve_compression
+from fedml_tpu.comm.serialization import SharedPayload
 from fedml_tpu.core import pytree as pt
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.base import FederatedDataset
@@ -147,14 +148,54 @@ class FedAvgAggregator:
 
     Reference: FedAVGAggregator.py — ``add_local_trained_result`` (:44),
     ``check_whether_all_receive`` (:50), ``aggregate`` (:58), seeded
-    ``client_sampling`` (:89)."""
+    ``client_sampling`` (:89).
+
+    Aggregation is a streaming in-order prefix fold (default path): as
+    each report arrives, the contiguous worker-index prefix is folded
+    into a weighted running sum (``pt.tree_weighted_fold_*``), and only
+    out-of-order arrivals wait in ``model_dict`` — O(out-of-order) host
+    memory instead of O(cohort), and round close shrinks to draining the
+    residual suffix. The overall fold order is ALWAYS ascending worker
+    index (contiguous prefix first, then the sorted remainder at close),
+    so any arrival order, any partial close, and a restore-from-snapshot
+    mid-fold all produce bit-identical results — the fold IS the
+    canonical reduction. (It matches the old stacked
+    ``tree_weighted_mean`` only to float tolerance: XLA reassociates the
+    stacked axis-0 reduce.) A custom ``aggregate_fn`` (order-statistic
+    robust rules need the full cohort) keeps the legacy buffered path.
+    """
 
     def __init__(self, worker_num: int, aggregate_fn=None):
         self.worker_num = worker_num
+        #: streaming path: ONLY the out-of-order / not-yet-folded
+        #: reports; legacy path (custom aggregate_fn): every report
         self.model_dict: Dict[int, object] = {}
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded = [False] * worker_num
+        self._streaming = aggregate_fn is None
         self._aggregate = jax.jit(aggregate_fn or pt.tree_weighted_mean)
+        # per-instance jits (matching _aggregate's style): the fold steps
+        # are THE canonical reduction — every path (incremental, close
+        # drain, restored-from-snapshot) must run these exact programs
+        self._fold_init = jax.jit(pt.tree_weighted_fold_init)
+        self._fold_step = jax.jit(pt.tree_weighted_fold_step)
+        self._fold_finish = jax.jit(pt.tree_fold_finish)
+        #: running weighted sum of the folded prefix (None: nothing folded)
+        self._fold_acc = None
+        #: next contiguous worker index the fold is waiting for
+        self._fold_next = 0
+        #: reports folded so far this round
+        self._fold_count = 0
+        #: f32 running total of folded weights (sequential f32 adds —
+        #: part of the canonical reduction, so snapshots roundtrip it
+        #: exactly via float64)
+        self._fold_total = np.float32(0.0)
+        #: True once any weight > 0 was seen this round; while False the
+        #: fold defers (all-empty-shard rounds close with the uniform
+        #: fallback, which needs the reports unfolded)
+        self._any_pos = False
+        #: peak len(model_dict) this round (the agg_buffered_peak gauge)
+        self.buffered_peak = 0
         #: optional cohort-draw override (``fedml_tpu/wan``: the WAN
         #: world's availability-restricted sampler). None (default) =
         #: the reference seeded stream, byte-identical legacy behavior.
@@ -165,9 +206,25 @@ class FedAvgAggregator:
 
     def add_local_trained_result(self, worker_idx: int, model_params,
                                  sample_num: float) -> None:
+        """Record one report and fold the ready prefix. Device compute
+        happens here (the fold steps), so callers invoke this under the
+        device lock — same contract as decode/aggregate."""
+        if self._streaming and worker_idx < self._fold_next:
+            # already folded into the running sum: a transport-level
+            # duplicate delivers an identical payload, so dropping it
+            # preserves the result; it cannot be un-folded anyway
+            logging.debug("aggregator: duplicate report from folded "
+                          "worker %d ignored", worker_idx)
+            self.flag_client_model_uploaded[worker_idx] = True
+            return
         self.model_dict[worker_idx] = model_params
         self.sample_num_dict[worker_idx] = sample_num
         self.flag_client_model_uploaded[worker_idx] = True
+        if sample_num > 0:
+            self._any_pos = True
+        self.buffered_peak = max(self.buffered_peak, len(self.model_dict))
+        if self._streaming:
+            self._drain_ready()
 
     def check_whether_all_receive(self) -> bool:
         if all(self.flag_client_model_uploaded):
@@ -175,6 +232,64 @@ class FedAvgAggregator:
             return True
         return False
 
+    # -- streaming fold ------------------------------------------------------
+    def _fold_in(self, idx: int, weight=None) -> None:
+        """Fold pending report ``idx`` into the running sum (arrival
+        weight unless the uniform-fallback close overrides it)."""
+        model = self.model_dict.pop(idx)
+        w32 = np.float32(self.sample_num_dict.pop(idx)
+                         if weight is None else weight)
+        wj = jnp.asarray(w32)
+        if self._fold_acc is None:
+            self._fold_acc = self._fold_init(model, wj)
+        else:
+            self._fold_acc = self._fold_step(self._fold_acc, model, wj)
+        self._fold_total = np.float32(self._fold_total + w32)
+        self._fold_count += 1
+
+    def _drain_ready(self) -> None:
+        """Fold the contiguous worker-index prefix now in hand. Deferred
+        until a positive weight is seen: an all-empty-shard round must
+        close with the uniform fallback, which re-weights every report."""
+        if not self._any_pos:
+            return
+        while self._fold_next in self.model_dict:
+            self._fold_in(self._fold_next)
+            self._fold_next += 1
+
+    def _reset_round(self) -> None:
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        self.flag_client_model_uploaded = [False] * self.worker_num
+        self._fold_acc = None
+        self._fold_next = 0
+        self._fold_count = 0
+        self._fold_total = np.float32(0.0)
+        self._any_pos = False
+        self.buffered_peak = 0
+
+    def _close_streaming(self):
+        """Drain the residual suffix and normalize. Pending keys are all
+        >= the folded prefix, so draining them sorted makes the overall
+        fold order ``sorted(reported)`` — identical for every arrival
+        order and for a mid-fold snapshot restore."""
+        if self._fold_count == 0 and not self.model_dict:
+            raise ValueError("aggregate on an empty round: no reports")
+        # recomputed (not just self._any_pos): restored snapshots and
+        # tests inject pending reports directly into model_dict
+        uniform = self._fold_count == 0 and \
+            not any(w > 0 for w in self.sample_num_dict.values())
+        for i in sorted(self.model_dict):
+            # uniform fallback (every reporter had an empty shard):
+            # weight 1.0 — ``x * 1.0`` is bitwise ``x``, so the fallback
+            # is the SAME fold with unit weights, not a separate path
+            self._fold_in(i, weight=1.0 if uniform else None)
+        out = self._fold_finish(self._fold_acc,
+                                jnp.asarray(self._fold_total))
+        self._reset_round()
+        return out
+
+    # -- legacy buffered close (custom aggregate_fn) -------------------------
     def _close(self, idxs):
         stacked = pt.tree_stack([self.model_dict[i] for i in idxs])
         weights = np.asarray([self.sample_num_dict[i] for i in idxs],
@@ -190,16 +305,28 @@ class FedAvgAggregator:
         return out
 
     def aggregate(self):
+        if self._streaming:
+            return self._close_streaming()
         return self._close(range(self.worker_num))
+
+    def reported_set(self) -> set:
+        """Workers whose report is in hand for the open round — folded
+        prefix plus pending buffer (the old ``set(model_dict)``)."""
+        return set(range(self._fold_next)) | set(self.model_dict)
+
+    def has_reported(self, worker_idx: int) -> bool:
+        return worker_idx < self._fold_next or worker_idx in self.model_dict
 
     def received_count(self) -> int:
         """Updates in hand for the open round (quorum checks)."""
-        return len(self.model_dict)
+        return self._fold_count + len(self.model_dict)
 
     def aggregate_available(self):
         """Weighted mean over whichever workers reported this round, then
         reset — the straggler-tolerant close (quorum rounds). Equal to
         :meth:`aggregate` when everyone reported."""
+        if self._streaming:
+            return self._close_streaming()
         return self._close(sorted(self.model_dict))
 
     def client_sampling(self, round_idx: int, client_num_in_total: int,
@@ -388,8 +515,12 @@ class FedAvgServerManager(ServerManager):
         agg = self.aggregator
         with self._device_lock:  # D2H transfers are device dispatches
             gm = fser.to_state_dict(_to_numpy(self.global_model))
+            # the streaming aggregator's pending buffer holds only the
+            # not-yet-folded reports; the folded prefix rides in agg_fold
             pending = {str(w): fser.to_state_dict(_to_numpy(m))
                        for w, m in agg.model_dict.items()}
+            fold_acc = (fser.to_state_dict(_to_numpy(agg._fold_acc))
+                        if agg._fold_acc is not None else None)
         state = {
             "round_idx": int(self.round_idx),
             "comm_round": int(self.comm_round),
@@ -409,6 +540,18 @@ class FedAvgServerManager(ServerManager):
             "pending_models": pending,
             "pending_weights": {str(w): float(v)
                                 for w, v in agg.sample_num_dict.items()},
+            # mid-fold state: the running weighted sum, the contiguous
+            # prefix bound, and the f32 weight total (exact through
+            # float64 — f32 -> f64 -> f32 roundtrips bit-identically),
+            # so a restored server resumes the fold where it stopped and
+            # closes bit-identical to the unkilled reference
+            "agg_fold": {
+                "next": int(agg._fold_next),
+                "count": int(agg._fold_count),
+                "total": float(agg._fold_total),
+                "any_pos": bool(agg._any_pos),
+                "acc": fold_acc,
+            },
             "uploaded_flags": [bool(f)
                                for f in agg.flag_client_model_uploaded],
             "live_history": self.live_history,
@@ -465,6 +608,27 @@ class FedAvgServerManager(ServerManager):
                                for w, v in state["pending_weights"].items()}
         agg.flag_client_model_uploaded = [
             bool(f) for f in state["uploaded_flags"]]
+        fold = state.get("agg_fold")
+        if fold is not None:
+            agg._fold_next = int(fold["next"])
+            agg._fold_count = int(fold["count"])
+            agg._fold_total = np.float32(fold["total"])
+            agg._any_pos = bool(fold["any_pos"])
+            # like pending models, the acc restores as a plain dict of
+            # numpy arrays — bit-identical leaves, so resuming the fold
+            # continues the canonical reduction exactly
+            agg._fold_acc = fold["acc"]
+        else:
+            # pre-fold snapshot format: every report is pending; the
+            # close drain refolds them in sorted order, which the fold
+            # contract makes equal to the streaming result
+            agg._fold_acc = None
+            agg._fold_next = 0
+            agg._fold_count = 0
+            agg._fold_total = np.float32(0.0)
+            agg._any_pos = any(w > 0
+                               for w in agg.sample_num_dict.values())
+        agg.buffered_peak = len(agg.model_dict)
         self.live_history = list(state["live_history"] or [])
         self.ft_counters.update(
             {k: int(v) for k, v in (state["ft_counters"] or {}).items()})
@@ -539,7 +703,7 @@ class FedAvgServerManager(ServerManager):
                     "schedule mid-flight",
                     self._server_ckpt.directory, self.round_idx,
                     sorted(self.liveness.live_workers()),
-                    len(self.aggregator.model_dict))
+                    self.aggregator.received_count())
             # latch AFTER success: if the restore refused (format or
             # schedule mismatch), the racing other entry point (run vs
             # send_init_msg) must retry and re-raise the refusal loudly
@@ -732,25 +896,61 @@ class FedAvgServerManager(ServerManager):
             tm.begin_round(self.round_idx)
         if self.obs is not None:
             self.obs.round_begin(self.round_idx)
+        # ONE encode for the whole fan-out: every per-peer frame splices
+        # the cached header+buffers and contributes only its envelope
+        # keys. A fresh wrapper per broadcast is the cache invalidation —
+        # round r+1's payload can never reuse round r's frames.
+        shared = SharedPayload(payload)
+        msgs = []
         for worker in range(1, self.size):
             if self._evict_on_deadline and (worker - 1) not in live:
                 continue
             msg = Message(msg_type, self.rank, worker)
-            msg.add(MSG_ARG_KEY_MODEL_PARAMS, payload)
+            msg.add(MSG_ARG_KEY_MODEL_PARAMS, shared)
             msg.add(MSG_ARG_KEY_CLIENT_INDEX, int(idxs[worker - 1]))
             msg.add(MSG_ARG_KEY_ROUND, self.round_idx)
             msg.add(MSG_ARG_KEY_BCAST_SEQ, self._bcast_seq)
-            try:
-                self.send_message(msg)
-            except OSError as exc:
-                if not self._evict_on_deadline:
-                    raise
-                if self.liveness.evict(worker - 1):
-                    self._worker_base.pop(worker - 1, None)
-                    logging.warning(
-                        "broadcast to silo %d failed after transport "
-                        "retries (%r) — EVICTED from the live set; it "
-                        "re-admits via JOIN", worker, exc)
+            msgs.append(msg)  # ft: allow[FT008] one envelope per live silo, dropped at loop exit — bounded by silo count, not population
+        bcast = getattr(self.com_manager, "broadcast", None)
+        t0 = time.monotonic()
+        if bcast is not None:
+            # overlapped fan-out: enqueue on per-peer writer threads and
+            # return; a peer whose queue overflows or whose retries
+            # exhaust is evicted from the writer thread via on_error.
+            # Without FT mode there is no eviction path, so on_error
+            # stays None and the first failure propagates (sequentially,
+            # matching the legacy loop).
+            stats = bcast(msgs, on_error=(self._on_broadcast_send_error
+                                          if self._evict_on_deadline
+                                          else None))
+        else:
+            # backend without a broadcast API (duck-typed stubs): the
+            # legacy sequential loop, same eviction semantics
+            stats = {"max_queue_depth": 0}
+            for msg in msgs:
+                try:
+                    self.send_message(msg)
+                except OSError as exc:
+                    if not self._evict_on_deadline:
+                        raise
+                    self._on_broadcast_send_error(msg.get_receiver_id(),
+                                                  exc)
+        if tm is not None:
+            tm.gauge("bcast_fanout_ms", (time.monotonic() - t0) * 1e3)
+            tm.gauge("send_queue_depth", stats["max_queue_depth"])
+
+    def _on_broadcast_send_error(self, worker_rank: int, exc) -> None:
+        """Per-peer broadcast failure -> eviction. MAY run on a comm
+        writer thread (overlapped fan-out): evict() is internally locked,
+        and the _worker_base pop is a GIL-atomic dict op; a silo that
+        slips past an in-flight round's cohort is swept by the deadline
+        path, which re-checks liveness."""
+        if self.liveness.evict(worker_rank - 1):
+            self._worker_base.pop(worker_rank - 1, None)
+            logging.warning(
+                "broadcast to silo %d failed after transport "
+                "retries (%r) — EVICTED from the live set; it "
+                "re-admits via JOIN", worker_rank, exc)
 
     def _note_worker_base(self, msg: Message) -> None:
         """Record which model version/structure the silo reports holding
@@ -835,11 +1035,18 @@ class FedAvgServerManager(ServerManager):
                 "reply and forcing a full-precision rebase", worker + 1,
                 self.round_idx, exc_info=True)
             return
-        self.aggregator.add_local_trained_result(
-            worker, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES))
+        t0 = time.monotonic()
+        with self._device_lock:  # the streaming fold is device compute
+            self.aggregator.add_local_trained_result(
+                worker, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES))
+        tm = getattr(self, "round_timer", None)
+        if tm is not None:
+            # slowest incremental fold this run; close-drain is gauged
+            # into the same metric by _close_round
+            tm.gauge("agg_fold_ms", (time.monotonic() - t0) * 1e3)
         if self._evict_on_deadline:
             live = self.liveness.live_workers()
-            reported = set(self.aggregator.model_dict)
+            reported = self.aggregator.reported_set()
             if live <= reported:
                 self._close_round(partial=len(reported) < self.worker_num)
             return
@@ -877,7 +1084,7 @@ class FedAvgServerManager(ServerManager):
         # a protocol property; multi-process deployments (one device per
         # silo) close at the deadline proper.
         self._cancel_deadline()
-        reported = sorted(self.aggregator.model_dict)
+        reported = sorted(self.aggregator.reported_set())
         live_n = (len(self.liveness.live_workers())
                   if self._evict_on_deadline else self.worker_num)
         if self._evict_on_deadline:
@@ -888,8 +1095,17 @@ class FedAvgServerManager(ServerManager):
                 "partial": bool(partial)})
             if partial:
                 self.ft_counters["partial_rounds"] += 1
+        buffered_peak = self.aggregator.buffered_peak
+        t0 = time.monotonic()
         with self._device_lock:
             self.global_model = self._aggregate_round(partial=partial)
+        tm = getattr(self, "round_timer", None)
+        if tm is not None:
+            # the close is just the residual-suffix drain + normalize
+            # under the streaming fold — the latency the old buffered
+            # stack-reduce paid here is what fanout_agg measures
+            tm.gauge("agg_fold_ms", (time.monotonic() - t0) * 1e3)
+            tm.gauge("agg_buffered_peak", buffered_peak)
         if self.on_round_done is not None:
             # outside the lock: eval re-locks internally, sink I/O doesn't
             self.on_round_done(self.round_idx, self.global_model)
@@ -1042,7 +1258,7 @@ class FedAvgServerManager(ServerManager):
         if not self._evict_on_deadline:
             return
         live = self.liveness.live_workers()
-        reported = set(self.aggregator.model_dict)
+        reported = self.aggregator.reported_set()
         if self._wan is not None:
             # the trace IS the availability oracle: a live silo whose
             # device is offline at this round can never report, so it
@@ -1152,7 +1368,7 @@ class FedAvgServerManager(ServerManager):
         worker = msg.get_sender_id() - 1
         done = msg.get_params().get(MSG_ARG_KEY_ROUNDS_COMPLETED, None)
         if self.liveness.is_live(worker) \
-                and worker in self.aggregator.model_dict:
+                and self.aggregator.has_reported(worker):
             # a live silo that already reported this round is just waiting
             # out the deadline with us — it is not lost, so no resync
             # (which would only trigger a redundant retrain)

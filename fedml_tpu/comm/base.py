@@ -208,6 +208,37 @@ class BaseCommunicationManager(abc.ABC):
     def send_message(self, msg: Message) -> None:
         ...
 
+    def broadcast(self, msgs, on_error=None) -> Dict[str, int]:
+        """Send one message per peer, surfacing per-peer failures without
+        aborting the rest of the fan-out.
+
+        Contract: with ``on_error`` set, a peer's failure (``OSError``
+        family, which includes ``TransportError``) is reported as
+        ``on_error(receiver_id, exc)`` and the remaining sends proceed —
+        the caller's eviction path replaces the raise. ``on_error`` MAY be
+        invoked on a writer thread (overlapped backends) and MAY arrive
+        after this call returns; callers synchronize their own state.
+        Without ``on_error`` the first failure propagates, matching a
+        plain ``send_message`` loop.
+
+        This default runs sequentially (correct for object hand-off and
+        wrapper backends); overlapped transports override it to enqueue on
+        per-peer writer threads and return after enqueue. Returns fan-out
+        stats: ``enqueued`` (messages accepted) and ``max_queue_depth``
+        (peak per-peer send-queue depth observed; 0 when sends complete
+        inline).
+        """
+        enqueued = 0
+        for msg in msgs:
+            try:
+                self.send_message(msg)
+            except OSError as exc:
+                if on_error is None:
+                    raise
+                on_error(msg.get_receiver_id(), exc)
+            enqueued += 1
+        return {"enqueued": enqueued, "max_queue_depth": 0}
+
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
 

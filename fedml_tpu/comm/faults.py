@@ -367,6 +367,23 @@ class FaultyCommManager:
             return
         self._apply_send(msg, rule)
 
+    def broadcast(self, msgs, on_error=None) -> Dict[str, int]:
+        """Fan-out THROUGH the fault engine: each per-peer message takes
+        the wrapper's own send path (so drop/delay/corrupt rules apply per
+        peer), sequentially — chaos runs trade fan-out overlap for
+        deterministic fault application. Same per-peer error contract as
+        ``BaseCommunicationManager.broadcast``."""
+        enqueued = 0
+        for msg in msgs:
+            try:
+                self.send_message(msg)
+            except OSError as exc:
+                if on_error is None:
+                    raise
+                on_error(msg.get_receiver_id(), exc)
+            enqueued += 1
+        return {"enqueued": enqueued, "max_queue_depth": 0}
+
     def add_observer(self, observer) -> None:
         self._observers.append(observer)
 

@@ -54,7 +54,13 @@ class Message:
     add_params = add
 
     def get(self, key: str) -> Any:
-        return self.msg_params[key]
+        value = self.msg_params[key]
+        if isinstance(value, serialization.SharedPayload):
+            # in-proc object hand-off skips the wire codec, so the
+            # broadcast wrapper survives to the receiver — unwrap here
+            # so handlers never see the cache layer
+            return value.value
+        return value
 
     def get_params(self) -> Dict[str, Any]:
         return self.msg_params

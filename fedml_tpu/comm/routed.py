@@ -84,10 +84,15 @@ class RoutedCommManager(BaseCommunicationManager):
         self._reader: Optional[threading.Thread] = None
 
     def send_message(self, msg: Message) -> None:
-        frame = msg.to_bytes()
+        # parts, not one joined frame: a broadcast's shared payload rides
+        # as cached buffer views and a multi-hundred-MB model update never
+        # materializes as a contiguous copy on the send path
+        parts = msg.to_parts()
+        total = sum(len(p) for p in parts)
         with self._send_lock:
-            self._sock.sendall(_HDR.pack(msg.get_receiver_id(), len(frame)))
-            self._sock.sendall(frame)
+            self._sock.sendall(_HDR.pack(msg.get_receiver_id(), total))
+            for part in parts:
+                self._sock.sendall(part)
 
     def _read_loop(self) -> None:
         try:

@@ -16,7 +16,8 @@ Scalars, strings, bools and None ride in the header itself.
 from __future__ import annotations
 
 import struct
-from typing import Any, List
+import threading
+from typing import Any, List, Optional, Tuple
 
 import msgpack
 import numpy as np
@@ -24,7 +25,68 @@ import numpy as np
 _LEAF = "__leaf__"  # marker: {"__leaf__": buffer_index, "dtype", "shape"}
 
 
+class SharedPayload:
+    """Encode-once wrapper for a payload fanned out to N peers.
+
+    A broadcast sends ONE model pytree to every silo, but each per-peer
+    ``Message`` re-walked and re-encoded the whole tree — O(N * model)
+    header/copy work per round. Wrapping the payload in a SharedPayload
+    makes ``_encode`` splice the cached (spec, buffers) pair instead of
+    re-walking: the tree is encoded exactly once per wrapper instance,
+    per-peer frames differ only in their small envelope keys, and the
+    emitted bytes are identical to the uncached encoder's output (the
+    dedup/replay layer keys on frame content, so byte-parity is load-
+    bearing, not cosmetic). Cache invalidation is by construction: each
+    round's ``_broadcast_model`` wraps a fresh instance.
+
+    Thread-safe: concurrent ``to_parts`` calls (per-peer writer threads)
+    race to encode; the lock makes the first one win and the rest reuse.
+    """
+
+    __slots__ = ("value", "_lock", "_spec", "_buffers", "encode_count")
+
+    def __init__(self, value: Any):
+        self.value = value
+        self._lock = threading.Lock()
+        self._spec: Optional[Any] = None
+        self._buffers: Optional[List[bytes]] = None
+        self.encode_count = 0  # test hook: encodes actually performed
+
+    def _encoded(self) -> Tuple[Any, List[bytes]]:
+        with self._lock:
+            if self._spec is None:
+                buffers: List[bytes] = []
+                self._spec = _encode(self.value, buffers)
+                self._buffers = buffers
+                self.encode_count += 1
+            return self._spec, self._buffers
+
+
+def _rebase(spec: Any, base: int) -> Any:
+    """Copy of ``spec`` with every ``_LEAF`` buffer index shifted by
+    ``base`` — needed when a cached subtree is spliced into a frame that
+    already emitted buffers before it."""
+    t = spec["t"]
+    if t == "d":
+        return {"t": "d", "k": spec["k"],
+                "v": [_rebase(v, base) for v in spec["v"]]}
+    if t in ("l", "u"):
+        return {"t": t, "v": [_rebase(v, base) for v in spec["v"]]}
+    if t == "a":
+        out = dict(spec)
+        out[_LEAF] = spec[_LEAF] + base
+        return out
+    return spec
+
+
 def _encode(obj: Any, buffers: List[bytes]) -> Any:
+    if isinstance(obj, SharedPayload):
+        spec, bufs = obj._encoded()
+        base = len(buffers)
+        buffers.extend(bufs)
+        # envelope keys are scalars, so base is 0 in practice and the
+        # cached spec embeds as-is; rebase covers arrays-before-payload
+        return spec if base == 0 else _rebase(spec, base)
     if isinstance(obj, dict):
         return {"t": "d", "k": list(obj.keys()),
                 "v": [_encode(v, buffers) for v in obj.values()]}

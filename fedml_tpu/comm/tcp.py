@@ -26,7 +26,7 @@ from typing import Dict, Optional, Tuple
 
 from fedml_tpu.comm.base import WIRE_JOB_KEY, BaseCommunicationManager
 from fedml_tpu.comm.message import Message
-from fedml_tpu.comm.reliable import RetryPolicy, retry_call
+from fedml_tpu.comm.reliable import RetryPolicy, TransportError, retry_call
 
 _LEN = struct.Struct("<Q")
 _STOP = object()
@@ -35,6 +35,12 @@ _CHUNK = 1 << 20  # per-recv_into slice; bounds kernel copy granularity
 #: a connect attempt must not block a send slot unboundedly — failed
 #: connects feed the retry loop, which owns the waiting
 _CONNECT_TIMEOUT_S = 30.0
+
+#: per-peer send-queue bound: deep enough to absorb a round's burst of
+#: frames to one peer, shallow enough that a wedged peer sheds loudly
+#: (overflow → TransportError → the caller's eviction path) instead of
+#: buffering a round's worth of model bytes per dead silo
+_SEND_QUEUE_DEPTH = 64
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -77,18 +83,50 @@ def recv_frame(sock: socket.socket) -> bytearray:
     return _recv_exact(sock, size)
 
 
+class _SendItem:
+    """One queued frame. Synchronous senders wait on ``done`` and re-raise
+    ``error``; broadcast senders pass ``on_error`` instead and never wait."""
+
+    __slots__ = ("frame", "nbytes", "job", "done", "error", "on_error",
+                 "receiver")
+
+    def __init__(self, frame, nbytes: int, job, wait: bool,
+                 on_error=None, receiver=None):
+        self.frame = frame
+        self.nbytes = nbytes
+        self.job = job
+        self.done = threading.Event() if wait else None
+        self.error: Optional[BaseException] = None
+        self.on_error = on_error
+        self.receiver = receiver
+
+
 class _Peer:
-    """A cached outbound connection with its own I/O lock, so sends to
-    different peers never serialize behind each other (or behind one slow
-    connect)."""
+    """A cached outbound connection with its own I/O lock and a bounded
+    send queue drained by a dedicated writer thread: sends to different
+    peers overlap, and a broadcast's round thread returns after enqueue
+    instead of waiting out every peer's TCP backpressure in turn.
+
+    Every send routes through the queue (synchronous senders block on the
+    item's ``done`` event), so frames to one peer stay FIFO — a direct
+    send can never jump an in-flight broadcast frame on the stream.
+    """
 
     def __init__(self, address: Tuple[str, int], retry: RetryPolicy,
-                 bump=None):
+                 bump=None, on_sent=None,
+                 queue_depth: int = _SEND_QUEUE_DEPTH):
         self.address = address
         self.retry = retry
         self.lock = threading.Lock()
         self.sock: socket.socket | None = None
         self._bump = bump or (lambda name, n=1, job=None: None)
+        self._on_sent = on_sent or (lambda nbytes, job=None: None)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        self._closed = False
+        self._writer = threading.Thread(
+            target=self._writer_loop, daemon=True,
+            name=f"tcp-writer-{address[0]}:{address[1]}")
+        self._writer.start()
 
     def _send_once(self, frame) -> None:
         """One attempt: (re)connect if needed, write the frame. A failed
@@ -125,7 +163,103 @@ class _Peer:
                 on_retry=lambda attempt, exc: self._bump("retries",
                                                          job=job))
 
+    # -- send queue ---------------------------------------------------------
+    def _fail(self, item: _SendItem, exc: BaseException) -> None:
+        item.error = exc
+        if item.on_error is not None:
+            try:
+                item.on_error(item.receiver, exc)
+            except Exception:
+                logging.exception("tcp peer %s: broadcast on_error "
+                                  "callback raised", self.address)
+        if item.done is not None:
+            item.done.set()
+
+    def _process(self, item: _SendItem) -> None:
+        try:
+            self.send(item.frame, job=item.job)
+        except OSError as exc:
+            self._fail(item, exc)
+        else:
+            self._on_sent(item.nbytes, item.job)
+            if item.done is not None:
+                item.done.set()
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _STOP:
+                break
+            self._process(item)
+        # shed anything that raced past close(): never strand a waiter
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                self._fail(item, TransportError(
+                    f"peer {self.address} closed", transient=False))
+
+    def enqueue(self, frame, job=None) -> None:
+        """Synchronous send THROUGH the queue: stays FIFO with any
+        in-flight broadcast frames to this peer, then waits out the write
+        (blocking if the queue is momentarily full) and re-raises its
+        error — same contract as a direct :meth:`send`."""
+        if self._closed:
+            raise TransportError(f"peer {self.address} closed",
+                                 transient=False)
+        nbytes = (len(frame)
+                  if isinstance(frame, (bytes, bytearray, memoryview))
+                  else sum(len(p) for p in frame))
+        item = _SendItem(frame, nbytes, job, wait=True)
+        self._queue.put(item)
+        item.done.wait()
+        if item.error is not None:
+            raise item.error
+
+    def enqueue_nowait(self, frame, job, on_error, receiver) -> int:
+        """Broadcast fan-out: enqueue and return immediately. A full
+        queue (wedged peer) or a later exhausted-retry failure surfaces
+        through ``on_error(receiver, exc)`` with a ``TransportError`` —
+        the same OSError family as the blocking path, so the caller's
+        eviction logic is shared. Returns the observed queue depth."""
+        nbytes = (len(frame)
+                  if isinstance(frame, (bytes, bytearray, memoryview))
+                  else sum(len(p) for p in frame))
+        item = _SendItem(frame, nbytes, job, wait=False,
+                         on_error=on_error, receiver=receiver)
+        if self._closed:
+            self._fail(item, TransportError(
+                f"peer {self.address} closed", transient=False))
+            return 0
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            self._bump("send_queue_overflows", job=job)
+            self._fail(item, TransportError(
+                f"send queue to {self.address[0]}:{self.address[1]} "
+                f"overflowed ({self._queue.maxsize} frames pending) — "
+                "peer is not draining", transient=True))
+        return self._queue.qsize()
+
     def close(self) -> None:
+        # stop the writer first: drain pending items (erroring their
+        # waiters — a send queued behind a closing peer must not hang),
+        # then the sentinel; the writer's final drain sheds stragglers
+        self._closed = True
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                self._fail(item, TransportError(
+                    f"peer {self.address} closed", transient=False))
+        try:
+            self._queue.put_nowait(_STOP)
+        except queue.Full:
+            pass
         with self.lock:
             if self.sock is not None:
                 try:
@@ -163,22 +297,50 @@ class TcpCommManager(BaseCommunicationManager):
         self._running = False
         self._accept_thread: threading.Thread | None = None
 
-    def send_message(self, msg: Message) -> None:
-        dest = msg.get_receiver_id()
+    def _peer_for(self, dest: int) -> _Peer:
         with self._peers_lock:  # dict access only; I/O under the peer lock
             peer = self._peers.get(dest)
             if peer is None:
-                peer = self._peers[dest] = _Peer(self.addresses[dest],
-                                                 self.retry, bump=self.bump)
+                peer = self._peers[dest] = _Peer(
+                    self.addresses[dest], self.retry, bump=self.bump,
+                    on_sent=self._count_sent)
+        return peer
+
+    def send_message(self, msg: Message) -> None:
+        peer = self._peer_for(msg.get_receiver_id())
         # stamp BEFORE encoding: every retry ships the identical frame,
         # so the receiver's dedup recognizes the duplicate
         self._stamp_seq(msg)
         # parts, not one joined frame: a model update goes header-then-
         # buffers straight to the socket with no contiguous copy
         parts = msg.to_parts()
-        peer.send(parts, job=msg.msg_params.get(WIRE_JOB_KEY))
-        self._count_sent(sum(len(p) for p in parts),
-                         msg.msg_params.get(WIRE_JOB_KEY))
+        # through the peer's queue (blocking on completion), so frames to
+        # one peer stay FIFO with any in-flight broadcast; wire bytes are
+        # credited by the writer on successful send
+        peer.enqueue(parts, job=msg.msg_params.get(WIRE_JOB_KEY))
+
+    def broadcast(self, msgs, on_error=None) -> Dict[str, int]:
+        """Overlapped fan-out: encode (once, via the shared-payload
+        cache), stamp, and enqueue every frame on its peer's writer
+        thread — this returns after enqueue, while N sends proceed in
+        parallel. Per-peer failures (queue overflow, exhausted retries)
+        surface through ``on_error`` on the writer thread; without
+        ``on_error`` the sequential base implementation runs instead, so
+        errors can propagate to the caller."""
+        if on_error is None:
+            return super().broadcast(msgs)
+        enqueued = 0
+        max_depth = 0
+        for msg in msgs:
+            dest = msg.get_receiver_id()
+            peer = self._peer_for(dest)
+            self._stamp_seq(msg)
+            parts = msg.to_parts()
+            depth = peer.enqueue_nowait(
+                parts, msg.msg_params.get(WIRE_JOB_KEY), on_error, dest)
+            max_depth = max(max_depth, depth)
+            enqueued += 1
+        return {"enqueued": enqueued, "max_queue_depth": max_depth}
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:

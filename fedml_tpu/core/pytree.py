@@ -68,6 +68,36 @@ def tree_mean(stacked):
     return jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked)
 
 
+# -- streaming weighted fold --------------------------------------------------
+# The three steps of a weighted mean computed as an in-order left fold:
+#   acc = init(x_0, w_0); acc = step(acc, x_i, w_i) ...; out = finish(acc, W)
+# Folding updates one at a time (as they ARRIVE) instead of stacking the
+# cohort is what lets the server aggregate incrementally with O(1) live
+# state. The fold is the CANONICAL reduction: any two evaluation
+# strategies that apply these same jitted steps in the same index order
+# produce bit-identical accumulators — which is the streaming
+# aggregator's parity contract. (It is NOT bit-identical to
+# ``tree_weighted_mean``'s stacked ``jnp.sum(axis=0)``: XLA reassociates
+# that reduction — pairwise/SIMD — so the two agree only to float
+# tolerance, ~1e-6 relative for f32.)
+
+def tree_weighted_fold_init(x, w):
+    """First fold term: ``x * w`` per leaf. Deliberately NOT zeros+add —
+    ``0.0 + (-0.0)`` is ``+0.0``, so seeding with zeros would flip signed
+    zeros and break the fold's bit-reproducibility contract."""
+    return jax.tree.map(lambda l: l * w.astype(l.dtype), x)
+
+
+def tree_weighted_fold_step(acc, x, w):
+    """Fold one update in: ``acc + x * w`` per leaf, in arrival order."""
+    return jax.tree.map(lambda a, l: a + l * w.astype(l.dtype), acc, x)
+
+
+def tree_fold_finish(acc, total):
+    """Normalize the folded sum by the total weight."""
+    return jax.tree.map(lambda a: a / total.astype(a.dtype), acc)
+
+
 def tree_stack(trees):
     """Stack a list of congruent pytrees along a new leading axis."""
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)

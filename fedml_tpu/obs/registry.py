@@ -68,6 +68,24 @@ METRICS: Dict[str, Dict[str, str]] = {
     "comm_bytes_down": _m(KIND_COUNTER, "comm",
                           "server->client wire bytes, actual encoded "
                           "frame lengths"),
+    # -- server round hot path (serialize-once broadcast + streaming fold) -
+    "bcast_fanout_ms": _m(KIND_GAUGE, "comm",
+                          "slowest round-open broadcast fan-out: wall "
+                          "time from first enqueue to the round thread "
+                          "regaining control (NOT wire drain — the "
+                          "per-peer writer threads absorb slow links)"),
+    "send_queue_depth": _m(KIND_GAUGE, "comm",
+                           "peak per-peer send-queue depth observed at "
+                           "broadcast enqueue (bounded queue; overflow "
+                           "sheds the peer through the eviction path)"),
+    "agg_fold_ms": _m(KIND_GAUGE, "round pipeline",
+                      "slowest streaming-fold step (decode + in-order "
+                      "prefix fold of one reply, or the round-close "
+                      "drain of the out-of-order buffer)"),
+    "agg_buffered_peak": _m(KIND_GAUGE, "round pipeline",
+                            "peak out-of-order reply buffer size held by "
+                            "the streaming aggregator (contiguous-prefix "
+                            "replies fold immediately and never buffer)"),
     # -- fault tolerance (PR-5 layer; rolled up by launch_federation) ------
     "ft_retries": _m(KIND_COUNTER, "fault tolerance",
                      "transport send retries across every endpoint"),

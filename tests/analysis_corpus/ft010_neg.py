@@ -1,6 +1,7 @@
 """FT010 negative: the same two-root shape, but every access to the
 shared flags holds one common lock (plus a single-root counter, which
 is never a finding)."""
+import queue
 import threading
 import time
 
@@ -37,3 +38,32 @@ class Manager:
             if not busy and idle > 30.0:
                 return idle
             time.sleep(1.0)
+
+
+class PeerFanout:
+    """The broadcast fan-out shape done RIGHT: the round thread hands
+    frames to the per-peer writer through a bounded queue.Queue (its own
+    internal lock is the synchronization); every other attribute is
+    touched from a single root only."""
+
+    def __init__(self):
+        self._queue = queue.Queue(maxsize=8)
+        self._sent = 0  # writer-root-only: no cross-thread access
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        daemon=True)
+        self._writer.start()
+
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(2, self.handle_round_open)
+
+    def handle_round_open(self, msg):
+        self._queue.put_nowait(msg)  # queue hand-off IS the lock
+
+    def _writer_loop(self):
+        while True:
+            frame = self._queue.get()
+            self._sent += 1
+            del frame

@@ -31,3 +31,33 @@ class Manager:
             if not self._busy and idle > 30.0:
                 return idle
             time.sleep(1.0)
+
+
+class PeerFanout:
+    """The broadcast fan-out shape done WRONG: the round thread (receive
+    root) hands frames to a per-peer writer thread through a bare list
+    and a shared error slot — both mutated from two roots, no lock."""
+
+    def __init__(self):
+        self._pending = []
+        self._last_error = None
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        daemon=True)
+        self._writer.start()
+
+    def register_message_receive_handler(self, msg_type, handler):
+        """Stub of the comm-layer registration (AST-only corpus)."""
+
+    def run(self):
+        self.register_message_receive_handler(2, self.handle_round_open)
+
+    def handle_round_open(self, msg):
+        self._pending.append(msg)  # unguarded hand-off to the writer
+        self._last_error = None    # racing the writer's error report
+
+    def _writer_loop(self):
+        while True:
+            if self._pending:
+                frame = self._pending.pop(0)  # racing handle_round_open
+                self._last_error = frame
+            time.sleep(0.01)

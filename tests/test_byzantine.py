@@ -41,8 +41,11 @@ class TestMedianTrimmed:
         agg = trimmed_mean(stacked, trim_ratio=0.0)
         mean = jax.tree.map(lambda l: jnp.mean(l, axis=0), stacked)
         for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(mean)):
+            # zero-trim sorts then sums while jnp.mean sums in input
+            # order; XLA reassociates both, so they agree only to float
+            # tolerance (observed ~2e-6 relative on CPU f32)
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=1e-6)
+                                       rtol=1e-5)
 
     def test_trimmed_mean_overtrim_rejected(self):
         _, stacked = _stacked_with_outlier(c=4)

@@ -506,7 +506,15 @@ class TestDeadlineExtensionCap:
         assert snap is not None
         assert snap["round_idx"] == 0  # the round that could not close
         assert snap["extensions_this_round"] >= 3
-        assert sorted(int(w) for w in snap["pending_models"]) == [0, 1]
+        # the streaming fold absorbs the contiguous worker-index prefix
+        # as it arrives: workers 0 and 1 live in the snapshot as fold
+        # state (running sum + prefix bound), not as pending models
+        fold = snap["agg_fold"]
+        reported = sorted(set(range(int(fold["next"])))
+                          | {int(w) for w in snap["pending_models"]})
+        assert reported == [0, 1]
+        assert int(fold["count"]) == 2  # both folded: the prefix was ready
+        assert fold["acc"] is not None
 
     def test_steered_quorum_never_demands_every_live_silo(self):
         """ceil(0.9 * 3) == 3, so the steered fraction alone would

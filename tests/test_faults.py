@@ -420,21 +420,43 @@ class TestChaosParity:
 # ---------------------------------------------------------------------------
 class RecordingAggregator(FedAvgAggregator):
     """Snapshots every close's (reporters, models, weights) so tests can
-    verify the weighted-partial math against an independent oracle."""
+    verify the weighted-partial math against an independent oracle.
+
+    Reports are recorded AS THEY ARRIVE: the streaming fold consumes the
+    pending buffer incrementally, so by close time ``model_dict`` holds
+    only the out-of-order residue — the full cohort is only observable
+    at add time."""
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
         self.closes = []
+        self._round_models = {}
+        self._round_weights = {}
 
-    def _close(self, idxs):
-        idxs = list(idxs)
+    def add_local_trained_result(self, worker_idx, model_params,
+                                 sample_num):
+        self._round_models[worker_idx] = jax.tree.map(np.asarray,
+                                                      model_params)
+        self._round_weights[worker_idx] = sample_num
+        super().add_local_trained_result(worker_idx, model_params,
+                                         sample_num)
+
+    def _snap_close(self):
         self.closes.append({
-            "reported": sorted(self.model_dict),
-            "models": {i: jax.tree.map(np.asarray, self.model_dict[i])
-                       for i in self.model_dict},
-            "weights": dict(self.sample_num_dict),
+            "reported": sorted(self._round_models),
+            "models": dict(self._round_models),
+            "weights": dict(self._round_weights),
         })
-        return super()._close(idxs)
+        self._round_models = {}
+        self._round_weights = {}
+
+    def aggregate(self):
+        self._snap_close()
+        return super().aggregate()
+
+    def aggregate_available(self):
+        self._snap_close()
+        return super().aggregate_available()
 
 
 def _numpy_weighted_mean(models, weights):

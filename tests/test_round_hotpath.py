@@ -1,0 +1,350 @@
+"""Server round hot path: the serialize-once broadcast frame cache and
+the streaming in-order aggregation fold.
+
+Two contracts under test:
+
+- **frame cache** — a ``SharedPayload``-wrapped payload produces frames
+  BYTE-IDENTICAL to the naive per-peer encode (seq stamping and dedup
+  see the same bytes), encodes exactly once per wrapper, and ships the
+  same underlying buffer objects to every peer (no per-peer copy);
+- **fold parity** — the in-order prefix fold is the canonical
+  reduction: any arrival order, any partial close, and a mid-fold
+  snapshot restore produce BIT-identical aggregates (the old stacked
+  reduce agrees only to float tolerance — XLA reassociates it).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.comm import Message, create_comm_manager
+from fedml_tpu.comm import serialization
+from fedml_tpu.comm.inproc import InProcRouter
+from fedml_tpu.comm.serialization import SharedPayload
+from fedml_tpu.core import pytree as pt
+
+
+def tree_bits_equal(a, b):
+    fa, da = jax.tree.flatten(a)
+    fb, db = jax.tree.flatten(b)
+    assert da == db
+    for x, y in zip(fa, fb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+
+
+def _payload_tree(seed=0, dim=64):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"kernel": rng.standard_normal((dim, 8)).astype(np.float32),
+                  "bias": rng.standard_normal((8,)).astype(np.float32)},
+        "scale": rng.standard_normal((1,)).astype(np.float32),
+    }
+
+
+def _round_msg(receiver, payload, round_idx=3):
+    msg = Message(1, 0, receiver)
+    msg.add(Message.MSG_ARG_KEY_MODEL_PARAMS, payload)
+    msg.add(Message.MSG_ARG_KEY_CLIENT_INDEX, receiver - 1)
+    msg.add("round_idx", round_idx)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+class TestSharedPayloadFrames:
+    def test_frames_byte_identical_to_plain_encode(self):
+        tree = _payload_tree()
+        shared = SharedPayload(tree)
+        for receiver in (1, 2, 5):
+            cached = _round_msg(receiver, shared).to_bytes()
+            plain = _round_msg(receiver, tree).to_bytes()
+            assert cached == plain
+        # the whole fan-out cost ONE payload encode
+        assert shared.encode_count == 1
+        # and the frames still decode to the original tree
+        tree_bits_equal(Message.from_bytes(cached).get("model_params"),
+                        tree)
+
+    def test_parts_share_buffer_objects_across_peers(self):
+        """Zero-copy: every peer's frame carries the SAME buffer objects
+        (only the per-message header differs), so N peers never cost N
+        payload copies."""
+        shared = SharedPayload(_payload_tree(seed=1))
+        p1 = _round_msg(1, shared).to_parts()
+        p2 = _round_msg(2, shared).to_parts()
+        assert len(p1) == len(p2) > 2
+        # parts: [u32 header len][msgpack header][raw buffers...]
+        assert p1[1] != p2[1]  # header: envelope (receiver) differs
+        for b1, b2 in zip(p1[2:], p2[2:]):
+            assert b1 is b2  # identical objects, not equal copies
+
+    def test_fresh_wrapper_per_round_is_the_invalidation(self):
+        """Round r+1 wraps its payload in a NEW SharedPayload, so stale
+        frames can never leak across rounds; each wrapper encodes once."""
+        t_a, t_b = _payload_tree(seed=2), _payload_tree(seed=3)
+        s_a, s_b = SharedPayload(t_a), SharedPayload(t_b)
+        f_a = _round_msg(1, s_a).to_bytes()
+        f_b = _round_msg(1, s_b).to_bytes()
+        assert f_a != f_b
+        tree_bits_equal(Message.from_bytes(f_a).get("model_params"), t_a)
+        tree_bits_equal(Message.from_bytes(f_b).get("model_params"), t_b)
+        assert s_a.encode_count == 1 and s_b.encode_count == 1
+
+    def test_inproc_object_handoff_unwraps(self):
+        """The in-proc object path skips the wire codec, so the wrapper
+        reaches the receiver — ``Message.get`` must unwrap it."""
+        tree = _payload_tree(seed=4)
+        msg = _round_msg(1, SharedPayload(tree))
+        assert msg.get("model_params") is tree
+
+    @pytest.mark.parametrize("backend,kw", [
+        ("INPROC", dict(wire_codec=True)),
+        ("TCP", dict()),
+    ])
+    def test_wire_parity_across_backends(self, backend, kw):
+        """A SharedPayload broadcast frame decodes at the receiver to
+        the exact original tree on both the in-proc wire codec and real
+        TCP sockets."""
+        if backend == "INPROC":
+            kw = dict(kw, router=InProcRouter())
+        else:
+            kw = dict(kw, addresses={0: ("127.0.0.1", 39441),
+                                     1: ("127.0.0.1", 39442)})
+        tree = _payload_tree(seed=5)
+        received = []
+
+        class Recorder:
+            def receive_message(self, msg_type, msg):
+                received.append(msg)
+
+        com0 = create_comm_manager(backend, 0, 2, **kw)
+        com1 = create_comm_manager(backend, 1, 2, **kw)
+        com0.add_observer(Recorder())
+        t = threading.Thread(target=com0.handle_receive_message,
+                             daemon=True)
+        t.start()
+        try:
+            com1.send_message(_round_msg(0, SharedPayload(tree)))
+            for _ in range(200):
+                if received:
+                    break
+                threading.Event().wait(0.05)
+        finally:
+            com0.stop_receive_message()
+            com1.stop_receive_message()
+            t.join(timeout=5)
+        assert received, f"{backend}: nothing received"
+        tree_bits_equal(received[0].get("model_params"), tree)
+
+
+# ---------------------------------------------------------------------------
+def _reports(n, seed=7, dim=16):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        tree = {"w": rng.standard_normal((dim,)).astype(np.float32),
+                "b": rng.standard_normal((4,)).astype(np.float32)}
+        out.append((i, tree, float(rng.integers(1, 40))))
+    return out
+
+
+def _run_order(reports, order, worker_num, close="aggregate"):
+    from fedml_tpu.algorithms.fedavg_cross_silo import FedAvgAggregator
+    agg = FedAvgAggregator(worker_num)
+    by_idx = {i: (m, w) for i, m, w in reports}
+    for i in order:
+        m, w = by_idx[i]
+        agg.add_local_trained_result(i, m, w)
+    return jax.tree.map(np.asarray, getattr(agg, close)())
+
+
+class TestStreamingFoldBitParity:
+    def test_any_arrival_order_is_bit_identical(self):
+        n = 6
+        reports = _reports(n)
+        ref = _run_order(reports, list(range(n)), n)
+        for order in (list(reversed(range(n))),
+                      [3, 0, 5, 1, 4, 2],
+                      [1, 2, 3, 4, 5, 0]):
+            tree_bits_equal(_run_order(reports, order, n), ref)
+
+    def test_partial_close_is_bit_identical(self):
+        """Quorum/deadline closes fold whoever reported, sorted — any
+        arrival order of the partial cohort agrees bit-for-bit."""
+        reports = [r for r in _reports(6) if r[0] in (1, 3, 4)]
+        ref = _run_order(reports, [1, 3, 4], 6, close="aggregate_available")
+        for order in ([4, 3, 1], [3, 4, 1]):
+            got = _run_order(reports, order, 6,
+                             close="aggregate_available")
+            tree_bits_equal(got, ref)
+
+    def test_fold_matches_stacked_reduce_to_float_tol_only(self):
+        """The documented caveat: the fold agrees with the legacy
+        stacked ``tree_weighted_mean`` only to float tolerance — XLA
+        reassociates the stacked axis-0 sum."""
+        from fedml_tpu.algorithms.fedavg_cross_silo import FedAvgAggregator
+        n = 6
+        reports = _reports(n, seed=11)
+        streamed = _run_order(reports, list(range(n)), n)
+        legacy = FedAvgAggregator(n, aggregate_fn=pt.tree_weighted_mean)
+        for i, m, w in reports:
+            legacy.add_local_trained_result(i, m, w)
+        stacked = jax.tree.map(np.asarray, legacy.aggregate())
+        for a, b in zip(jax.tree.leaves(streamed),
+                        jax.tree.leaves(stacked)):
+            np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_all_empty_shards_uniform_fallback(self):
+        """Every reporter had an empty shard: the close re-weights the
+        fold with 1.0 (``x * 1.0`` is bitwise ``x``) instead of 0/0."""
+        n = 3
+        reports = [(i, m, 0.0) for i, m, _ in _reports(n, seed=13)]
+        out = _run_order(reports, [2, 0, 1], n)
+        want = {k: np.mean(np.stack([m[k] for _, m, _ in reports]), axis=0)
+                for k in reports[0][1]}
+        for k in want:
+            np.testing.assert_allclose(out[k], want[k], rtol=1e-6)
+
+    def test_duplicate_of_folded_report_is_dropped(self):
+        """A transport-level duplicate of an already-folded report must
+        not fold twice (it cannot be un-folded; the payload is
+        identical by the dedup layer's contract)."""
+        from fedml_tpu.algorithms.fedavg_cross_silo import FedAvgAggregator
+        n = 3
+        reports = _reports(n, seed=17)
+        ref = _run_order(reports, list(range(n)), n)
+        agg = FedAvgAggregator(n)
+        by_idx = {i: (m, w) for i, m, w in reports}
+        agg.add_local_trained_result(0, *by_idx[0])
+        agg.add_local_trained_result(0, *by_idx[0])  # duplicate: folded
+        agg.add_local_trained_result(1, *by_idx[1])
+        agg.add_local_trained_result(2, *by_idx[2])
+        tree_bits_equal(jax.tree.map(np.asarray, agg.aggregate()), ref)
+
+    def test_buffered_peak_counts_only_out_of_order(self):
+        from fedml_tpu.algorithms.fedavg_cross_silo import FedAvgAggregator
+        n = 4
+        reports = _reports(n, seed=19)
+        by_idx = {i: (m, w) for i, m, w in reports}
+        agg = FedAvgAggregator(n)
+        for i in range(n):  # strictly in order: nothing ever buffers > 1
+            agg.add_local_trained_result(i, *by_idx[i])
+        assert agg.buffered_peak == 1
+        agg2 = FedAvgAggregator(n)
+        for i in (3, 2, 1, 0):  # fully reversed: suffix waits for 0
+            agg2.add_local_trained_result(i, *by_idx[i])
+        assert agg2.buffered_peak == n
+
+
+# ---------------------------------------------------------------------------
+class _RecordingCom:
+    def __init__(self):
+        self.sent = []
+
+    def add_observer(self, obs):
+        pass
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+    def stop_receive_message(self):
+        pass
+
+
+class TestMidFoldSnapshotParity:
+    """The failover half of the parity contract: a control-state
+    snapshot captured MID-FOLD (prefix folded, suffix pending) restores
+    into a fresh server whose finished round is bit-identical to the
+    server that never died."""
+
+    def _servers(self, fedopt):
+        import jax.numpy as jnp
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            FedAvgAggregator, FedAvgServerManager, FedOptServerManager)
+        from fedml_tpu.control.failover_harness import build_fixture
+        ds, module, _ = build_fixture(3)
+        gm = module.init(jax.random.key(0),
+                         jnp.asarray(ds.train_data_global[0][:1]),
+                         train=False)
+
+        def make():
+            if fedopt:
+                return FedOptServerManager(
+                    0, 4, _RecordingCom(), FedAvgAggregator(3), 4,
+                    ds.client_num, gm, server_optimizer="adam",
+                    server_lr=0.05)
+            return FedAvgServerManager(
+                0, 4, _RecordingCom(), FedAvgAggregator(3), 4,
+                ds.client_num, gm)
+
+        return gm, make
+
+    @pytest.mark.parametrize("fedopt", [False, True],
+                             ids=["fedavg", "fedopt"])
+    def test_restore_mid_fold_matches_unkilled(self, fedopt):
+        import flax.serialization as fser
+        gm, make = self._servers(fedopt)
+        reports = {
+            i: (jax.tree.map(lambda x, i=i: np.asarray(x) + 0.05 * (i + 1),
+                             gm), float(10 + 3 * i))
+            for i in range(3)
+        }
+        # reference: never dies; sees 0 folded, 2 buffered, then 1
+        ref = make()
+        for i in (0, 2, 1):
+            ref.aggregator.add_local_trained_result(i, *reports[i])
+        ref.global_model = ref._aggregate_round()
+
+        # victim: folds 0, buffers 2, then "dies" — snapshot rides the
+        # msgpack wire format the real checkpointer uses
+        victim = make()
+        victim.aggregator.add_local_trained_result(0, *reports[0])
+        victim.aggregator.add_local_trained_result(2, *reports[2])
+        assert victim.aggregator._fold_count == 1  # mid-fold, truly
+        blob = fser.msgpack_serialize(victim._capture_control_state())
+
+        heir = make()
+        heir._restore_control_state(fser.msgpack_restore(blob))
+        assert heir.aggregator.received_count() == 2
+        heir.aggregator.add_local_trained_result(1, *reports[1])
+        heir.global_model = heir._aggregate_round()
+
+        tree_bits_equal(jax.tree.map(np.asarray, heir.global_model),
+                        jax.tree.map(np.asarray, ref.global_model))
+        if fedopt:
+            tree_bits_equal(
+                jax.tree.map(np.asarray, heir.server_opt_state),
+                jax.tree.map(np.asarray, ref.server_opt_state))
+
+
+# ---------------------------------------------------------------------------
+class TestEndToEndBitReproducibility:
+    """The whole-protocol gate: two runs of the threaded cross-silo
+    federation (real in-proc comm, nondeterministic arrival order at the
+    server) must produce BIT-identical final models — only the
+    sorted-index fold makes that hold. Compression on and off: the
+    decode happens before the fold, so the contract is policy-blind."""
+
+    @pytest.mark.parametrize("compression", ["none", "topk_ef_int8:0.25"],
+                             ids=["uncompressed", "topk_int8"])
+    def test_two_runs_bit_equal(self, compression, small_dataset):
+        from fedml_tpu.algorithms.fedavg_cross_silo import (
+            run_fedavg_cross_silo)
+        from fedml_tpu.models.lr import LogisticRegression
+        from fedml_tpu.trainer.functional import TrainConfig
+
+        ds = small_dataset
+        tcfg = TrainConfig(epochs=1, batch_size=4, lr=0.1)
+
+        def one_run():
+            model, _ = run_fedavg_cross_silo(
+                ds, LogisticRegression(num_classes=ds.class_num),
+                worker_num=ds.client_num, comm_round=2, train_cfg=tcfg,
+                compression=compression)
+            return jax.tree.map(np.asarray, model)
+
+        tree_bits_equal(one_run(), one_run())

@@ -34,6 +34,14 @@ def _init_model(module, ds, seed=0):
         train=False))
 
 
+def _wait_until(pred, timeout_s=10.0):
+    """Poll a condition instead of guessing a wall-clock delay."""
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        assert time.monotonic() < deadline, "condition never became true"
+        time.sleep(0.002)
+
+
 def _leaves_equal(a, b):
     la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
     return len(la) == len(lb) and all(
@@ -134,8 +142,10 @@ class TestBatcher:
 
     def test_full_queue_sheds(self):
         release = threading.Event()
+        entered = threading.Event()
 
         def predict(x, variant=None):
+            entered.set()
             release.wait(10)
             return np.asarray(x), 0
 
@@ -146,11 +156,13 @@ class TestBatcher:
             first = threading.Thread(
                 target=lambda: b.submit(x, timeout_s=15))
             first.start()
-            time.sleep(0.2)  # worker now blocked inside predict
+            assert entered.wait(10)  # worker now blocked inside predict
             second = threading.Thread(
                 target=lambda: b.submit(x, timeout_s=15))
             second.start()
-            time.sleep(0.2)  # queue slot occupied by the second request
+            # wait for the second request to actually occupy the lone
+            # queue slot (not for a wall-clock guess at when it might)
+            _wait_until(lambda: b._queue.full())
             with pytest.raises(ShedError):
                 b.submit(x)
             assert b.shed >= 1
@@ -180,8 +192,22 @@ class TestBatcher:
 
             def one(i):
                 v = "a" if i % 2 == 0 else "b"
-                out, _ = b.submit(np.full((1, 2), float(i), np.float32),
-                                  variant=v, timeout_s=30)
+                # 12 concurrent submits into a depth-2 queue WILL shed —
+                # that is the batcher's backpressure contract, not the
+                # wedge under test. Retry until accepted: a wedged
+                # consumer never drains the queue, so every retry sheds
+                # and the deadline trips instead of hanging.
+                deadline = time.monotonic() + 30
+                while True:
+                    try:
+                        out, _ = b.submit(
+                            np.full((1, 2), float(i), np.float32),
+                            variant=v, timeout_s=30)
+                        break
+                    except ShedError:
+                        assert time.monotonic() < deadline, \
+                            "queue never drained — consumer wedged"
+                        time.sleep(0.002)
                 results.append((i, v, float(out[0, 0])))
 
             threads = [threading.Thread(target=one, args=(i,))
@@ -199,8 +225,10 @@ class TestBatcher:
 
     def test_dead_deadline_sheds(self):
         release = threading.Event()
+        entered = threading.Event()
 
         def predict(x, variant=None):
+            entered.set()
             release.wait(10)
             return np.asarray(x), 0
 
@@ -210,7 +238,7 @@ class TestBatcher:
             x = np.zeros((1, 2), np.float32)
             t1 = threading.Thread(target=lambda: b.submit(x, timeout_s=15))
             t1.start()
-            time.sleep(0.2)
+            assert entered.wait(10)  # worker blocked inside predict
             err = {}
 
             def late():
@@ -221,7 +249,12 @@ class TestBatcher:
 
             t2 = threading.Thread(target=late)
             t2.start()
-            time.sleep(0.3)  # the deadline dies while queued
+            # wait for the late request to be queued, then for its OWN
+            # recorded deadline to expire before releasing the worker —
+            # no wall-clock guess about scheduling latency
+            _wait_until(lambda: b._queue.qsize() >= 1)
+            req = b._queue.queue[0]
+            _wait_until(lambda: time.monotonic() > req.deadline)
             release.set()
             t1.join(timeout=10)
             t2.join(timeout=10)
