@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # CI static lane: fedml_tpu.analysis over fedml_tpu/ and tests/ —
-# AST lint (FT001-FT015, incl. the determinism rules) + unused-pragma
-# strictness (FT012) + the whole-program protocol conformance pass
-# (FT2xx, drift-checked against ci/protocol_graph.json) + round-shape
+# AST lint (FT001-FT015 incl. the determinism rules, plus the
+# resource-lifecycle rules FT020-FT024) + unused-pragma strictness
+# (FT012) + the whole-program protocol conformance pass (FT2xx,
+# drift-checked against ci/protocol_graph.json) + round-shape
 # conformance over the algorithms/ driver zoo (FT30x, drift-checked
 # against ci/round_engine_map.json; accept with --write-round-map) +
+# the shutdown-graph extraction (FT025, drift-checked against
+# ci/shutdown_graph.json; accept with --write-shutdown-graph) +
 # flag/env conformance (FT016, vs the README flag/env tables) + the
 # jaxpr/collective audit of registered hot entry points (FT10x,
 # drift-checked against ci/collective_baseline.json).
@@ -12,13 +15,17 @@
 # (# ft: allow[FTxxx]) or baselined in ci/analysis_baseline.json.
 # CI artifacts: runs/static_analysis.json (report),
 # runs/protocol_graph.json (sender->handler graph),
-# runs/round_engine_map.json (the round-engine parity oracle).
+# runs/round_engine_map.json (the round-engine parity oracle),
+# runs/shutdown_graph.json (the worker/resource teardown map).
 #
 # Fast pre-commit lane (sub-second, no jax import):
 #   ci/run_static.sh --changed-only            # lint files touched vs HEAD
 #   ci/run_static.sh --changed-only origin/main
 # (--changed-only implies --no-audit --no-protocol --no-roundshape
-# --no-flags inside the CLI — every whole-program pass skips.)
+# --no-flags --no-lifecycle inside the CLI — every whole-program pass
+# skips; the per-file FT020-FT024 rules still run there, kept cheap by
+# their textual pre-gates: a changed file without "Thread("/"socket"/
+# "Lock"/"Queue"-class tokens costs a substring scan, no AST walk.)
 #
 # Under GitHub Actions ($GITHUB_ACTIONS set) findings are emitted as
 # ::error file=...,line=...:: annotations.

@@ -2,10 +2,10 @@
 
 Default paths are ``fedml_tpu/`` and ``tests/`` under the repo root
 (auto-detected: the cwd if it contains ``fedml_tpu/``, else the
-package's parent). Four passes share one parse of the tree:
+package's parent). Five passes share one parse of the tree:
 
-1. AST lint (FT001–FT015) + unused-pragma detection (FT012 under
-   ``--strict-pragmas``; a warning otherwise);
+1. AST lint (FT001–FT015, FT020–FT024) + unused-pragma detection
+   (FT012 under ``--strict-pragmas``; a warning otherwise);
 2. whole-program protocol conformance (FT2xx) with the sender→handler
    graph emitted to ``runs/protocol_graph.json`` and drift-checked
    against the ``ci/protocol_graph.json`` snapshot;
@@ -14,7 +14,11 @@ package's parent). Four passes share one parse of the tree:
    ``runs/round_engine_map.json`` and is drift-checked against the
    ``ci/round_engine_map.json`` snapshot (accept with
    ``--write-round-map``);
-4. jaxpr audit of registered hot entry points (FT10x) incl. the
+4. resource-lifecycle extraction (FT025): the worker/resource shutdown
+   graph lands in ``runs/shutdown_graph.json`` and is drift-checked
+   against the ``ci/shutdown_graph.json`` snapshot (accept with
+   ``--write-shutdown-graph``);
+5. jaxpr audit of registered hot entry points (FT10x) incl. the
    collective-signature check against ``ci/collective_baseline.json``.
 
 ``--changed-only [REF]`` lints only files touched vs a git ref
@@ -144,6 +148,10 @@ def main(argv: List[str] | None = None) -> int:
                              "(FT30x)")
     parser.add_argument("--no-flags", action="store_true",
                         help="skip the flag/env conformance pass (FT016)")
+    parser.add_argument("--no-lifecycle", action="store_true",
+                        help="skip the shutdown-graph extraction / drift "
+                             "pass (FT025; the FT020-FT024 rules run in "
+                             "the lint pass regardless)")
     parser.add_argument("--changed-only", nargs="?", const="HEAD",
                         default=None, metavar="GITREF",
                         help="lint only python files changed vs GITREF "
@@ -165,6 +173,14 @@ def main(argv: List[str] | None = None) -> int:
                         help="refresh ci/round_engine_map.json from the "
                              "current tree (the deliberate way to accept "
                              "a round-shape change)")
+    parser.add_argument("--write-shutdown-graph", action="store_true",
+                        help="refresh ci/shutdown_graph.json from the "
+                             "current tree (the deliberate way to accept "
+                             "a worker/resource lifecycle change)")
+    parser.add_argument("--shutdown-graph-snapshot", type=Path,
+                        default=None,
+                        help="shutdown-graph snapshot path (default: "
+                             "ci/shutdown_graph.json under the root)")
     parser.add_argument("--round-map-snapshot", type=Path, default=None,
                         help="round-shape snapshot path (default: "
                              "ci/round_engine_map.json under the root)")
@@ -200,6 +216,8 @@ def main(argv: List[str] | None = None) -> int:
                            or root / "ci" / "collective_baseline.json")
     round_map_snapshot = (args.round_map_snapshot
                           or root / "ci" / "round_engine_map.json")
+    shutdown_graph_snapshot = (args.shutdown_graph_snapshot
+                               or root / "ci" / "shutdown_graph.json")
 
     changed_only = args.changed_only is not None
     if changed_only:
@@ -218,6 +236,8 @@ def main(argv: List[str] | None = None) -> int:
                       and not changed_only)
     run_flags = (not args.audit_only and not args.no_flags
                  and not changed_only)
+    run_lifecycle = (not args.audit_only and not args.no_lifecycle
+                     and not changed_only)
     run_audit_pass = not args.no_audit and not changed_only
 
     # the snapshot-refresh flags must apply or fail loudly — a silently
@@ -236,6 +256,11 @@ def main(argv: List[str] | None = None) -> int:
         print("--write-round-map needs the default whole-tree "
               "round-shape pass (no explicit paths, no --changed-only / "
               "--no-roundshape / --audit-only)", file=sys.stderr)
+        return 2
+    if args.write_shutdown_graph and (not run_lifecycle or args.paths):
+        print("--write-shutdown-graph needs the default whole-tree "
+              "lifecycle pass (no explicit paths, no --changed-only / "
+              "--no-lifecycle / --audit-only)", file=sys.stderr)
         return 2
 
     findings = []
@@ -291,6 +316,26 @@ def main(argv: List[str] | None = None) -> int:
             round_map = rs.extract_round_shapes(ctxs, analysis=analysis)
         findings.extend(rs_findings)
         active_rule_ids |= {"FT301", "FT302", "FT303", "FT304"}
+
+    shutdown_graph = None
+    if run_lifecycle:
+        from fedml_tpu.analysis import lifecycle as lc
+        if full_walk:
+            # artifact + snapshot only make sense for the default walk
+            # (a partial graph would always "drift")
+            lc_findings, shutdown_graph = lc.check_lifecycle(
+                ctxs, shutdown_graph_snapshot,
+                artifact_path=root / "runs" / "shutdown_graph.json",
+                write_snapshot=args.write_shutdown_graph)
+            if args.write_shutdown_graph:
+                print(f"wrote shutdown-graph snapshot "
+                      f"({len(shutdown_graph['classes'])} owner classes) "
+                      f"to {shutdown_graph_snapshot}")
+        else:
+            lc_findings = []
+            shutdown_graph = lc.extract_shutdown_graph(ctxs)
+        findings.extend(lc_findings)
+        active_rule_ids |= {"FT025"}
 
     flags_summary = None
     if run_flags:
@@ -390,6 +435,12 @@ def main(argv: List[str] | None = None) -> int:
                                   for k in sorted({d["kind"] for d in
                                                    round_map["drivers"]})}}
                        if round_map is not None else None),
+        "lifecycle": ({"classes": len(shutdown_graph["classes"]),
+                       "workers": sum(len(c["workers"]) for c in
+                                      shutdown_graph["classes"]),
+                       "resources": sum(len(c["resources"]) for c in
+                                        shutdown_graph["classes"])}
+                      if shutdown_graph is not None else None),
         "flags": flags_summary,
         "counts": {"active": len(findings), "suppressed": len(suppressed),
                    "stale_baseline": len(stale),
@@ -435,6 +486,13 @@ def main(argv: List[str] | None = None) -> int:
                   f"driver(s) ("
                   + ", ".join(f"{v} {k}" for k, v in kinds.items())
                   + f"){dest}")
+        if shutdown_graph is not None:
+            dest = (" -> runs/shutdown_graph.json" if full_walk
+                    else " (partial walk: no artifact/snapshot check)")
+            print(f"lifecycle: {report['lifecycle']['classes']} owner "
+                  f"class(es), {report['lifecycle']['workers']} "
+                  f"worker(s), {report['lifecycle']['resources']} "
+                  f"resource(s){dest}")
         if flags_summary is not None:
             print(f"flags: {flags_summary['flags_defined']} defined "
                   f"({flags_summary['flags_shared']} shared), "

@@ -1,0 +1,277 @@
+"""Shared per-class AST model for the concurrency-family passes.
+
+FT010/FT011 (``rules/concurrency.py``) and FT020–FT025
+(``lifecycle.py``) all reason about the same substrate: which functions
+a class body defines (methods plus nested defs handed to
+Thread/Timer), which thread roots the runtime actually spawns, which
+``self.<attr>`` state each function touches under which lexical locks,
+and the same-class call closure. That substrate lives here — rule
+modules import it instead of each other, so the ``rules`` package
+init (which imports every rule) can never form a cycle with a pass
+module.
+
+Names keep their original leading underscores: they are internal to
+the analysis layer, re-exported by ``rules/concurrency.py`` for its
+tests, and not part of the public analysis API.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.lint import dotted_name
+
+#: substrings that mark a ``with`` context expression as a mutual
+#: exclusion (matched on the LAST dotted component, lowercased)
+_LOCKISH = ("lock", "cond", "mutex", "rlock", "semaphore")
+_LOCK_CTORS = frozenset({"locked_global_numpy_rng"})
+
+#: method calls that mutate their receiver in place (kept narrow — a
+#: false "write" flags thread-safe primitives like Event.set)
+_MUTATORS = frozenset({"append", "appendleft", "extend", "insert",
+                       "setdefault", "pop", "popitem", "clear",
+                       "update", "remove", "discard"})
+
+#: methods that belong to the receive root besides registered handlers
+_RECEIVE_ROOT_EXTRAS = ("run", "receive_message")
+
+
+def _lock_name(expr: ast.expr) -> Optional[str]:
+    """Normalized lock identity of a with-item context expr, or None.
+    ``self._lock`` and ``_lock`` normalize apart (different objects);
+    a call ``locked_global_numpy_rng()`` normalizes to its callee."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr)
+    if not name:
+        return None
+    last = name.split(".")[-1].lower()
+    if any(tok in last for tok in _LOCKISH) or \
+            name.split(".")[-1] in _LOCK_CTORS:
+        return name
+    return None
+
+
+class _Access:
+    __slots__ = ("attr", "line", "node", "is_write", "locks")
+
+    def __init__(self, attr: str, line: int, node: ast.AST,
+                 is_write: bool, locks: Tuple[str, ...]):
+        self.attr = attr
+        self.line = line
+        self.node = node
+        self.is_write = is_write
+        self.locks = frozenset(locks)
+
+
+class _Func:
+    """One analyzable function body: a method or a nested def inside a
+    method (``qual`` = "method" or "method.<nested>")."""
+
+    def __init__(self, qual: str, node: ast.AST):
+        self.qual = qual
+        self.node = node
+        self.accesses: List[_Access] = []
+        self.calls: Set[str] = set()          # self.X() / local nested defs
+        self.acquire_pairs: List[Tuple[str, str, int]] = []  # (held, taken)
+        self.calls_under_lock: List[Tuple[str, str]] = []  # (lock, callee)
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """Collect accesses / calls / lock orderings for ONE function body,
+    tracking the lexical with-lock stack. Nested defs are NOT entered —
+    they are separate _Func units."""
+
+    def __init__(self, func: _Func):
+        self.func = func
+        self.lock_stack: List[str] = []
+        self._root = func.node
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self._root:
+            self.generic_visit(node)
+        # else: nested def — its own unit
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.generic_visit(node)  # lambdas stay part of the enclosing body
+
+    def visit_With(self, node: ast.With) -> None:
+        taken = [ln for item in node.items
+                 if (ln := _lock_name(item.context_expr))]
+        for ln in taken:
+            for held in self.lock_stack:
+                if held != ln:
+                    self.func.acquire_pairs.append((held, ln, node.lineno))
+        self.lock_stack.extend(taken)
+        self.generic_visit(node)
+        for _ in taken:
+            self.lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _record(self, attr: Optional[str], node: ast.AST,
+                is_write: bool) -> None:
+        if attr:
+            self.func.accesses.append(_Access(
+                attr, getattr(node, "lineno", 0), node, is_write,
+                tuple(self.lock_stack)))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._record(_self_attr(tgt), node, True)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(_self_attr(node.target), node, True)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                self._record(_self_attr(node.func.value), node, True)
+            callee = dotted_name(node.func)
+            if callee and callee.startswith("self."):
+                name = callee[len("self."):]
+                if "." not in name:
+                    self.func.calls.add(name)
+                    for held in self.lock_stack:
+                        self.func.calls_under_lock.append((held, name))
+        elif isinstance(node.func, ast.Name):
+            self.func.calls.add(node.func.id)  # maybe a nested def
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self._record(_self_attr(node), node, False)
+        self.generic_visit(node)
+
+
+def _callable_target(expr: ast.expr) -> Optional[str]:
+    """``self.M`` -> "M"; bare ``fire`` -> "fire"; else None."""
+    name = dotted_name(expr)
+    if not name:
+        return None
+    if name.startswith("self.") and name.count(".") == 1:
+        return name[len("self."):]
+    if "." not in name:
+        return name
+    return None
+
+
+class _ClassModel:
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.funcs: Dict[str, _Func] = {}
+        #: root label -> entry function quals
+        self.roots: Dict[str, Set[str]] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        for method in self.cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            self.funcs[method.name] = _Func(method.name, method)
+            for child in ast.walk(method):
+                if child is method:
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # nested defs (timer `fire`, thread `runner`) are
+                    # their own units, qualified under the method
+                    qual = f"{method.name}.{child.name}"
+                    if qual not in self.funcs:
+                        self.funcs[qual] = _Func(qual, child)
+        for fn in list(self.funcs.values()):
+            _FuncVisitor(fn).visit(fn.node)
+        self._infer_roots()
+
+    def _resolve(self, caller_qual: str, name: str) -> Optional[str]:
+        """A name referenced inside ``caller_qual``: nested def first,
+        then a plain method."""
+        nested = f"{caller_qual.split('.')[0]}.{name}"
+        if nested in self.funcs:
+            return nested
+        if name in self.funcs:
+            return name
+        return None
+
+    def _infer_roots(self) -> None:
+        receive: Set[str] = set()
+        for qual, fn in self.funcs.items():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func) or ""
+                last = callee.split(".")[-1]
+                if last == "register_message_receive_handler" \
+                        and len(node.args) >= 2:
+                    target = _callable_target(node.args[1])
+                    if target:
+                        res = self._resolve(qual, target)
+                        if res:
+                            receive.add(res)
+                elif last == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = _callable_target(kw.value)
+                            if target:
+                                res = self._resolve(qual, target)
+                                if res:
+                                    self.roots.setdefault(
+                                        f"thread:{target}", set()).add(res)
+                elif last == "Timer" and len(node.args) >= 2:
+                    target = _callable_target(node.args[1])
+                    if target:
+                        res = self._resolve(qual, target)
+                        if res:
+                            self.roots.setdefault(
+                                f"timer:{target}", set()).add(res)
+                elif last == "RoundPrefetcher":
+                    producers = []
+                    if node.args:
+                        producers.append(_callable_target(node.args[0]))
+                    for kw in node.keywords:
+                        if kw.arg == "next_key":
+                            producers.append(_callable_target(kw.value))
+                    for target in producers:
+                        if target:
+                            res = self._resolve(qual, target)
+                            if res:
+                                self.roots.setdefault(
+                                    "prefetch", set()).add(res)
+        for extra in _RECEIVE_ROOT_EXTRAS:
+            if extra in self.funcs:
+                receive.add(extra)
+        if receive:
+            self.roots["receive"] = receive
+
+    def closure(self, entries: Set[str]) -> Set[str]:
+        """Entry quals expanded through same-class calls. ``__init__``
+        itself is excluded (construction precedes every thread) — but a
+        nested def INSIDE ``__init__`` handed to a Thread/Timer runs
+        after start() and stays in."""
+        seen: Set[str] = set()
+        work = [q for q in entries if q in self.funcs]
+        while work:
+            qual = work.pop()
+            if qual in seen or qual == "__init__":
+                continue
+            seen.add(qual)
+            for name in self.funcs[qual].calls:
+                res = self._resolve(qual, name)
+                if res and res not in seen:
+                    work.append(res)
+        return seen

@@ -1,0 +1,1210 @@
+"""FT020–FT025 — resource lifecycle, shutdown reachability, blocking hazards.
+
+Five thread-bearing subsystems (comm writer threads, serve
+rollout/coalescer workers, sched receive loops, the failover harness,
+obs followers) kept growing the same bug class, found by hand each
+time: ``launch_federation`` leaked its listening socket + worker
+threads on a raise (EADDRINUSE on relaunch), the serve coalescer
+deadlocked on a blocking put into its own full queue, ``submit()``
+after ``close()`` blocked 30 s on a dead worker, ``rollout.drain()``
+raced an in-flight swap. This module freezes the class out statically,
+the way FT010/FT011 froze shared-state races:
+
+- **FT020** thread-lifecycle — every ``Thread``/``Timer`` start site
+  must be daemon'd or reachable from a close/stop/shutdown join path
+  (interprocedural, one call level, reusing concurrency.py's per-class
+  call graph). Local threads may instead join in-function or escape
+  to a caller.
+- **FT021** leak-on-raise — sockets/listeners/files/subprocesses (and
+  same-module closable classes) acquired into a local with raising
+  statements before the release and no ``finally``/context-manager
+  protection. Init-assignment to a self-attr on a class with a
+  close-ish method counts as escaped-to-owner (the owner's release
+  edge is FT023's job); a self-attr on a class with NO close path is
+  flagged here.
+- **FT022** blocking-call-under-lock — lexical lock-hold dataflow
+  (extending FT011's nested-``with`` walker, plus ``lk = self._lock``
+  aliases and one same-class call level): ``queue.put/get`` without
+  timeout, socket send/recv/accept, thread ``join()``, bare ``wait()``
+  and device dispatch inside a held lock. Device gates
+  (``*_device_lock``) and dedicated write-serialization locks
+  (``_send_lock``/``_wlock``/``*_io_lock``) are exempt — serializing
+  socket writers is what those locks are FOR.
+- **FT023** shutdown-reachability — a class that starts a self-stored
+  worker and defines a close path must set the worker's stop signal
+  (closed flag, stop-Event ``set()``, queue sentinel, timer cancel, or
+  tearing the socket the worker blocks on) on some path from close;
+  every self-stored resource must be referenced from the close
+  closure (the missing release edge is how the TCP listener leaked);
+  and close must be idempotent (an unguarded ``X.shutdown()`` raises
+  on the second call).
+- **FT024** submit-after-close — public enqueue methods on classes
+  whose close path sets a closed flag must read that flag before a
+  blocking ``put`` (the 30 s-timeout-on-a-dead-worker shape).
+
+Beyond the per-file rules, :func:`extract_shutdown_graph` emits the
+whole-program **resource/shutdown graph** — every background worker
+and owned resource with its teardown edges — to
+``runs/shutdown_graph.json``, drift-checked against the line-free
+fingerprinted snapshot ``ci/shutdown_graph.json`` (**FT025**: loud if
+missing, drift finding otherwise; accept deliberate changes with
+``--write-shutdown-graph``), exactly the FT200/FT204 pattern.
+
+Scope: library code only (tests are single-threaded and short-lived
+by construction; corpus paths are linted as library code). Sanctioned
+sites carry ``# ft: allow[FT02x]`` pragmas with rationale — the
+strict-pragma lane keeps them fresh.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from fedml_tpu.analysis.finding import Finding
+from fedml_tpu.analysis.lint import (FileContext, Rule, dotted_name,
+                                     is_test_path)
+from fedml_tpu.analysis.classmodel import (_ClassModel, _lock_name,
+                                           _self_attr)
+
+GRAPH_VERSION = 1
+
+#: method-name prefixes that mark a teardown path
+_CLOSE_PREFIXES = ("close", "stop", "shutdown", "release", "terminate",
+                   "finish", "quit", "dispose")
+
+#: callee last-component -> resource kind (the acquirer set)
+_ACQUIRE_KINDS = {
+    "create_connection": "socket", "create_server": "socket",
+    "socketpair": "socket", "Popen": "process",
+}
+#: ``open``/``socket`` only count when bare or from a stdlib fs/net
+#: module — ``webbrowser.open`` or ``shelf.open`` must not register
+_OPEN_PREFIXES = {"", "io", "os", "gzip", "bz2", "lzma", "codecs"}
+_SOCKET_PREFIXES = {"", "socket"}
+
+#: attr-name tokens that mark a closed/stop flag or event
+_STOPPISH = ("stop", "clos", "shutdown", "done", "quit", "exit",
+             "kill", "running", "alive", "active", "finished")
+#: receiver-name tokens marking a queue-like hand-off object
+def _queueish(name: str) -> bool:
+    n = name.split(".")[-1].lower()
+    return ("queue" in n or "box" in n or n.strip("_") == "q"
+            or n.endswith("_q"))
+
+
+def _threadish(name: str) -> bool:
+    n = name.split(".")[-1].lower()
+    return any(tok in n for tok in ("thread", "worker", "writer", "reader",
+                                    "timer", "proc", "poller", "watcher",
+                                    "pump"))
+
+
+def _sockish(name: str) -> bool:
+    n = name.split(".")[-1].lower()
+    return any(tok in n for tok in ("sock", "conn", "server", "client",
+                                    "peer", "fh", "file", "pipe"))
+
+
+def _is_close_name(name: str) -> bool:
+    return name in ("__exit__", "__del__") or \
+        name.startswith(_CLOSE_PREFIXES)
+
+
+def _stoppish(name: str) -> bool:
+    n = name.split(".")[-1].lower()
+    return any(tok in n for tok in _STOPPISH)
+
+
+def _daemon_of(call: ast.Call) -> bool:
+    """True when the ctor passes ``daemon=True`` (or a non-literal
+    expression — we stay quiet rather than guess)."""
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True  # dynamic daemon-ness: not resolvable, stay quiet
+    return False
+
+
+def _worker_ctor(call: ast.Call) -> Optional[str]:
+    """'thread' / 'timer' when ``call`` constructs one, else None."""
+    name = dotted_name(call.func) or ""
+    last = name.split(".")[-1]
+    if last == "Thread":
+        return "thread"
+    if last == "Timer":
+        return "timer"
+    return None
+
+
+def _acquire_kind(call: ast.Call,
+                  closable_classes: Set[str]) -> Optional[str]:
+    """Resource kind acquired by ``call``, or None."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    prefix = ".".join(parts[:-1])
+    if last == "open" and prefix in _OPEN_PREFIXES:
+        return "file"
+    if last == "socket" and prefix in _SOCKET_PREFIXES:
+        return "socket"
+    if last in _ACQUIRE_KINDS:
+        return _ACQUIRE_KINDS[last]
+    if last in closable_classes:
+        return "closable"
+    if last.endswith("CommManager") or last.endswith("Endpoint"):
+        # the framework's connection-owning classes: constructing one
+        # binds a listening/outbound socket the creator must release
+        return "endpoint"
+    return None
+
+
+def _closable_classes(tree: ast.Module) -> Set[str]:
+    """Names of same-module classes that define a close-ish method —
+    constructing one is acquiring a resource the creator must own."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and _is_close_name(m.name):
+                    out.add(node.name)
+                    break
+    return out
+
+
+def _target_name(call: ast.Call, kind: str) -> Optional[str]:
+    """The worker's entry callable as a short name (``self.M`` -> M)."""
+    expr: Optional[ast.expr] = None
+    if kind == "thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                expr = kw.value
+    elif kind == "timer" and len(call.args) >= 2:
+        expr = call.args[1]
+    if expr is None:
+        return None
+    name = dotted_name(expr)
+    if name and name.startswith("self.") and name.count(".") == 1:
+        return name[len("self."):]
+    if name and "." not in name:
+        return name
+    return None
+
+
+class _Worker:
+    __slots__ = ("kind", "attr", "local", "target", "line", "daemon",
+                 "created_in", "node")
+
+    def __init__(self, kind: str, attr: Optional[str], local: Optional[str],
+                 target: Optional[str], line: int, daemon: bool,
+                 created_in: str, node: ast.AST):
+        self.kind = kind
+        self.attr = attr          # self-attr it is stored to, or None
+        self.local = local        # local name it is bound to, or None
+        self.target = target
+        self.line = line
+        self.daemon = daemon
+        self.created_in = created_in
+        self.node = node
+
+
+class _Resource:
+    __slots__ = ("kind", "attr", "line", "created_in", "node")
+
+    def __init__(self, kind: str, attr: str, line: int, created_in: str,
+                 node: ast.AST):
+        self.kind = kind
+        self.attr = attr
+        self.line = line
+        self.created_in = created_in
+        self.node = node
+
+
+class _ClassLife:
+    """Per-class lifecycle model: workers, owned resources, join/release
+    sites, stop-signal writes, and the close-path closure — built on
+    concurrency.py's per-class call graph."""
+
+    def __init__(self, cls: ast.ClassDef, closable_classes: Set[str]):
+        self.cls = cls
+        self.model = _ClassModel(cls)
+        self.workers: List[_Worker] = []
+        self.resources: List[_Resource] = []
+        #: attr -> methods that join()/cancel() it
+        self.join_sites: Dict[str, Set[str]] = {}
+        #: method -> human-readable stop-signal writes in its body
+        self.stop_signals: Dict[str, List[str]] = {}
+        #: method -> self-attrs it calls a release method on
+        self.release_sites: Dict[str, Set[str]] = {}
+        #: method -> unguarded ``X.shutdown()`` lines (idempotency)
+        self.unguarded_shutdowns: Dict[str, List[int]] = {}
+        self.close_methods = sorted(
+            q for q in self.model.funcs
+            if "." not in q and _is_close_name(q))
+        self._closable = closable_classes
+        self._collect()
+        self.close_closure: Set[str] = set()
+        for m in self.close_methods:
+            self.close_closure |= self.model.closure({m})
+        self.close_closure |= set(self.close_methods)
+
+    # -- collection -------------------------------------------------------
+    def _collect(self) -> None:
+        for qual, fn in self.model.funcs.items():
+            self._collect_func(qual, fn.node)
+        self._apply_daemon_assigns()
+
+    def _apply_daemon_assigns(self) -> None:
+        """``t.daemon = True`` / ``self._t.daemon = True`` after the
+        ctor daemonizes the worker just as surely as the kwarg."""
+        daemon_locals: Set[Tuple[str, str]] = set()   # (qual, local)
+        daemon_attrs: Set[str] = set()
+        for qual, fn in self.model.funcs.items():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Constant) or \
+                        not node.value.value:
+                    continue
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Attribute) or \
+                            tgt.attr != "daemon":
+                        continue
+                    if isinstance(tgt.value, ast.Name):
+                        daemon_locals.add((qual, tgt.value.id))
+                    else:
+                        attr = _self_attr(tgt.value)
+                        if attr:
+                            daemon_attrs.add(attr)
+        for w in self.workers:
+            if w.attr in daemon_attrs or \
+                    (w.local and (w.created_in, w.local) in daemon_locals):
+                w.daemon = True
+
+    def _collect_func(self, qual: str, func: ast.AST) -> None:
+        try_stack: List[ast.Try] = []
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not func:
+                return  # nested defs are their own _Func units
+            if isinstance(node, ast.Try):
+                try_stack.append(node)
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+                try_stack.pop()
+                return
+            if isinstance(node, ast.Assign):
+                self._on_assign(qual, node)
+            elif isinstance(node, ast.Call):
+                self._on_call(qual, node, in_try=bool(try_stack))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(func)
+
+    def _on_assign(self, qual: str, node: ast.Assign) -> None:
+        if not isinstance(node.value, ast.Call):
+            self._flag_assign(qual, node)
+            return
+        call = node.value
+        kind = _worker_ctor(call)
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            sub_attr = None
+            if isinstance(tgt, ast.Subscript):
+                sub_attr = _self_attr(tgt.value)
+            local = tgt.id if isinstance(tgt, ast.Name) else None
+            if kind:
+                self.workers.append(_Worker(
+                    kind, attr, local, _target_name(call, kind),
+                    node.lineno, _daemon_of(call), qual, node))
+            else:
+                rkind = _acquire_kind(call, self._closable)
+                if rkind and (attr or sub_attr):
+                    self.resources.append(_Resource(
+                        rkind, attr or sub_attr, node.lineno, qual, node))
+
+    def _flag_assign(self, qual: str, node: ast.Assign) -> None:
+        """Record ``self._closed = True`` style stop-flag writes."""
+        if not isinstance(node.value, ast.Constant) or \
+                not isinstance(node.value.value, bool):
+            return
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr and _stoppish(attr):
+                self.stop_signals.setdefault(qual, []).append(
+                    f"{attr}={node.value.value}")
+
+    def _on_call(self, qual: str, node: ast.Call, in_try: bool) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            # anonymous ``Thread(...).start()`` never binds a name
+            return
+        attr_call = node.func.attr
+        recv = node.func.value
+        recv_attr = _self_attr(recv)
+        if isinstance(recv, ast.Call):
+            kind = _worker_ctor(recv)
+            if kind and attr_call == "start":
+                self.workers.append(_Worker(
+                    kind, None, None, _target_name(recv, kind),
+                    node.lineno, _daemon_of(recv), qual, node))
+            return
+        if recv_attr is None:
+            return
+        if attr_call in ("join", "cancel"):
+            self.join_sites.setdefault(recv_attr, set()).add(qual)
+            if _threadish(recv_attr) or _stoppish(recv_attr):
+                self.stop_signals.setdefault(qual, []).append(
+                    f"{recv_attr}.{attr_call}()")
+        elif attr_call == "set" and _stoppish(recv_attr):
+            self.stop_signals.setdefault(qual, []).append(
+                f"{recv_attr}.set()")
+        elif attr_call in ("put", "put_nowait") and _queueish(recv_attr):
+            self.stop_signals.setdefault(qual, []).append(
+                f"{recv_attr}.{attr_call}(<sentinel>)")
+        elif attr_call in ("kill", "disconnect") or \
+                attr_call.startswith(_CLOSE_PREFIXES):
+            self.release_sites.setdefault(qual, set()).add(recv_attr)
+            if _sockish(recv_attr) or attr_call.startswith(
+                    _CLOSE_PREFIXES):
+                # tearing the socket a reader blocks on IS its stop;
+                # so is cascading teardown into an owned delegate
+                # (router.stop -> physical.stop_receive_message)
+                self.stop_signals.setdefault(qual, []).append(
+                    f"{recv_attr}.{attr_call}()")
+            if attr_call == "shutdown" and not in_try:
+                self.unguarded_shutdowns.setdefault(qual, []).append(
+                    node.lineno)
+
+    # -- queries ----------------------------------------------------------
+    def attr_in_close_path(self, attr: str) -> bool:
+        for qual in self.close_closure:
+            fn = self.model.funcs.get(qual)
+            if fn and any(a.attr == attr for a in fn.accesses):
+                return True
+        return False
+
+    def joined_from_close(self, attr: str) -> bool:
+        return bool(self.join_sites.get(attr, set()) & self.close_closure)
+
+    def close_stop_signals(self) -> List[str]:
+        out: List[str] = []
+        for qual in sorted(self.close_closure):
+            out.extend(self.stop_signals.get(qual, []))
+        return out
+
+
+def _life(ctx: FileContext, cls: ast.ClassDef) -> _ClassLife:
+    cache = ctx.__dict__.setdefault("_lifecycle_models", {})
+    key = id(cls)
+    if key not in cache:
+        cache[key] = _ClassLife(cls, _closable_classes(ctx.tree))
+    return cache[key]
+
+
+def _gate(ctx: FileContext, *tokens: str) -> bool:
+    """Textual pre-gate keeping the ``--changed-only`` lane cheap: a
+    file that never mentions the construct cannot violate the rule."""
+    return any(tok in ctx.source for tok in tokens)
+
+
+# -- FT020 --------------------------------------------------------------------
+
+class ThreadLifecycleRule(Rule):
+    id = "FT020"
+    title = ("non-daemon Thread/Timer with no join/cancel path from "
+             "close/stop/shutdown (orphaned worker outlives its owner)")
+    hint = ("pass daemon=True, join/cancel the worker from the owner's "
+            "close path, or pragma a deliberately process-lifetime "
+            "thread: # ft: allow[FT020] why")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _gate(ctx, "Thread(", "Timer("):
+            return
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+        yield from self._check_module_funcs(ctx)
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        life = _life(ctx, cls)
+        for w in life.workers:
+            if w.daemon:
+                continue
+            if w.attr is not None:
+                if life.joined_from_close(w.attr):
+                    continue
+                where = (f"join/cancel self.{w.attr} from "
+                         f"{', '.join(life.close_methods) or 'a close()'}"
+                         )
+                yield ctx.finding(
+                    self, w.node,
+                    f"{cls.name}.{w.created_in} starts non-daemon "
+                    f"{w.kind} self.{w.attr} but no close/stop/shutdown "
+                    f"path ever joins or cancels it — the worker "
+                    "outlives its owner and pins interpreter exit "
+                    f"({where})")
+            elif w.local is not None:
+                if self._local_ok(life.model.funcs[w.created_in].node,
+                                  w.local):
+                    continue
+                yield ctx.finding(
+                    self, w.node,
+                    f"{cls.name}.{w.created_in} starts non-daemon "
+                    f"{w.kind} {w.local!r} that is neither joined here "
+                    "nor handed to a caller — nothing can ever tear "
+                    "it down")
+            else:
+                yield ctx.finding(
+                    self, w.node,
+                    f"{cls.name}.{w.created_in} starts an anonymous "
+                    f"non-daemon {w.kind} — unjoinable by construction")
+
+    def _check_module_funcs(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.tree.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign) or \
+                        not isinstance(sub.value, ast.Call):
+                    continue
+                kind = _worker_ctor(sub.value)
+                if not kind or _daemon_of(sub.value):
+                    continue
+                local = next((t.id for t in sub.targets
+                              if isinstance(t, ast.Name)), None)
+                if local is None:
+                    continue  # stored elsewhere: escapes
+                if self._local_ok(node, local):
+                    continue
+                yield ctx.finding(
+                    self, sub,
+                    f"{node.name}() starts non-daemon {kind} {local!r} "
+                    "that is neither joined in this function nor "
+                    "returned/stored — it leaks past every caller")
+
+    @staticmethod
+    def _local_ok(func: ast.AST, local: str) -> bool:
+        """Joined/cancelled in-function, or escapes to the caller."""
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("join", "cancel") and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == local:
+                    return True
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == local:
+                        return True  # handed off (append/register/...)
+            elif isinstance(node, (ast.Return, ast.Yield)) and \
+                    node.value is not None:
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name) and n.id == local:
+                        return True
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "daemon" and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == local and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value:
+                        return True  # t.daemon = True after the ctor
+                if isinstance(node.value, ast.Name) and \
+                        node.value.id == local:
+                    return True  # aliased/stored (self.x = t, d[k] = t)
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name) and n.id == local and \
+                            not isinstance(node.value, ast.Call):
+                        return True  # packed into a container literal
+        return False
+
+
+# -- FT021 --------------------------------------------------------------------
+
+class LeakOnRaiseRule(Rule):
+    id = "FT021"
+    title = ("resource acquired then lost on a raising path (no "
+             "finally/with release) or owned by a class with no close "
+             "path — the EADDRINUSE-on-relaunch shape")
+    hint = ("wrap the acquisition in try/finally or a with block, close "
+            "before the raising call, or give the owning class a "
+            "close() that releases it")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _gate(ctx, "open(", "socket", "Popen(", "def close"):
+            return
+        closable = _closable_classes(ctx.tree)
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            life = _life(ctx, cls)
+            if life.close_methods:
+                continue  # escaped-to-owner: release edges are FT023's
+            for res in life.resources:
+                yield ctx.finding(
+                    self, res.node,
+                    f"{cls.name} acquires {res.kind} self.{res.attr} "
+                    "but defines no close/stop/shutdown method — the "
+                    "handle can never be released and leaks for the "
+                    "process lifetime (add a close() and call it from "
+                    "the owner's teardown)")
+        for func, in_class in self._functions(ctx.tree):
+            yield from self._check_locals(ctx, func, closable, in_class)
+
+    @staticmethod
+    def _functions(tree: ast.Module):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node, False
+
+    def _check_locals(self, ctx: FileContext, func: ast.AST,
+                      closable: Set[str],
+                      in_class: bool) -> Iterator[Finding]:
+        # straight-line scan per statement block: an acquisition into a
+        # local must be protected (try/finally, with, or immediate
+        # escape) before the next raise-capable statement
+        for block in self._blocks(func):
+            yield from self._scan_block(ctx, func, block, closable)
+
+    @staticmethod
+    def _blocks(func: ast.AST) -> List[List[ast.stmt]]:
+        """Statement lists of ``func`` NOT under a Try (a surrounding
+        try is assumed to release in its handler/finally) and not
+        inside nested defs."""
+        out: List[List[ast.stmt]] = []
+
+        def walk(stmts: List[ast.stmt], protected: bool) -> None:
+            if not protected:
+                out.append(stmts)
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if isinstance(s, ast.Try):
+                    walk(s.body, True)
+                    for h in s.handlers:
+                        walk(h.body, protected)
+                    walk(s.orelse, protected)
+                    walk(s.finalbody, protected)
+                elif isinstance(s, (ast.If,)):
+                    walk(s.body, protected)
+                    walk(s.orelse, protected)
+                elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                    walk(s.body, protected)
+                    walk(s.orelse, protected)
+                elif isinstance(s, (ast.With, ast.AsyncWith)):
+                    walk(s.body, protected)
+
+        walk(getattr(func, "body", []), False)
+        return out
+
+    def _scan_block(self, ctx: FileContext, func: ast.AST,
+                    stmts: List[ast.stmt],
+                    closable: Set[str]) -> Iterator[Finding]:
+        for i, stmt in enumerate(stmts):
+            if not isinstance(stmt, ast.Assign) or \
+                    not isinstance(stmt.value, ast.Call):
+                continue
+            kind = _acquire_kind(stmt.value, closable)
+            if kind is None:
+                continue
+            local = next((t.id for t in stmt.targets
+                          if isinstance(t, ast.Name)), None)
+            if local is None:
+                continue  # self-attr case handled per class above
+            verdict = self._follow(stmts[i + 1:], local)
+            if verdict is not None:
+                yield ctx.finding(
+                    self, stmt,
+                    f"{getattr(func, 'name', '<fn>')}() acquires {kind} "
+                    f"{local!r} and {verdict} — a raise in between "
+                    "leaks the handle (EADDRINUSE / fd exhaustion on "
+                    "the relaunch path); release it in a finally or a "
+                    "with block")
+
+    @staticmethod
+    def _follow(rest: List[ast.stmt], local: str) -> Optional[str]:
+        """None when the local is safely released/escaped; otherwise a
+        description of the unprotected window."""
+        def mentions(node: ast.AST) -> bool:
+            return any(isinstance(n, ast.Name) and n.id == local
+                       for n in ast.walk(node))
+
+        def stored_away(node: ast.AST) -> bool:
+            """Handed to a container/registry METHOD (x.append(local),
+            registry.register(local)) — a plain function call taking the
+            local as an argument does NOT transfer ownership (Popen can
+            raise without adopting the handle)."""
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute):
+                    for arg in list(n.args) + [k.value for k in
+                                               n.keywords]:
+                        if isinstance(arg, ast.Name) and arg.id == local:
+                            return True
+            return False
+
+        def aliased(value: ast.expr) -> bool:
+            if isinstance(value, ast.Name) and value.id == local:
+                return True
+            if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+                return any(isinstance(e, ast.Name) and e.id == local
+                           for e in value.elts)
+            if isinstance(value, ast.Dict):
+                return any(isinstance(e, ast.Name) and e.id == local
+                           for e in value.values)
+            return False
+
+        def releases(node: ast.AST) -> bool:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.value.id == local and \
+                        (n.func.attr in ("kill",)
+                         or n.func.attr.startswith(_CLOSE_PREFIXES)):
+                    return True
+            return False
+
+        raised = False
+        for stmt in rest:
+            # escape: returned, yielded, aliased/stored, or handed to a
+            # container method — ownership moves before a raise can
+            # strand the handle
+            if isinstance(stmt, ast.Return) and stmt.value is not None \
+                    and mentions(stmt.value):
+                return None
+            if isinstance(stmt, ast.Assign) and aliased(stmt.value):
+                return None
+            if stored_away(stmt):
+                return None
+            if isinstance(stmt, ast.Try):
+                # a try immediately after the acquisition that releases
+                # the local in a handler or finally is the sanctioned
+                # pattern
+                for part in ([h for h in stmt.handlers]
+                             + [stmt]):
+                    body = part.finalbody if part is stmt else part.body
+                    if any(releases(s) for s in body):
+                        return None
+                raised = True  # try body can raise past the handlers
+                continue
+            # release on the straight line
+            if releases(stmt):
+                return ("releases it only after raise-capable "
+                        "statements with no try/finally"
+                        if raised else None)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if mentions(item.context_expr):
+                        return None  # managed from here on
+            # raise-capable?
+            if isinstance(stmt, ast.Raise):
+                return "raises before releasing it"
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    raised = True
+                    break
+        if raised:
+            return ("never releases it on this path (and raise-capable "
+                    "statements follow the acquisition)")
+        return None
+
+
+# -- FT022 --------------------------------------------------------------------
+
+#: a held lock whose last component contains one of these is exempt —
+#: device gates serialize dispatch on purpose; send/write locks exist
+#: to serialize exactly the socket writes FT022 would flag
+_EXEMPT_LOCK_TOKENS = ("device", "gate", "send", "write", "wlock", "io")
+
+_SOCKET_BLOCKERS = frozenset({"sendall", "recv", "recv_into", "accept",
+                              "create_connection"})
+_DEVICE_BLOCKERS = frozenset({"device_put", "block_until_ready"})
+
+
+def _lock_exempt(name: str) -> bool:
+    last = name.split(".")[-1].lower()
+    return any(tok in last for tok in _EXEMPT_LOCK_TOKENS)
+
+
+def _blocking_site(node: ast.Call) -> Optional[str]:
+    """A human-readable description when ``node`` can block
+    indefinitely, else None."""
+    callee = dotted_name(node.func) or ""
+    last = callee.split(".")[-1]
+    if last in _DEVICE_BLOCKERS:
+        return f"device dispatch {last}()"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    recv = node.func.value
+    recv_name = dotted_name(recv) or ""
+    attr = node.func.attr
+    kwargs = {k.arg for k in node.keywords}
+    has_timeout = "timeout" in kwargs or any(
+        k.arg == "block" and isinstance(k.value, ast.Constant)
+        and k.value.value is False for k in node.keywords)
+    if attr in ("put", "get") and _queueish(recv_name):
+        if not has_timeout and len(node.args) < (2 if attr == "put" else 1):
+            return f"blocking {recv_name}.{attr}() with no timeout"
+    if attr in _SOCKET_BLOCKERS and not isinstance(recv, ast.Constant) \
+            and "timeout" not in kwargs:
+        return f"socket {recv_name or '<expr>'}.{attr}()"
+    if attr == "join" and not node.args and not kwargs and \
+            _threadish(recv_name):
+        return f"unbounded {recv_name}.join()"
+    if attr == "wait" and not node.args and not has_timeout and \
+            recv_name and not _lock_name(recv) and \
+            not isinstance(recv, ast.Constant):
+        return f"unbounded {recv_name}.wait()"
+    return None
+
+
+class _HoldScan(ast.NodeVisitor):
+    """Lock-hold dataflow for one function body: lexical ``with``
+    nesting plus ``lk = self._lock`` aliases. Records (lock, site,
+    node) blocking triples and (lock, callee, node) call edges."""
+
+    def __init__(self, root: ast.AST):
+        self.root = root
+        self.lock_stack: List[str] = []
+        self.aliases: Dict[str, str] = {}
+        self.blocked: List[Tuple[str, str, ast.AST]] = []
+        self.calls_under: List[Tuple[str, str, ast.AST]] = []
+
+    def _lockname(self, expr: ast.expr) -> Optional[str]:
+        name = _lock_name(expr)
+        if name:
+            return name
+        if isinstance(expr, ast.Name) and expr.id in self.aliases:
+            return self.aliases[expr.id]
+        return None
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.root:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value_lock = _lock_name(node.value) if not isinstance(
+            node.value, ast.Call) else None
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and value_lock:
+                self.aliases[tgt.id] = value_lock
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        taken = [ln for item in node.items
+                 if (ln := self._lockname(item.context_expr))]
+        self.lock_stack.extend(taken)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in taken:
+            self.lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # innermost-lock semantics: device/send/write work placed under
+        # its own dedicated gate is sanctioned even when an outer
+        # coarse lock is also held (the endpoint's swap-then-device
+        # nesting) — the outer lock is then that gate's client
+        if self.lock_stack and not _lock_exempt(self.lock_stack[-1]):
+            lock = self.lock_stack[-1]
+            site = _blocking_site(node)
+            if site:
+                self.blocked.append((lock, site, node))
+            callee = dotted_name(node.func) or ""
+            if callee.startswith("self.") and callee.count(".") == 1:
+                self.calls_under.append(
+                    (lock, callee[len("self."):], node))
+        self.generic_visit(node)
+
+
+class BlockingUnderLockRule(Rule):
+    id = "FT022"
+    title = ("blocking call (queue put/get, socket send/recv, join, "
+             "device dispatch) while holding a lock — every other "
+             "path needing that lock stalls behind it")
+    hint = ("move the blocking call outside the with block (snapshot "
+            "under the lock, block outside), add a timeout, or pragma "
+            "a deliberate serialization point: # ft: allow[FT022] why")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _gate(ctx, "Lock", "lock", "Condition", "mutex"):
+            return
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _HoldScan(node)
+                scan.visit(node)
+                yield from self._emit(ctx, node.name, scan, None)
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        life = _life(ctx, cls)
+        scans: Dict[str, _HoldScan] = {}
+        for qual, fn in life.model.funcs.items():
+            scan = _HoldScan(fn.node)
+            scan.visit(fn.node)
+            scans[qual] = scan
+        for qual, scan in scans.items():
+            yield from self._emit(ctx, f"{cls.name}.{qual}", scan, None)
+            # one call level: a held lock survives into same-class calls
+            for lock, callee, node in scan.calls_under:
+                res = life.model._resolve(qual, callee)
+                if res is None or res not in scans:
+                    continue
+                for _, site, _n in self._bare_sites(scans[res]):
+                    yield from self._one(
+                        ctx, node, lock,
+                        f"{site} (inside self.{callee}(), called here "
+                        f"while {lock} is held)")
+                    break  # one finding per call edge is enough
+
+    @staticmethod
+    def _bare_sites(scan: _HoldScan):
+        """Blocking sites in a callee that run under the CALLER's lock:
+        everything not already attributed to a lock of its own."""
+        seen_nodes = {id(n) for _, _, n in scan.blocked}
+        out = []
+        for node in ast.walk(scan.root):
+            if isinstance(node, ast.Call) and id(node) not in seen_nodes:
+                site = _blocking_site(node)
+                if site:
+                    out.append((None, site, node))
+        return out
+
+    def _emit(self, ctx: FileContext, where: str, scan: _HoldScan,
+              _unused) -> Iterator[Finding]:
+        for lock, site, node in scan.blocked:
+            yield from self._one(ctx, node, lock, site, where)
+
+    def _one(self, ctx: FileContext, node: ast.AST, lock: str,
+             site: str, where: str = "") -> Iterator[Finding]:
+        prefix = f"{where}: " if where else ""
+        yield ctx.finding(
+            self, node,
+            f"{prefix}{site} while holding {lock} — every thread "
+            "needing this lock (heartbeats, counters, the close path) "
+            "stalls behind a peer/device that may never answer; the "
+            "serve-tier deadlock was exactly this shape")
+
+
+# -- FT023 --------------------------------------------------------------------
+
+class ShutdownReachabilityRule(Rule):
+    id = "FT023"
+    title = ("close() path missing a teardown edge: started worker "
+             "with no stop signal, owned resource never released, or "
+             "non-idempotent close (unguarded shutdown())")
+    hint = ("set the worker's stop flag/Event/sentinel from close, "
+            "release every owned handle there, and guard "
+            "sock.shutdown() with try/except OSError so a second "
+            "close is a no-op")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _gate(ctx, "Thread(", "Timer(", "def close", "def stop",
+                     "def shutdown"):
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            life = _life(ctx, cls)
+            if not life.close_methods:
+                continue
+            signals = life.close_stop_signals()
+            for w in life.workers:
+                if w.attr is None:
+                    continue
+                if signals or life.joined_from_close(w.attr):
+                    continue
+                yield ctx.finding(
+                    self, w.node,
+                    f"{cls.name} starts {w.kind} self.{w.attr} but "
+                    f"{'/'.join(life.close_methods)} sets no stop "
+                    "signal (no closed flag, stop-Event set(), queue "
+                    "sentinel, cancel, or socket teardown) — the "
+                    "worker never learns the owner is gone and spins "
+                    "until process exit")
+            for res in life.resources:
+                if life.attr_in_close_path(res.attr):
+                    continue
+                yield ctx.finding(
+                    self, res.node,
+                    f"{cls.name} acquires {res.kind} self.{res.attr} "
+                    f"but the close path "
+                    f"({'/'.join(life.close_methods)}) never touches "
+                    "it — the handle outlives the owner (the leaked "
+                    "TCP listener / EADDRINUSE shape)")
+            for qual in life.close_methods:
+                for line in life.unguarded_shutdowns.get(qual, []):
+                    snippet = (ctx.lines[line - 1].strip()
+                               if 0 < line <= len(ctx.lines) else "")
+                    f = Finding(
+                        rule=self.id, path=ctx.relpath, line=line,
+                        message=f"{cls.name}.{qual} calls shutdown() "
+                                "outside try/except — socket.shutdown "
+                                "raises OSError on an already-closed "
+                                "socket, so the second close() crashes "
+                                "instead of no-opping (close must be "
+                                "idempotent)",
+                        hint=self.hint, snippet=snippet)
+                    yield f
+
+
+# -- FT024 --------------------------------------------------------------------
+
+class SubmitAfterCloseRule(Rule):
+    id = "FT024"
+    title = ("public enqueue method does not check the closed flag "
+             "before a blocking put — submit() after close() parks the "
+             "caller on a dead worker")
+    hint = ("read the closed flag (or stop-Event) first and shed "
+            "immediately; the worker that would drain the queue is "
+            "gone")
+
+    def applies(self, relpath: str) -> bool:
+        return not is_test_path(relpath)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _gate(ctx, ".put("):
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            life = _life(ctx, cls)
+            if not life.close_methods:
+                continue
+            flags = self._close_flags(life)
+            if not flags:
+                continue
+            for qual, fn in life.model.funcs.items():
+                if "." in qual or qual.startswith("_") or \
+                        _is_close_name(qual):
+                    continue
+                reads = {a.attr for a in fn.accesses if not a.is_write}
+                if reads & flags:
+                    continue
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Call) or \
+                            not isinstance(node.func, ast.Attribute):
+                        continue
+                    if node.func.attr != "put":
+                        continue
+                    recv = _self_attr(node.func.value)
+                    if recv is None or not _queueish(recv):
+                        continue
+                    yield ctx.finding(
+                        self, node,
+                        f"{cls.name}.{qual}() blocks on "
+                        f"self.{recv}.put() without reading the closed "
+                        f"flag ({'/'.join(sorted(flags))}) first — "
+                        "after close() nothing drains the queue and "
+                        "the caller parks for the full timeout (the "
+                        "30 s dead-worker hang)")
+
+    @staticmethod
+    def _close_flags(life: _ClassLife) -> Set[str]:
+        """Attrs the close path uses as its closed signal (flag assigns
+        and stop-Event sets — the signals a submit CAN check)."""
+        flags: Set[str] = set()
+        for qual in life.close_methods:
+            for sig in life.stop_signals.get(qual, []):
+                name = sig.split("=")[0].split(".")[0]
+                if _stoppish(name) and ("=" in sig or ".set()" in sig):
+                    flags.add(name)
+        return flags
+
+
+# -- shutdown graph (FT025) ---------------------------------------------------
+
+_FT025_HINT = ("review the worker/resource change, then "
+               "--write-shutdown-graph")
+
+
+def _module_of(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    return p.replace("/", ".")
+
+
+def extract_shutdown_graph(ctxs: Sequence[FileContext]) -> Dict:
+    """-> the line-bearing resource/shutdown graph over every library
+    class that owns a worker or a resource (the ``runs/`` artifact):
+    the reviewer's shutdown map, one entry per owner."""
+    classes: List[Dict] = []
+    for ctx in ctxs:
+        if is_test_path(ctx.relpath):
+            continue
+        if not _gate(ctx, "Thread(", "Timer(", "socket", "open(",
+                     "Popen("):
+            continue
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            life = _life(ctx, cls)
+            if not life.workers and not life.resources:
+                continue
+            workers = []
+            for w in sorted(life.workers, key=lambda w: w.line):
+                workers.append({
+                    "kind": w.kind,
+                    "attr": w.attr,
+                    "target": w.target,
+                    "daemon": w.daemon,
+                    "created_in": w.created_in,
+                    "line": w.line,
+                    "joined_in": sorted(life.join_sites.get(w.attr, ()))
+                    if w.attr else [],
+                })
+            resources = []
+            for r in sorted(life.resources, key=lambda r: r.line):
+                resources.append({
+                    "kind": r.kind,
+                    "attr": r.attr,
+                    "created_in": r.created_in,
+                    "line": r.line,
+                    "released_in": sorted(
+                        q for q, attrs in life.release_sites.items()
+                        if r.attr in attrs),
+                })
+            classes.append({
+                "class": cls.name,
+                "module": _module_of(ctx.relpath),
+                "path": ctx.relpath,
+                "workers": workers,
+                "resources": resources,
+                "close_methods": life.close_methods,
+                "stop_signals": sorted(set(life.close_stop_signals())),
+            })
+    classes.sort(key=lambda c: (c["module"], c["class"]))
+    return {"version": GRAPH_VERSION, "classes": classes}
+
+
+def normalize_graph(graph: Dict) -> Dict:
+    """Line-free, path-free shape for the checked-in snapshot."""
+    classes = []
+    for c in graph["classes"]:
+        classes.append({
+            "class": c["class"],
+            "module": c["module"],
+            "workers": [{k: v for k, v in w.items() if k != "line"}
+                        for w in c["workers"]],
+            "resources": [{k: v for k, v in r.items() if k != "line"}
+                          for r in c["resources"]],
+            "close_methods": c["close_methods"],
+            "stop_signals": c["stop_signals"],
+        })
+    payload = {"version": GRAPH_VERSION,
+               "classes": sorted(classes,
+                                 key=lambda c: (c["module"], c["class"]))}
+    blob = json.dumps(payload, sort_keys=True)
+    payload["fingerprint"] = hashlib.sha1(blob.encode()).hexdigest()[:16]
+    return payload
+
+
+def snapshot_findings(graph: Dict, snapshot_path: Path) -> List[Finding]:
+    norm = normalize_graph(graph)
+    path = Path(snapshot_path)
+    if not path.exists():
+        return [Finding(
+            rule="FT025", path=str(snapshot_path), line=0,
+            message="shutdown-graph snapshot is MISSING — worker/"
+                    "resource teardown edges cannot drift-check, and a "
+                    "silently skipped check is the failure mode this "
+                    "pass exists to prevent",
+            hint=_FT025_HINT)]
+    try:
+        old = json.loads(path.read_text())
+    except (OSError, ValueError) as exc:
+        return [Finding(
+            rule="FT025", path=str(snapshot_path), line=0,
+            message=f"shutdown-graph snapshot is unreadable ({exc}) — "
+                    "regenerate it",
+            hint=_FT025_HINT)]
+    if old.get("fingerprint") == norm["fingerprint"]:
+        return []
+    key = lambda c: f"{c['module']}.{c['class']}"  # noqa: E731
+    old_c = {key(c): c for c in old.get("classes", [])}
+    new_c = {key(c): c for c in norm["classes"]}
+    changes: List[str] = []
+    for k in sorted(set(new_c) - set(old_c)):
+        changes.append(f"new owner {k}")
+    for k in sorted(set(old_c) - set(new_c)):
+        changes.append(f"removed owner {k}")
+    for k in sorted(set(old_c) & set(new_c)):
+        if old_c[k] != new_c[k]:
+            diff = [part for part in ("workers", "resources",
+                                      "close_methods", "stop_signals")
+                    if old_c[k].get(part) != new_c[k].get(part)]
+            changes.append(f"{k}: {'/'.join(diff) or 'shape'} changed")
+    detail = "; ".join(changes) or "graph fingerprint changed"
+    return [Finding(
+        rule="FT025", path=str(snapshot_path), line=0,
+        message="shutdown graph drifted from the checked-in snapshot: "
+                f"{detail}",
+        hint=_FT025_HINT)]
+
+
+def write_graph(graph: Dict, artifact_path: Path,
+                snapshot_path: Optional[Path] = None) -> None:
+    artifact_path = Path(artifact_path)
+    artifact_path.parent.mkdir(parents=True, exist_ok=True)
+    artifact_path.write_text(json.dumps(graph, indent=2, sort_keys=True)
+                             + "\n")
+    if snapshot_path is not None:
+        snapshot_path = Path(snapshot_path)
+        snapshot_path.parent.mkdir(parents=True, exist_ok=True)
+        snapshot_path.write_text(
+            json.dumps(normalize_graph(graph), indent=2, sort_keys=True)
+            + "\n")
+
+
+def check_lifecycle(ctxs: Sequence[FileContext], snapshot_path: Path,
+                    artifact_path: Optional[Path] = None,
+                    write_snapshot: bool = False
+                    ) -> Tuple[List[Finding], Dict]:
+    """The CLI entry for the whole-program half: extract the shutdown
+    graph, emit the artifact, snapshot-check (FT020–FT024 are per-file
+    Rules and run in the lint pass). ``write_snapshot`` refreshes
+    instead of comparing — a snapshot never launders a rule finding,
+    only the graph shape."""
+    graph = extract_shutdown_graph(ctxs)
+    if artifact_path is not None:
+        write_graph(graph, artifact_path)
+    findings: List[Finding] = []
+    if write_snapshot:
+        snapshot_path = Path(snapshot_path)
+        snapshot_path.parent.mkdir(parents=True, exist_ok=True)
+        snapshot_path.write_text(
+            json.dumps(normalize_graph(graph), indent=2, sort_keys=True)
+            + "\n")
+    else:
+        findings.extend(snapshot_findings(graph, snapshot_path))
+    return findings, graph

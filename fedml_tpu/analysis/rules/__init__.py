@@ -44,13 +44,20 @@ from fedml_tpu.analysis.rules.metrics_names import MetricNameRule
 from fedml_tpu.analysis.rules.population_growth import PopulationGrowthRule
 from fedml_tpu.analysis.rules.rng import GlobalRngRule
 from fedml_tpu.analysis.rules.server_state import ServerStateRule
+from fedml_tpu.analysis.lifecycle import (BlockingUnderLockRule,
+                                          LeakOnRaiseRule,
+                                          ShutdownReachabilityRule,
+                                          SubmitAfterCloseRule,
+                                          ThreadLifecycleRule)
 
 _RULES = (GlobalRngRule, DonatedReuseRule, HostSyncRule,
           JitScalarArgRule, BroadExceptRule, Float64Rule,
           CommTimeoutRule, PopulationGrowthRule, ServerStateRule,
           SharedStateLockRule, LockOrderRule,
           FsEnumOrderRule, SetIterationOrderRule,
-          WallClockControlFlowRule, MetricNameRule, JobIsolationRule)
+          WallClockControlFlowRule, MetricNameRule, JobIsolationRule,
+          ThreadLifecycleRule, LeakOnRaiseRule, BlockingUnderLockRule,
+          ShutdownReachabilityRule, SubmitAfterCloseRule)
 
 #: engine / whole-program / audit checks that are not per-file Rule
 #: instances but are part of the rule surface
@@ -138,6 +145,11 @@ _EXTRA_RULE_ROWS = (
      "title": "round-shape audit: extracted map drifted from the "
               "snapshot",
      "hint": "review the round-shape change, then --write-round-map"},
+    {"id": "FT025",
+     "title": "lifecycle audit: ci/shutdown_graph.json snapshot missing "
+              "or drifted from the extracted worker/resource graph",
+     "hint": "review the worker/resource change, then "
+             "--write-shutdown-graph"},
 )
 
 #: every rule id that must have a pos/neg corpus pair (meta-tested);

@@ -65,7 +65,12 @@ class _RawPeer:
 
     def _serve(self) -> None:
         from fedml_tpu.comm.tcp import recv_frame
-        conn, _ = self._server.accept()
+        try:
+            conn, _ = self._server.accept()
+        except OSError:
+            # close() tore down the listener before any connect
+            # arrived — nothing to sink
+            return
         try:
             if self.stall_s:
                 time.sleep(self.stall_s)
@@ -78,10 +83,16 @@ class _RawPeer:
             conn.close()
             self._server.close()
 
+    def close(self) -> None:
+        """Idempotent: releases the listener (unblocking a
+        never-connected ``accept()``) so the port can be rebound by the
+        next stage immediately instead of leaking for the process
+        lifetime."""
+        self._server.close()
+        self._thread.join(timeout=1.0)
+
 
 def stage_transport(port_base: int) -> None:
-    from fedml_tpu.comm.message import Message
-    from fedml_tpu.comm.serialization import SharedPayload
     from fedml_tpu.comm.tcp import TcpCommManager
 
     n_peers = 4
@@ -90,7 +101,22 @@ def stage_transport(port_base: int) -> None:
     peers = {r: _RawPeer(port_base + r,
                          stall_s=STALL_S if r == slow_rank else 0.0)
              for r in range(1, n_peers + 1)}
-    com = TcpCommManager(0, addresses)
+    try:
+        com = TcpCommManager(0, addresses)
+        try:
+            _stage_transport_run(com, peers, n_peers, slow_rank)
+        finally:
+            # a _fail() mid-stage must not strand the bound listener:
+            # stage 2 rebinds the same port range in this process
+            com.stop_receive_message()
+    finally:
+        for peer in peers.values():
+            peer.close()
+
+
+def _stage_transport_run(com, peers, n_peers: int, slow_rank: int) -> None:
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.serialization import SharedPayload
 
     rng = np.random.default_rng(0)
     tree = {"w": rng.standard_normal(
@@ -120,7 +146,6 @@ def stage_transport(port_base: int) -> None:
     while time.monotonic() < slow_deadline \
             and peers[slow_rank].done_t is None:
         time.sleep(0.01)
-    com.stop_receive_message()
 
     if errors:
         _fail(f"stage 1: broadcast surfaced errors: {errors}")

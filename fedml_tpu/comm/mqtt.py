@@ -290,6 +290,7 @@ class MiniMqttBroker:
                     with self._lock:
                         for t in self._subs.get(topic, ()):
                             try:
+                                # ft: allow[FT022] the broker lock IS the per-subscriber interleave guard: sendall must happen under it or two publishers corrupt one sub's byte stream; subs are loopback sockets, worst case bounded by the kernel buffer
                                 t.sendall(frame)
                             # ft: allow[FT007] dead sub detaches itself
                             except OSError:

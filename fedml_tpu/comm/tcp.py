@@ -390,6 +390,13 @@ class TcpCommManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self._running = False
+        # close the listener here, not only from the accept loop: a
+        # sender-only manager (broadcast without handle_receive_message)
+        # never starts that loop, and the bound port must not outlive
+        # its owner (EADDRINUSE on relaunch). socket.close() is
+        # idempotent, so the accept loop's own close on exit stays safe
+        # and so does calling stop twice.
+        self._server.close()
         self._inbox.put(_STOP)
         with self._peers_lock:
             for peer in self._peers.values():

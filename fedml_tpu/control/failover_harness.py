@@ -161,6 +161,25 @@ def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
     size = workers + 1
     com = _make_com("TCP", 0, size,
                     addresses=make_addresses(port_base, size))
+    try:
+        return _serve_with(com, workers, rounds, ckpt_dir,
+                           deadline_s=deadline_s,
+                           min_quorum_frac=min_quorum_frac, pace=pace,
+                           join_rate_limit=join_rate_limit,
+                           max_deadline_extensions=max_deadline_extensions,
+                           join_timeout_s=join_timeout_s,
+                           obs_dir=obs_dir)
+    finally:
+        # the listener must not survive a raise: the supervisor
+        # relaunches this incarnation on the SAME port, and a leaked
+        # bind turns every failover into EADDRINUSE
+        com.stop_receive_message()
+
+
+def _serve_with(com, workers: int, rounds: int, ckpt_dir: str, *,
+                deadline_s: float, min_quorum_frac: float, pace: bool,
+                join_rate_limit: float, max_deadline_extensions: int,
+                join_timeout_s: float, obs_dir: Optional[str]) -> int:
     server = _build_server(com, workers, rounds, ckpt_dir,
                            deadline_s=deadline_s,
                            min_quorum_frac=min_quorum_frac, pace=pace,
@@ -188,7 +207,6 @@ def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
     with open(tmp, "w") as f:
         json.dump(summary, f)
     os.replace(tmp, os.path.join(ckpt_dir, "server_summary.json"))
-    com.stop_receive_message()
     return 0 if done else 1
 
 

@@ -140,6 +140,7 @@ class ModelEndpoint:
                 with self._device_lock:
                     pre = jax.device_put(variables)
                     jax.block_until_ready(pre)
+                # ft: allow[FT022] first-install only: the bucket ladder must be compiled under the swap gate so no request observes a half-warmed endpoint; every later install skips this branch
                 self._warm(pre)
                 self._warmed = True
                 t0 = time.perf_counter()

@@ -1,0 +1,39 @@
+"""FT020 negative: every start site has a lifecycle — daemon'd in the
+ctor, daemon'd by post-ctor assignment, or non-daemon but joined from
+the owner's close path."""
+import threading
+
+
+class DaemonWriter:
+    """Daemon in the constructor: exits with the process."""
+
+    def __init__(self):
+        self._writer = threading.Thread(target=self._loop, daemon=True)
+        self._writer.start()
+
+    def _loop(self):
+        return None
+
+
+class JoinedWriter:
+    """Non-daemon, but close() signals and joins it — the sanctioned
+    deliberate-teardown shape."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._writer = threading.Thread(target=self._loop)
+        self._writer.start()
+
+    def _loop(self):
+        self._stop.wait(timeout=1.0)
+
+    def close(self):
+        self._stop.set()
+        self._writer.join(timeout=5.0)
+
+
+def start_background(fn):
+    t = threading.Thread(target=fn)
+    t.daemon = True
+    t.start()
+    return None

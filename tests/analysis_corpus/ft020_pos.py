@@ -1,0 +1,25 @@
+"""FT020 positive: the pre-fix writer-thread shape — a non-daemon
+worker started in ``__init__`` with no close/stop/join path anywhere on
+the class (process exit hangs on the live thread), plus a local thread
+started and forgotten inside a helper."""
+import threading
+
+
+class WriterPool:
+    """Owns a writer thread but no teardown at all: not daemon'd, never
+    joined — interpreter shutdown blocks on it forever."""
+
+    def __init__(self):
+        self._items = []
+        self._writer = threading.Thread(target=self._loop)
+        self._writer.start()
+
+    def _loop(self):
+        while self._items:
+            self._items.pop()
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return None

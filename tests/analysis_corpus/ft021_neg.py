@@ -1,0 +1,36 @@
+"""FT021 negative: every acquisition is protected — with-block,
+try/finally, escape to a registry, or init-assignment to a self-attr
+on a class that ships a close path (escaped-to-owner)."""
+import json
+import socket
+
+
+def launch(port, config_text):
+    server = socket.create_server(("127.0.0.1", port))
+    try:
+        cfg = json.loads(config_text)
+        return cfg
+    finally:
+        server.close()
+
+
+def probe_header(path):
+    with open(path, "rb") as fh:
+        return fh.read(16)
+
+
+def reserve_into(registry, port):
+    sock = socket.create_server(("127.0.0.1", port))
+    registry.append(sock)
+    return None
+
+
+class PortReserver:
+    """Init-assignment to a self-attr with a class-level close: the
+    owner's teardown is the release edge (FT023's jurisdiction)."""
+
+    def __init__(self, port):
+        self._server = socket.create_server(("127.0.0.1", port))
+
+    def close(self):
+        self._server.close()

@@ -1,0 +1,28 @@
+"""FT021 positive: the launch-federation leak shape — a listening
+socket bound, then raise-capable work, then (maybe) a release with no
+try/finally in between; and an owner class that binds a handle but
+ships no close method at all. A raise leaves the port bound
+(EADDRINUSE on relaunch) or the fd open for the process lifetime."""
+import json
+import socket
+
+
+def launch(port, config_text):
+    server = socket.create_server(("127.0.0.1", port))
+    cfg = json.loads(config_text)
+    server.close()
+    return cfg
+
+
+def probe_header(path):
+    fh = open(path, "rb")
+    header = fh.read(16)
+    return header
+
+
+class PortReserver:
+    """Binds in __init__, defines no close/stop/shutdown — the handle
+    can never be released."""
+
+    def __init__(self, port):
+        self._server = socket.create_server(("127.0.0.1", port))

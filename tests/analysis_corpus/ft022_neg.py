@@ -1,0 +1,30 @@
+"""FT022 negative: blocking work happens OUTSIDE the shared lock (or
+is non-blocking under it) — the lock guards only the bookkeeping, and
+device dispatch sits under its own dedicated device gate."""
+import queue
+import threading
+
+
+class Coalescer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._device_lock = threading.Lock()
+        self._box = queue.Queue(maxsize=8)
+        self._seq = 0
+
+    def submit(self, item):
+        with self._lock:
+            self._seq += 1
+            self._box.put_nowait(item)
+        return self._seq
+
+    def submit_patient(self, item):
+        with self._lock:
+            self._seq += 1
+        self._box.put(item, timeout=1.0)
+        return self._seq
+
+    def flush(self):
+        with self._lock:
+            pending = self._seq
+        return self._box.get(timeout=1.0), pending

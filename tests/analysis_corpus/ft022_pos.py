@@ -1,0 +1,27 @@
+"""FT022 positive: the serve-coalescer deadlock shape — a blocking
+``put`` into the consumer's own bounded queue while holding the lock
+that the consumer needs to drain it; plus the same hazard one call
+level down (the blocking site lives in a helper invoked under the
+lock)."""
+import queue
+import threading
+
+
+class Coalescer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._box = queue.Queue(maxsize=8)
+        self._seq = 0
+
+    def submit(self, item):
+        with self._lock:
+            self._seq += 1
+            self._box.put(item)
+        return self._seq
+
+    def _drain_one_locked(self):
+        return self._box.get()
+
+    def flush(self):
+        with self._lock:
+            return self._drain_one_locked()

@@ -1,0 +1,40 @@
+"""FT023 negative: every started worker's stop signal is set on a path
+from the owner's close, every acquired handle is released there, and
+the delegated-teardown shape (close() cascading into a member's own
+close) counts."""
+import threading
+
+
+class Follower:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._stop.wait(timeout=1.0)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+class Recorder:
+    def __init__(self, path):
+        self._done = False
+        self._fh = open(path, "ab")
+
+    def close(self):
+        self._done = True
+        self._fh.close()
+
+
+class Router:
+    """Delegated teardown: stop() cascades into the owned transport's
+    own close path."""
+
+    def __init__(self, transport):
+        self.physical = transport
+
+    def stop(self):
+        self.physical.stop_receive_message()

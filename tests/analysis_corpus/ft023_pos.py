@@ -1,0 +1,34 @@
+"""FT023 positive: close paths that forget their obligations — a
+close() that never sets the worker's stop event (the thread outlives
+its owner), and a close() that never releases the file handle the
+ctor acquired."""
+import threading
+
+
+class Follower:
+    """close() exists but sets no stop signal and joins nothing: the
+    daemon loop keeps running against a torn-down owner."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._stop.wait(timeout=1.0)
+
+    def close(self):
+        """Forgets self._stop.set() and the join."""
+        return None
+
+
+class Recorder:
+    """close() flips a flag but never touches the handle the ctor
+    opened — the fd outlives the owner."""
+
+    def __init__(self, path):
+        self._done = False
+        self._fh = open(path, "ab")
+
+    def close(self):
+        self._done = True

@@ -1,0 +1,18 @@
+"""FT024 negative: the public enqueue sheds immediately when the
+closed flag is up — the post-fix coalescer shape."""
+import queue
+
+
+class Pool:
+    def __init__(self):
+        self._box = queue.Queue(maxsize=4)
+        self._closed = False
+
+    def close(self):
+        self._closed = True
+
+    def submit(self, item):
+        if self._closed:
+            raise RuntimeError("pool is closed")
+        self._box.put(item, timeout=30.0)
+        return True

@@ -1,0 +1,18 @@
+"""FT024 positive: the dead-worker hang shape — close() flips the
+closed flag, but the public submit() blocks on the bounded queue
+without reading it first; after close() nothing drains, so the caller
+parks for the full 30 s timeout."""
+import queue
+
+
+class Pool:
+    def __init__(self):
+        self._box = queue.Queue(maxsize=4)
+        self._closed = False
+
+    def close(self):
+        self._closed = True
+
+    def submit(self, item):
+        self._box.put(item, timeout=30.0)
+        return True
