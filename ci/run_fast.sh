@@ -22,6 +22,14 @@ JAX_PLATFORMS=cpu python -m fedml_tpu.state.population \
 rm -rf runs/obs_smoke && mkdir -p runs/obs_smoke
 JAX_PLATFORMS=cpu python -m fedml_tpu.control.failover_harness --smoke \
     --ckpt_dir runs/obs_smoke --obs_dir runs/obs_smoke/flight
+# same SIGKILL smoke under the LEGACY inline checkpointer: the default
+# leg above exercises the async writer (coalescing slot, writer-thread
+# fsync, restore-on-older-boundary + ledger replay); this leg pins
+# --checkpoint_sync to the old synchronous semantics so both durability
+# modes keep the bit-exact failover contract
+rm -rf runs/obs_smoke_sync && mkdir -p runs/obs_smoke_sync
+JAX_PLATFORMS=cpu python -m fedml_tpu.control.failover_harness --smoke \
+    --checkpoint_sync --ckpt_dir runs/obs_smoke_sync
 JAX_PLATFORMS=cpu python -m fedml_tpu.obs merge runs/obs_smoke/flight \
     --ledger runs/obs_smoke/killed/ledger.jsonl \
     --output runs/obs_smoke/merged.json

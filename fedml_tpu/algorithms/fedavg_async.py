@@ -223,6 +223,7 @@ def run_fedavg_async(dataset, module, task: str = "classification",
                      timer=None, heartbeat_s: float = 0.0,
                      fault_plan=None,
                      server_checkpoint_dir=None,
+                     checkpoint_sync: bool = False,
                      pace_steering: bool = False,
                      join_rate_limit: float = 0.0,
                      max_deadline_extensions=25):
@@ -273,7 +274,8 @@ def run_fedavg_async(dataset, module, task: str = "classification",
         server_checkpoint_dir=server_checkpoint_dir,
         pace_steering=pace_steering, join_rate_limit=join_rate_limit,
         round_deadline_s=round_deadline_s,
-        max_deadline_extensions=max_deadline_extensions)
+        max_deadline_extensions=max_deadline_extensions,
+        checkpoint_sync=checkpoint_sync)
         if mode == "quorum" else {})
 
     def server_factory(size, server_com, aggregator, global_model,

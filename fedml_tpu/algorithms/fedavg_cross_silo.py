@@ -461,6 +461,17 @@ class FedAvgServerManager(ServerManager):
         #: so these deliberately stay out of the checkpoint manifest)
         self._wire_credited_up = 0
         self._wire_credited_down = 0
+        #: serialization version token for the global model: bumped on
+        #: every reassignment (aggregation, restore) so the incremental
+        #: snapshot serializer and the capture cache below know when the
+        #: cached bytes are still the model's bytes. Pure derived
+        #: accounting — deliberately NOT in the checkpoint manifest (a
+        #: restored server starts a fresh serializer cache anyway)
+        self._model_version = 0
+        #: (model version, captured state-dict) pair: mid-round snapshots
+        #: (deadline extensions) re-capture the UNCHANGED global model —
+        #: the cache skips that D2H + tree copy entirely
+        self._gm_capture_cache = None
         #: terminal latch: set (with a FINISH sweep) when the schedule
         #: cannot make progress; launch_federation re-raises it
         self.scheduling_error: Optional[Exception] = None
@@ -514,7 +525,12 @@ class FedAvgServerManager(ServerManager):
         from flax import serialization as fser
         agg = self.aggregator
         with self._device_lock:  # D2H transfers are device dispatches
-            gm = fser.to_state_dict(_to_numpy(self.global_model))
+            cache = self._gm_capture_cache
+            if cache is not None and cache[0] == self._model_version:
+                gm = cache[1]
+            else:
+                gm = fser.to_state_dict(_to_numpy(self.global_model))
+                self._gm_capture_cache = (self._model_version, gm)
             # the streaming aggregator's pending buffer holds only the
             # not-yet-folded reports; the folded prefix rides in agg_fold
             pending = {str(w): fser.to_state_dict(_to_numpy(m))
@@ -646,17 +662,47 @@ class FedAvgServerManager(ServerManager):
                               if rc is not None else None)
         if self._pace is not None:
             self._pace.load_state(state.get("pace"))
+        # the restored model is a new object: invalidate the capture
+        # cache and bump the serialization token so the next snapshot
+        # re-serializes it instead of reusing pre-restore bytes
+        self._gm_capture_cache = None
+        self._model_version += 1
         self._restore_extra(state)
 
     def _save_control_snapshot(self) -> None:
         """Durably snapshot the control state (no-op without a
         checkpointer). A failed save warns loudly but never kills the
-        round loop — the federation keeps training, unprotected."""
+        round loop — the federation keeps training, unprotected.
+
+        With the async writer this is an O(capture) hand-off: the round
+        thread pays the host copy only (``cp_capture_ms``); the
+        serialize+fsync+publish cost (``cp_flush_ms``) rides the writer
+        thread (the last COMPLETED flush is reported — a gauge, not an
+        in-flight probe). In ``--checkpoint_sync`` mode both phases run
+        inline here, which is exactly what the ``round_overheads`` bench
+        measures against."""
         if self._server_ckpt is None:
             return
         try:
-            self._server_ckpt.save(self._capture_control_state())
+            t0 = time.perf_counter()
+            state = self._capture_control_state()
+            # version tokens for the incremental serializer: the model's
+            # bytes change only at aggregation/restore; the mirror's
+            # only when a broadcast advances it
+            versions = {"global_model": int(self._model_version),
+                        "mirror": int(self._bcast_seq)}
+            t1 = time.perf_counter()
+            self._server_ckpt.save(state, versions=versions)
+            t2 = time.perf_counter()
             self.cp_counters["checkpoints"] += 1
+            tm = getattr(self, "round_timer", None)
+            if tm is not None:
+                tm.gauge("cp_capture_ms", (t1 - t0) * 1e3)
+                stats_fn = getattr(self._server_ckpt, "stats", None)
+                if stats_fn is not None:  # async: writer-thread flush
+                    tm.gauge("cp_flush_ms", stats_fn()["last_flush_ms"])
+                else:  # sync: the save() above ran the flush inline
+                    tm.gauge("cp_flush_ms", (t2 - t1) * 1e3)
         except Exception:
             logging.warning(
                 "server control snapshot failed at round %d — the "
@@ -801,6 +847,28 @@ class FedAvgServerManager(ServerManager):
                 logging.warning("FINISH to silo %d failed (%r) — peer "
                                 "already gone", worker, exc)
         self.finish()
+        # close barrier: the async writer publishes its pending snapshot
+        # and the ledger flush-on-close fsyncs before the launcher (or
+        # the extension-exhaustion error path) lets the process die. The
+        # synchronous checkpointer's close is the same ledger flush.
+        if self._server_ckpt is not None:
+            try:
+                self._server_ckpt.close()
+            except Exception:
+                logging.warning("checkpoint close barrier failed",
+                                exc_info=True)
+            # fold the run's durability counters into the timer AFTER
+            # the close barrier (flush-on-close fsyncs included) so the
+            # overheads bench reads fsyncs-per-run without reaching
+            # into the now-closed checkpointer
+            tm = getattr(self, "round_timer", None)
+            if tm is not None:
+                raw = getattr(self._server_ckpt, "inner",
+                              self._server_ckpt)
+                tm.count("cp_fsync_total",
+                         int(getattr(raw, "fsync_count", 0)))
+                tm.count("cp_ledger_fsyncs",
+                         int(getattr(raw, "ledger_fsync_count", 0)))
 
     # -- downlink compression (comm/policy.py, comm/compression.py) ---------
     def _silos_in_sync(self) -> bool:
@@ -858,11 +926,15 @@ class FedAvgServerManager(ServerManager):
             self._mirror = full
             self._mirror_fp = tree_fingerprint(full)
             return full
+        t0 = time.perf_counter()
         with self._device_lock:  # delta compression is device compute
             key = jax.random.fold_in(jax.random.key(1733), self._bcast_seq)
             payload, _ = compress_for_policy(full, self._mirror, None, key,
                                              pol)
             self._mirror = _to_numpy(decompress(payload, self._mirror))
+        tm = getattr(self, "round_timer", None)
+        if tm is not None:
+            tm.gauge("codec_encode_ms", (time.perf_counter() - t0) * 1e3)
         return payload
 
     def _broadcast_model(self, msg_type: int, idxs) -> None:
@@ -1099,6 +1171,8 @@ class FedAvgServerManager(ServerManager):
         t0 = time.monotonic()
         with self._device_lock:
             self.global_model = self._aggregate_round(partial=partial)
+        # aggregation produced a new model: its serialized bytes changed
+        self._model_version += 1
         tm = getattr(self, "round_timer", None)
         if tm is not None:
             # the close is just the residual-suffix drain + normalize
@@ -1163,6 +1237,15 @@ class FedAvgServerManager(ServerManager):
                 self.round_idx,
                 round_rec["duration_s"] if round_rec else None,
                 record=round_rec)
+            # group-commit telemetry: flight fsync batches since the
+            # last close (credited after end_round, so the counter rolls
+            # into the NEXT round's delta — totals stay exact)
+            pop_fb = getattr(getattr(self.obs, "recorder", None),
+                             "pop_fsync_batches", None)
+            if pop_fb is not None and tm is not None:
+                batches = pop_fb()
+                if batches:
+                    tm.count("obs_fsync_batches", batches)
         deadline_used = self.round_deadline_s
         self.round_idx += 1
         if self.checkpoint_mgr is not None:
@@ -1207,6 +1290,15 @@ class FedAvgServerManager(ServerManager):
                 "partial": bool(partial),
                 "deadline_s": deadline_used})
             self._save_control_snapshot()
+            # async-writer backpressure telemetry: snapshots skipped by
+            # the depth-1 newest-wins slot since the last close
+            pop = getattr(self._server_ckpt, "pop_coalesced", None)
+            if pop is not None:
+                coalesced = pop()
+                if coalesced:
+                    tm = getattr(self, "round_timer", None)
+                    if tm is not None:
+                        tm.count("cp_writer_queue_coalesced", coalesced)
         if self.round_idx == self.comm_round:
             self._finish_federation()
             return
@@ -1560,6 +1652,7 @@ class FedAvgClientManager(ClientManager):
                  train_cfg: TrainConfig, seed: int = 0,
                  compress: bool = False, compression=None,
                  state_dir: Optional[str] = None, resume: bool = False,
+                 state_sync: bool = False,
                  prefetch_depth: int = 2,
                  heartbeat_s: float = 0.0,
                  rejoin_idle_s: Optional[float] = None,
@@ -1634,7 +1727,11 @@ class FedAvgClientManager(ClientManager):
         self._state_ckpt = None
         if state_dir and self._policy.uplink_topk:
             from fedml_tpu.state.residuals import SiloResidualStore
-            self._state_ckpt = SiloResidualStore(state_dir)
+            # async write-back by default: the residual flush rides a
+            # writer thread off the reply critical path (--checkpoint_sync
+            # forces the old inline semantics federation-wide)
+            self._state_ckpt = SiloResidualStore(
+                state_dir, async_writeback=not state_sync)
         # async round pipeline (parallel/prefetch.py): the server's
         # client_sampling is the deterministic shared stream
         # (core/sampling.sample_clients), so this silo can predict which
@@ -1797,10 +1894,18 @@ class FedAvgClientManager(ClientManager):
 
     def _handle_finish(self, msg: Message) -> None:
         # nothing follows FINISH: release speculated shards + the worker
-        # thread, then shut the protocol down
+        # thread, then shut the protocol down. The residual store's close
+        # is the write-back durability barrier — every async save() this
+        # run requested is on disk before the protocol exits.
         self._hb_stop.set()
         if self._prefetch is not None:
             self._prefetch.close()
+        if self._state_ckpt is not None:
+            try:
+                self._state_ckpt.close()
+            except Exception:
+                logging.exception("silo %d: residual store close failed",
+                                  self.rank)
         self.finish()
 
     def _apply_broadcast(self, msg: Message):
@@ -2013,6 +2118,7 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
                           heartbeat_s: float = 0.0,
                           fault_plan=None,
                           server_checkpoint_dir: Optional[str] = None,
+                          checkpoint_sync: bool = False,
                           pace_steering: bool = False,
                           join_rate_limit: float = 0.0,
                           max_deadline_extensions: Optional[int] = 25,
@@ -2049,6 +2155,11 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
     ``server_checkpoint_dir`` snapshots the server's full round-schedule
     state at round boundaries and deadline closes (a killed-and-restarted
     server resumes mid-schedule and appends to the round/cohort ledger);
+    snapshots are written ASYNCHRONOUSLY by default (a dedicated writer
+    thread with newest-wins coalescing and group-committed ledger fsyncs
+    — restore may land a few rounds back and replay forward to the same
+    ledger); ``checkpoint_sync`` forces the legacy inline
+    snapshot-at-every-boundary durability;
     ``pace_steering`` derives each round's deadline (p90·margin, clamped)
     and quorum target from the observed report-latency distribution,
     using the static flags as base/floor; ``join_rate_limit`` (joins/sec)
@@ -2112,7 +2223,8 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         pace_steering=pace_steering, join_rate_limit=join_rate_limit,
         round_deadline_s=round_deadline_s,
         min_quorum_frac=min_quorum_frac,
-        max_deadline_extensions=max_deadline_extensions)
+        max_deadline_extensions=max_deadline_extensions,
+        checkpoint_sync=checkpoint_sync)
 
     def server_factory(size, server_com, aggregator, global_model,
                        on_round_done):
@@ -2145,6 +2257,7 @@ def run_fedavg_cross_silo(dataset: FederatedDataset, module,
         backend=backend, addresses=addresses, wire_codec=wire_codec,
         compression=policy, token=token, seed=seed,
         client_state_dir=checkpoint_dir, resume=resume,
+        state_sync=checkpoint_sync,
         join_timeout_s=join_timeout_s, round_record_hook=round_record_hook,
         timer=timer, prefetch_depth=prefetch_depth,
         heartbeat_s=heartbeat_s, fault_plan=fault_plan,
@@ -2164,6 +2277,7 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                       token=None, seed: int = 0,
                       client_state_dir: Optional[str] = None,
                       resume: bool = False,
+                      state_sync: bool = False,
                       join_timeout_s: float = 600.0,
                       raise_on_timeout: bool = False,
                       round_record_hook=None,
@@ -2332,7 +2446,8 @@ def launch_federation(dataset: FederatedDataset, module, task: str,
                 compression=policy,
                 state_dir=(os.path.join(client_state_dir, f"silo_{rank}")
                            if client_state_dir else None),
-                resume=resume, prefetch_depth=prefetch_depth,
+                resume=resume, state_sync=state_sync,
+                prefetch_depth=prefetch_depth,
                 heartbeat_s=heartbeat_s, obs=silo_obs,
                 device_gate=device_gate,
                 wan_agent=(wan.agent(rank) if wan is not None else None)))

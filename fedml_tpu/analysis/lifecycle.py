@@ -732,8 +732,11 @@ class LeakOnRaiseRule(Rule):
 
 #: a held lock whose last component contains one of these is exempt —
 #: device gates serialize dispatch on purpose; send/write locks exist
-#: to serialize exactly the socket writes FT022 would flag
-_EXEMPT_LOCK_TOKENS = ("device", "gate", "send", "write", "wlock", "io")
+#: to serialize exactly the socket writes FT022 would flag; writer/
+#: flusher locks belong to dedicated writer threads (the async
+#: checkpoint/flush pattern) whose entire job is to hold the I/O
+_EXEMPT_LOCK_TOKENS = ("device", "gate", "send", "write", "wlock", "io",
+                       "writer", "flusher")
 
 _SOCKET_BLOCKERS = frozenset({"sendall", "recv", "recv_into", "accept",
                               "create_connection"})
@@ -752,6 +755,12 @@ def _blocking_site(node: ast.Call) -> Optional[str]:
     last = callee.split(".")[-1]
     if last in _DEVICE_BLOCKERS:
         return f"device dispatch {last}()"
+    if callee in ("os.fsync", "fsync"):
+        # a disk barrier is a blocking device wait in disguise: ms on an
+        # idle SSD, unbounded on a contended one — round/receive threads
+        # must hand durability to a writer thread (exempt tokens above)
+        # or batch it (group commit), never hold a shared lock across it
+        return "durable os.fsync()"
     if not isinstance(node.func, ast.Attribute):
         return None
     recv = node.func.value
@@ -842,8 +851,8 @@ class _HoldScan(ast.NodeVisitor):
 class BlockingUnderLockRule(Rule):
     id = "FT022"
     title = ("blocking call (queue put/get, socket send/recv, join, "
-             "device dispatch) while holding a lock — every other "
-             "path needing that lock stalls behind it")
+             "device dispatch, fsync) while holding a lock — every "
+             "other path needing that lock stalls behind it")
     hint = ("move the blocking call outside the with block (snapshot "
             "under the lock, block outside), add a timeout, or pragma "
             "a deliberate serialization point: # ft: allow[FT022] why")

@@ -35,7 +35,8 @@ import numpy as np
 from fedml_tpu.core import pytree as pt
 from fedml_tpu.ops.quantize import dequantize_tree, quantize_tree
 from fedml_tpu.ops.sparsify import (k_for, topk_densify, topk_dequantize,
-                                    topk_quantize, topk_sparsify)
+                                    topk_quantize, topk_quantize_donated,
+                                    topk_sparsify, topk_sparsify_donated)
 
 COMPRESSED_FLAG = "__delta_int8__"
 TOPK_FLAG = "__topk_ef__"
@@ -165,13 +166,17 @@ def compress_topk(new_tree, base_tree, residual, key, *,
     k = k_for(d, frac)
     payload: Dict[str, Any] = {TOPK_FLAG: True, "d": d,
                                "fp": _tree_fingerprint(base_tree)}
+    # `flat` is a freshly built temporary at this point (concat of leaf
+    # casts, plus the EF add) — donate it so the residual output aliases
+    # its memory on tpu/gpu. Bit-exact with the undonated kernels and the
+    # numpy oracle (topk_sparsify_reference); the parity tests pin that.
     if quantize:
-        idx, q, scales, res = topk_quantize(flat, key, k,
-                                            interpret=interpret)
+        idx, q, scales, res = topk_quantize_donated(flat, key, k,
+                                                    interpret=interpret)
         payload.update(i=np.asarray(idx), q=np.asarray(q),
                        s=np.asarray(scales))
     else:
-        idx, vals, res = topk_sparsify(flat, k)
+        idx, vals, res = topk_sparsify_donated(flat, k)
         payload.update(i=np.asarray(idx), v=np.asarray(vals))
     return payload, np.asarray(res)
 

@@ -23,7 +23,10 @@ survivable and its schedule adaptive:
 """
 
 from fedml_tpu.control.admission import JoinAdmissionController
-from fedml_tpu.control.checkpoint import ServerControlCheckpointer
+from fedml_tpu.control.checkpoint import (GROUP_COMMIT_LINES,
+                                          GROUP_COMMIT_MS,
+                                          AsyncCheckpointWriter,
+                                          ServerControlCheckpointer)
 from fedml_tpu.control.pace import QUORUM_CEIL, PaceSteerer
 
 
@@ -38,19 +41,34 @@ class SchedulingStallError(RuntimeError):
 
 def build_control_plane(server_checkpoint_dir=None, pace_steering=False,
                         join_rate_limit=0.0, round_deadline_s=None,
-                        min_quorum_frac=0.5, max_deadline_extensions=25):
+                        min_quorum_frac=0.5, max_deadline_extensions=25,
+                        checkpoint_sync=False):
     """Resolve the control-plane flags into the kwargs the round-based
     server managers take (``server_ckpt`` / ``pace`` / ``join_admission``
     / ``max_deadline_extensions``). All-defaults resolves to the inert
-    configuration — byte-identical to the pre-control-plane servers."""
+    configuration — byte-identical to the pre-control-plane servers.
+
+    Checkpointing is asynchronous by default (a dedicated writer thread
+    with a depth-1 coalescing slot and group-committed ledger fsyncs —
+    the round thread only pays the capture copy); ``checkpoint_sync``
+    forces the legacy inline snapshot-at-every-boundary semantics with
+    an fsync per ledger line."""
     if pace_steering and not round_deadline_s:
         raise ValueError(
             "--pace_steering needs --round_deadline_s as the base "
             "deadline steering starts from (and falls back to until "
             "enough report latencies are observed)")
+
+    def _make_ckpt():
+        if checkpoint_sync:
+            return ServerControlCheckpointer(server_checkpoint_dir)
+        return AsyncCheckpointWriter(ServerControlCheckpointer(
+            server_checkpoint_dir,
+            group_commit_lines=GROUP_COMMIT_LINES,
+            group_commit_ms=GROUP_COMMIT_MS))
+
     return {
-        "server_ckpt": (ServerControlCheckpointer(server_checkpoint_dir)
-                        if server_checkpoint_dir else None),
+        "server_ckpt": _make_ckpt() if server_checkpoint_dir else None,
         # the floor is the caller's static quorum, capped at the steering
         # ceiling (a 1.0 floor would pin steering at the full barrier —
         # the deadlock the deadline exists to break)
@@ -65,6 +83,6 @@ def build_control_plane(server_checkpoint_dir=None, pace_steering=False,
     }
 
 
-__all__ = ["JoinAdmissionController", "PaceSteerer",
-           "ServerControlCheckpointer", "SchedulingStallError",
-           "build_control_plane"]
+__all__ = ["AsyncCheckpointWriter", "JoinAdmissionController",
+           "PaceSteerer", "ServerControlCheckpointer",
+           "SchedulingStallError", "build_control_plane"]

@@ -113,7 +113,8 @@ def _build_server(com, workers: int, rounds: int, ckpt_dir: str, *,
                   deadline_s: Optional[float], min_quorum_frac: float,
                   pace: bool, join_rate_limit: float,
                   max_deadline_extensions: int, server_cls=None,
-                  obs_dir: Optional[str] = None):
+                  obs_dir: Optional[str] = None,
+                  checkpoint_sync: bool = False):
     from fedml_tpu.algorithms.fedavg_cross_silo import (FedAvgAggregator,
                                                         FedAvgServerManager)
     from fedml_tpu.control import build_control_plane
@@ -128,7 +129,8 @@ def _build_server(com, workers: int, rounds: int, ckpt_dir: str, *,
         server_checkpoint_dir=ckpt_dir, pace_steering=pace,
         join_rate_limit=join_rate_limit, round_deadline_s=deadline_s,
         min_quorum_frac=min_quorum_frac,
-        max_deadline_extensions=max_deadline_extensions)
+        max_deadline_extensions=max_deadline_extensions,
+        checkpoint_sync=checkpoint_sync)
     cls = server_cls or FedAvgServerManager
     server = cls(0, workers + 1, com, FedAvgAggregator(workers), rounds,
                  ds.client_num, global_model,
@@ -153,7 +155,8 @@ def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
           pace: bool = False, join_rate_limit: float = 0.0,
           max_deadline_extensions: int = 25,
           join_timeout_s: float = 600.0,
-          obs_dir: Optional[str] = None) -> int:
+          obs_dir: Optional[str] = None,
+          checkpoint_sync: bool = False) -> int:
     """Subprocess entry: run ONE server incarnation over TCP until the
     schedule completes (or this process is killed mid-flight — the point
     of the exercise). Writes ``server_summary.json`` next to the
@@ -168,7 +171,8 @@ def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
                            join_rate_limit=join_rate_limit,
                            max_deadline_extensions=max_deadline_extensions,
                            join_timeout_s=join_timeout_s,
-                           obs_dir=obs_dir)
+                           obs_dir=obs_dir,
+                           checkpoint_sync=checkpoint_sync)
     finally:
         # the listener must not survive a raise: the supervisor
         # relaunches this incarnation on the SAME port, and a leaked
@@ -179,13 +183,32 @@ def serve(rounds: int, workers: int, port_base: int, ckpt_dir: str, *,
 def _serve_with(com, workers: int, rounds: int, ckpt_dir: str, *,
                 deadline_s: float, min_quorum_frac: float, pace: bool,
                 join_rate_limit: float, max_deadline_extensions: int,
-                join_timeout_s: float, obs_dir: Optional[str]) -> int:
+                join_timeout_s: float, obs_dir: Optional[str],
+                checkpoint_sync: bool = False) -> int:
     server = _build_server(com, workers, rounds, ckpt_dir,
                            deadline_s=deadline_s,
                            min_quorum_frac=min_quorum_frac, pace=pace,
                            join_rate_limit=join_rate_limit,
                            max_deadline_extensions=max_deadline_extensions,
-                           obs_dir=obs_dir)
+                           obs_dir=obs_dir,
+                           checkpoint_sync=checkpoint_sync)
+    # graceful-stop barrier: SIGTERM (supervisor drain, NOT the SIGKILL
+    # legs) flushes the async writer's pending snapshot + the ledger's
+    # group-commit tail before the default handler takes the process
+    def _sigterm(signum, frame):
+        ckpt = server._server_ckpt
+        if ckpt is not None:
+            flush = getattr(ckpt, "flush", None)
+            if flush is not None:
+                flush(timeout=30)
+            sync = getattr(ckpt, "inner", ckpt)
+            sync.sync_ledger()
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use): no signal barrier
     thread = threading.Thread(target=server.run, daemon=True)
     thread.start()
     server.send_init_msg()
@@ -229,6 +252,14 @@ def make_crashing_server_cls(crash_at_round: int):
                 type(self).crashed = True
                 self._cancel_deadline()
                 self.com_manager.stop_receive_message()
+                # a real SIGKILL takes the async checkpoint writer
+                # thread with it; the in-process simulation must do the
+                # same (drop the pending slot, no flush) or the dead
+                # server's writer would keep publishing snapshots and
+                # race the phase-2 restore in this very process
+                abort = getattr(self._server_ckpt, "abort", None)
+                if abort is not None:
+                    abort()
                 return
             super()._broadcast_model(msg_type, idxs)
 
@@ -244,7 +275,8 @@ def run_simulated_failover(ckpt_dir: str, *, rounds: int = 6,
                            min_quorum_frac: float = 0.5,
                            pace: bool = False,
                            join_timeout_s: float = 180.0,
-                           obs_dir: Optional[str] = None):
+                           obs_dir: Optional[str] = None,
+                           checkpoint_sync: bool = False):
     """Kill-and-restart without subprocesses. Returns
     ``(final_model_numpy, ledger, server2)`` — server2 carries the
     restored counters and the bound RoundTimer."""
@@ -261,7 +293,8 @@ def run_simulated_failover(ckpt_dir: str, *, rounds: int = 6,
                                           addresses=addresses)
     common = dict(deadline_s=deadline_s, min_quorum_frac=min_quorum_frac,
                   pace=pace, join_rate_limit=0.0,
-                  max_deadline_extensions=25, obs_dir=obs_dir)
+                  max_deadline_extensions=25, obs_dir=obs_dir,
+                  checkpoint_sync=checkpoint_sync)
 
     # phase 1: runs to crash_at_round, then goes dark mid-schedule
     # (crash_at_round >= rounds never crashes: the unkilled reference leg)
@@ -318,7 +351,8 @@ def run_simulated_failover(ckpt_dir: str, *, rounds: int = 6,
 def _spawn_server(port_base: int, rounds: int, workers: int, ckpt_dir: str,
                   deadline_s: float, pace: bool, join_rate_limit: float,
                   log_path: str,
-                  obs_dir: Optional[str] = None) -> subprocess.Popen:
+                  obs_dir: Optional[str] = None,
+                  checkpoint_sync: bool = False) -> subprocess.Popen:
     cmd = [sys.executable, "-m", "fedml_tpu.control.failover_harness",
            "--role", "server", "--rounds", str(rounds),
            "--workers", str(workers), "--port_base", str(port_base),
@@ -326,6 +360,8 @@ def _spawn_server(port_base: int, rounds: int, workers: int, ckpt_dir: str,
            "--join_rate_limit", str(join_rate_limit)]
     if pace:
         cmd.append("--pace")
+    if checkpoint_sync:
+        cmd.append("--checkpoint_sync")
     if obs_dir:
         cmd.extend(["--obs_dir", obs_dir])
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -363,7 +399,8 @@ def run_failover_scenario(ckpt_dir: str, *, rounds: int = DEFAULT_ROUNDS,
                           join_rate_limit: float = 0.0,
                           silo_fault_plan=None,
                           timeout_s: float = 300.0,
-                          obs_dir: Optional[str] = None) -> Dict:
+                          obs_dir: Optional[str] = None,
+                          checkpoint_sync: bool = False) -> Dict:
     """SIGKILL the server subprocess mid-schedule, restart it, and wait
     for the full schedule. ``silo_fault_plan`` (e.g. a 30% flap) wraps
     the SILO endpoints only — the chaos rides the fleet while the kill
@@ -376,7 +413,8 @@ def run_failover_scenario(ckpt_dir: str, *, rounds: int = DEFAULT_ROUNDS,
         "TCP", workers, addresses=make_addresses(port_base, workers + 1),
         fault_plan=silo_fault_plan)
     proc = _spawn_server(port_base, rounds, workers, ckpt_dir, deadline_s,
-                         pace, join_rate_limit, log_path, obs_dir=obs_dir)
+                         pace, join_rate_limit, log_path, obs_dir=obs_dir,
+                         checkpoint_sync=checkpoint_sync)
     killed_at = None
     try:
         _wait_for_round(ckpt_dir, kill_after_round, proc, timeout_s / 2)
@@ -385,7 +423,8 @@ def run_failover_scenario(ckpt_dir: str, *, rounds: int = DEFAULT_ROUNDS,
         killed_at = kill_after_round
         proc = _spawn_server(port_base, rounds, workers, ckpt_dir,
                              deadline_s, pace, join_rate_limit, log_path,
-                             obs_dir=obs_dir)
+                             obs_dir=obs_dir,
+                             checkpoint_sync=checkpoint_sync)
         rc = proc.wait(timeout=timeout_s)
     finally:
         if proc.poll() is None:
@@ -415,22 +454,28 @@ def ledger_schedule(ledger: List[Dict]) -> List[Tuple[int, Tuple[int, ...]]]:
 
 # ---------------------------------------------------------------------------
 def _smoke(tmp_root: Optional[str],
-           obs_dir: Optional[str] = None) -> int:
+           obs_dir: Optional[str] = None,
+           checkpoint_sync: bool = False) -> int:
     import tempfile
     root = tmp_root or tempfile.mkdtemp(prefix="fedml_failover_smoke_")
     ref_dir = os.path.join(root, "reference")
     kill_dir = os.path.join(root, "killed")
     t0 = time.time()
-    # unkilled reference over the same TCP topology
+    # unkilled reference over the same TCP topology. Default mode is the
+    # ASYNC checkpoint writer, so every smoke exercises replay-from-an-
+    # older-boundary recovery; --checkpoint_sync pins the legacy
+    # snapshot-at-every-boundary leg.
     ref_model, ref_ledger, _ = run_simulated_failover(
         ref_dir, rounds=6, crash_at_round=10**9, backend="TCP",
-        port_base=40210, deadline_s=5.0)
+        port_base=40210, deadline_s=5.0,
+        checkpoint_sync=checkpoint_sync)
     # the kill leg records a flight log when asked (--obs_dir): both
     # SIGKILL server lives append under distinct epochs — the CI lane
     # then runs `obs merge --ledger` against exactly this log
     res = run_failover_scenario(kill_dir, rounds=6, kill_after_round=2,
                                 port_base=40230, deadline_s=2.0,
-                                obs_dir=obs_dir)
+                                obs_dir=obs_dir,
+                                checkpoint_sync=checkpoint_sync)
     ok = (res["summary"].get("done") is True
           and res["summary"].get("cp_counters", {}).get("restores", 0) >= 1
           and ledger_schedule(res["ledger"]) == ledger_schedule(ref_ledger))
@@ -462,6 +507,10 @@ def main(argv=None) -> int:
     p.add_argument("--min_quorum_frac", type=float, default=0.5)
     p.add_argument("--pace", action="store_true")
     p.add_argument("--join_rate_limit", type=float, default=0.0)
+    p.add_argument("--checkpoint_sync", action="store_true",
+                   help="force the legacy synchronous snapshot-at-every-"
+                        "boundary checkpointing (default: async writer "
+                        "thread with newest-wins coalescing)")
     p.add_argument("--obs_dir", type=str, default=None,
                    help="flight-recorder directory (fedml_tpu/obs) for "
                         "the server incarnation(s)")
@@ -475,8 +524,10 @@ def main(argv=None) -> int:
                      args.ckpt_dir, deadline_s=args.deadline_s,
                      min_quorum_frac=args.min_quorum_frac, pace=args.pace,
                      join_rate_limit=args.join_rate_limit,
-                     obs_dir=args.obs_dir)
-    return _smoke(args.ckpt_dir, obs_dir=args.obs_dir)
+                     obs_dir=args.obs_dir,
+                     checkpoint_sync=args.checkpoint_sync)
+    return _smoke(args.ckpt_dir, obs_dir=args.obs_dir,
+                  checkpoint_sync=args.checkpoint_sync)
 
 
 if __name__ == "__main__":

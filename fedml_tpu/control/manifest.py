@@ -72,6 +72,13 @@ SERVER_EPHEMERAL_FIELDS = frozenset({
                             # not resumable past the error
     "_control_restored",    # one-shot restore latch inside send_init_msg:
                             # a fresh process restores at most once
+    "_model_version",       # serialization token for the incremental
+                            # snapshot writer: a restarted server starts a
+                            # fresh serializer cache, so the counter may
+                            # restart from zero
+    "_gm_capture_cache",    # (version, state-dict) capture memo keyed by
+                            # _model_version: derived from global_model,
+                            # rebuilt on first post-restore capture
 })
 
 #: server classes exempt from FT009: no round schedule exists to resume.

@@ -175,7 +175,21 @@ def add_federated_args(parser: argparse.ArgumentParser):
                              "is written atomically at round boundaries "
                              "and deadline closes, so a killed-and-"
                              "restarted server resumes mid-schedule. "
-                             "Unset = no snapshots (legacy)")
+                             "Snapshots write ASYNCHRONOUSLY by default "
+                             "(dedicated writer thread, newest-wins "
+                             "coalescing, group-committed ledger fsyncs); "
+                             "see --checkpoint_sync. Unset = no snapshots "
+                             "(legacy)")
+    parser.add_argument("--checkpoint_sync", action="store_true",
+                        help="force SYNCHRONOUS control-plane snapshots: "
+                             "serialize+fsync+publish inline on the round "
+                             "thread at every boundary, one ledger fsync "
+                             "per line (the pre-async semantics — "
+                             "recovery point is always the latest "
+                             "boundary, at round-critical-path cost). "
+                             "Default off = async writer thread; restore "
+                             "may land a few rounds back and replay "
+                             "forward to the identical ledger")
     parser.add_argument("--pace_steering", action="store_true",
                         help="adaptive pace steering (Bonawitz et al.): "
                              "derive each round's deadline (p90 of "

@@ -243,6 +243,7 @@ def run_algo(args):
             # JOIN admission (README "Elastic control plane")
             server_checkpoint_dir=getattr(args, "server_checkpoint_dir",
                                           None),
+            checkpoint_sync=getattr(args, "checkpoint_sync", False),
             pace_steering=getattr(args, "pace_steering", False),
             join_rate_limit=getattr(args, "join_rate_limit", 0.0),
             max_deadline_extensions=resolve_max_extensions(args),
@@ -538,6 +539,7 @@ def run_algo(args):
             # control plane (quorum mode only; fedasync warns + ignores)
             server_checkpoint_dir=getattr(args, "server_checkpoint_dir",
                                           None),
+            checkpoint_sync=getattr(args, "checkpoint_sync", False),
             pace_steering=getattr(args, "pace_steering", False),
             join_rate_limit=getattr(args, "join_rate_limit", 0.0),
             max_deadline_extensions=resolve_max_extensions(args))

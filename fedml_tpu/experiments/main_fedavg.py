@@ -144,6 +144,7 @@ def run_cross_silo(args, ds, model, task, sink):
         fault_plan=getattr(args, "fault_plan", None),
         # elastic control plane (fedml_tpu/control/)
         server_checkpoint_dir=getattr(args, "server_checkpoint_dir", None),
+        checkpoint_sync=getattr(args, "checkpoint_sync", False),
         pace_steering=getattr(args, "pace_steering", False),
         join_rate_limit=getattr(args, "join_rate_limit", 0.0),
         max_deadline_extensions=resolve_max_extensions(args),

@@ -15,8 +15,11 @@ the state store):
 
 - **atomic line writes** — a record is one ``write()`` of a complete
   line, flushed; ``round``/``anomaly`` records (the crash oracle's
-  input) are additionally fsynced, while high-rate silo digest rows
-  ride the page cache so the receive thread never pays a disk sync per
+  input) are additionally fsynced with a GROUP COMMIT (every
+  ``fsync_lines`` sync-worthy records or ``fsync_ms`` milliseconds,
+  whichever first, plus flush-on-close — the same batching the
+  control-plane ledger uses), while high-rate silo digest rows ride the
+  page cache so the receive thread never pays a disk sync per
   heartbeat. A kill mid-write leaves at most one torn FINAL line,
   which the reader skips exactly like the ledger reader;
 - **keep_last_n rotation** — when the live file reaches
@@ -50,6 +53,8 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
+from fedml_tpu.utils.fsio import fsync_dir
+
 #: bumped when the record layout changes incompatibly
 FLIGHT_FORMAT = 1
 
@@ -61,7 +66,8 @@ class FlightRecorder:
 
     def __init__(self, directory: str, *, job_id: str = "job",
                  rank: int = 0, epoch: Optional[int] = None,
-                 rotate_lines: int = 20000, keep_last_n: int = 4):
+                 rotate_lines: int = 20000, keep_last_n: int = 4,
+                 fsync_lines: int = 8, fsync_ms: float = 50.0):
         import threading
         self.directory = str(directory)
         self.job_id = str(job_id)
@@ -69,9 +75,17 @@ class FlightRecorder:
         self.epoch = int(epoch) if epoch is not None else None
         self.rotate_lines = max(1, int(rotate_lines))
         self.keep_last_n = max(1, int(keep_last_n))
+        #: group-commit cadence for the sync-worthy (round/anomaly)
+        #: records: 1/0 = the legacy fsync-per-record
+        self.fsync_lines = max(1, int(fsync_lines))
+        self.fsync_ms = float(fsync_ms)
         self._lock = threading.Lock()
         self._seq = 0
         self._lines = 0
+        self._sync_pending = 0
+        self._last_fsync = time.monotonic()
+        self.fsync_batches = 0
+        self._fsync_batches_popped = 0
         self._disabled = False
         #: persistent append handle — re-opening per record costs more
         #: than the record on the server's receive thread
@@ -121,16 +135,28 @@ class FlightRecorder:
                 # one write() of a complete line + flush: a kill
                 # mid-write tears at most THIS line, never an earlier
                 # one. fsync is reserved for the records the crash
-                # oracle reads (round closes, anomalies) — the
-                # high-rate silo digest rows ride the page cache, so
-                # the server's receive thread never pays a disk sync
-                # per heartbeat.
+                # oracle reads (round closes, anomalies) and GROUP
+                # COMMITTED — every fsync_lines sync-worthy records or
+                # fsync_ms ms, whichever first — so neither the round
+                # thread nor the receive thread pays a disk sync per
+                # record; the high-rate silo digest rows never fsync at
+                # all.
                 if self._fh is None:
                     self._fh = open(self.path, "a")
                 self._fh.write(line + "\n")
                 self._fh.flush()
                 if record.get("kind") in ("round", "anomaly"):
-                    os.fsync(self._fh.fileno())
+                    self._sync_pending += 1
+                    now = time.monotonic()
+                    due = (self._sync_pending >= self.fsync_lines
+                           or (self.fsync_ms > 0.0
+                               and (now - self._last_fsync) * 1e3  # ft: allow[FT015] group-commit deadline is a real-time durability contract — it schedules WHEN the fsync lands, never what any record says, so parity is untouched
+                               >= self.fsync_ms))
+                    if due:
+                        os.fsync(self._fh.fileno())  # ft: allow[FT022] group-committed flight durability: bounded disk sync on the recorder's own lock, amortized 1/N records
+                        self.fsync_batches += 1
+                        self._sync_pending = 0
+                        self._last_fsync = now
                 self._lines += 1
                 if self._lines >= self.rotate_lines:
                     self._rotate_locked()
@@ -138,10 +164,40 @@ class FlightRecorder:
                 logging.warning("flight append to %s failed — record "
                                 "dropped", self.path, exc_info=True)
 
+    def sync(self) -> None:
+        """Force-fsync any pending sync-worthy records (the barrier the
+        merge/scan tools may take before reading a live log; close()
+        calls it implicitly). Never raises."""
+        with self._lock:
+            self._sync_locked()  # ft: allow[FT022] explicit flush barrier — the caller asked for durability; never on the round/receive hot path
+
+    def _sync_locked(self) -> None:
+        if self._fh is None or not self._sync_pending:
+            return
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.fsync_batches += 1
+            self._sync_pending = 0
+            self._last_fsync = time.monotonic()
+        except OSError:
+            logging.warning("flight sync of %s failed", self.path,
+                            exc_info=True)
+
+    def pop_fsync_batches(self) -> int:
+        """Group-commit fsyncs since the last pop (the server credits
+        this into the ``obs_fsync_batches`` counter at round close)."""
+        with self._lock:
+            delta = self.fsync_batches - self._fsync_batches_popped
+            self._fsync_batches_popped = self.fsync_batches
+            return delta
+
     def close(self) -> None:
-        """Release the append handle (tests and short-lived tools; the
+        """Flush-on-close (sync any pending group-commit tail) and
+        release the append handle (tests and short-lived tools; the
         long-running recorders just hold it for the process lifetime)."""
         with self._lock:
+            self._sync_locked()  # ft: allow[FT022] flush-on-close barrier — teardown, not a hot path
             if self._fh is not None:
                 try:
                     self._fh.close()
@@ -154,8 +210,11 @@ class FlightRecorder:
         (``os.replace`` — atomic) and sweep segments beyond
         ``keep_last_n`` in sorted order."""
         if self._fh is not None:
-            # the handle points at the file being sealed; the next
-            # append reopens a fresh live file
+            # the handle points at the file being sealed; sync the
+            # group-commit tail INTO the segment first — a sealed
+            # segment is immutable, its durability gap must not ride
+            # until the next live-file fsync
+            self._sync_locked()
             self._fh.close()
             self._fh = None
         stem = f"flight_rank{self.rank}"
@@ -167,6 +226,13 @@ class FlightRecorder:
         sealed = os.path.join(self.directory,
                               f"{stem}.{nxt:06d}.jsonl")
         os.replace(self.path, sealed)
+        # the rename lives in the directory entry: without a dirfd fsync
+        # a crash right after rotation can lose the sealed segment's
+        # name (degrade-to-warning inside fsync_dir on filesystems that
+        # refuse directory fsync)
+        # rotation is rare (every rotate_lines records) and the recorder
+        # lock is its own — never a round/receive-thread lock
+        fsync_dir(self.directory)
         self._lines = 0
         keep = set(sorted(seqs + [nxt])[-self.keep_last_n:])
         for s in sorted(seqs):

@@ -78,6 +78,10 @@ METRICS: Dict[str, Dict[str, str]] = {
                            "peak per-peer send-queue depth observed at "
                            "broadcast enqueue (bounded queue; overflow "
                            "sheds the peer through the eviction path)"),
+    "codec_encode_ms": _m(KIND_GAUGE, "comm",
+                          "slowest downlink compression encode (top-k/"
+                          "EF select + quantize + mirror advance) on "
+                          "the round thread before a broadcast"),
     "agg_fold_ms": _m(KIND_GAUGE, "round pipeline",
                       "slowest streaming-fold step (decode + in-order "
                       "prefix fold of one reply, or the round-close "
@@ -137,6 +141,30 @@ METRICS: Dict[str, Dict[str, str]] = {
                                   "from the pace-steering window (they "
                                   "measure the outage, not the silo's "
                                   "pace — the churn-poisoning guard)"),
+    "cp_capture_ms": _m(KIND_GAUGE, "control plane",
+                        "slowest control-state capture (the host-copy "
+                        "cost the round thread pays per snapshot — with "
+                        "the async writer this IS the round thread's "
+                        "whole checkpoint bill)"),
+    "cp_flush_ms": _m(KIND_GAUGE, "control plane",
+                      "slowest snapshot serialize+fsync+publish (inline "
+                      "in --checkpoint_sync mode; the writer thread's "
+                      "last completed flush in async mode)"),
+    "cp_writer_queue_coalesced": _m(KIND_COUNTER, "control plane",
+                                    "snapshots replaced in the async "
+                                    "writer's depth-1 newest-wins slot "
+                                    "before publishing (backpressure: "
+                                    "the writer fell behind the round "
+                                    "cadence)"),
+    "cp_fsync_total": _m(KIND_COUNTER, "control plane",
+                         "every fsync the control-plane checkpointer "
+                         "issued over the run (blobs, sidecars, "
+                         "directory entries, ledger), folded into the "
+                         "timer after the close barrier"),
+    "cp_ledger_fsyncs": _m(KIND_COUNTER, "control plane",
+                           "ledger.jsonl group-commit fsyncs (subset "
+                           "of cp_fsync_total; one per N-line/T-ms "
+                           "batch plus the flush-on-close tail)"),
     # -- WAN world model (fedml_tpu/wan/) -----------------------------------
     "wan_cohort_rejections": _m(KIND_COUNTER, "wan",
                                 "cohort-draw candidates skipped because "
@@ -249,6 +277,13 @@ METRICS: Dict[str, Dict[str, str]] = {
                               "one-shot jax.profiler window (bumped at "
                               "the window's close, so the delta lands "
                               "in the following round's record)"),
+    "obs_fsync_batches": _m(KIND_COUNTER, "observability",
+                            "flight-recorder group-commit fsyncs (one "
+                            "per batch of sync-worthy round/anomaly "
+                            "records — N lines or T ms, whichever "
+                            "first); credited after end_round, so the "
+                            "delta lands in the following round's "
+                            "record"),
     # -- perf flight deck (obs/perf.py): per-round derived perf record ------
     "mfu": _m(KIND_DERIVED, "perf",
               "model FLOP utilization: achieved FLOP/s over the fleet "

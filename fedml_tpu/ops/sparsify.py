@@ -21,6 +21,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.ops.quantize import dequantize_int8, quantize_int8
 
@@ -45,6 +46,64 @@ def topk_sparsify(x: jax.Array, k: int):
     vals = x[idx]
     residual = x.at[idx].set(0.0)
     return idx.astype(jnp.int32), vals, residual
+
+
+def topk_sparsify_reference(x, k: int):
+    """Pure-numpy parity oracle for :func:`topk_sparsify`.
+
+    ``jax.lax.top_k`` selects by descending value and breaks ties by
+    LOWEST index first; a stable descending argsort over ``|x|``
+    reproduces exactly that order, so indices, values, and the EF
+    residual must all match the jitted path bit-for-bit — the contract
+    the codec parity tests pin. This path is the oracle only (host
+    numpy, no donation, no jit): the wire always rides the jitted
+    kernels."""
+    x = np.asarray(x, np.float32)
+    k = max(1, min(int(x.size), int(k)))
+    idx = np.argsort(-np.abs(x), kind="stable")[:k].astype(np.int32)
+    vals = x[idx]
+    residual = x.copy()
+    residual[idx] = 0.0
+    return idx, vals, residual
+
+
+def _donate_flat_input() -> bool:
+    """Donate the flat delta buffer only where XLA implements donation
+    (tpu/gpu aliasing); the CPU backend warns-and-copies, so tests under
+    JAX_PLATFORMS=cpu run the identical program without the donation."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+@functools.lru_cache(maxsize=None)
+def _donated_topk_sparsify(k: int, donate: bool):
+    def fn(x):
+        return topk_sparsify(x, k)
+    return jax.jit(fn, donate_argnums=((0,) if donate else ()))
+
+
+def topk_sparsify_donated(x: jax.Array, k: int):
+    """:func:`topk_sparsify` with the input buffer donated to the
+    computation (the residual reuses the delta's memory on tpu/gpu —
+    the flat delta is a freshly built temporary at every call site, so
+    the aliasing is free bandwidth). Same compiled program otherwise:
+    bit-exact with :func:`topk_sparsify` and the numpy reference."""
+    return _donated_topk_sparsify(int(k), _donate_flat_input())(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _donated_topk_quantize(k: int, interpret: bool, donate: bool):
+    def fn(x, key):
+        return topk_quantize(x, key, k, interpret=interpret)
+    return jax.jit(fn, donate_argnums=((0,) if donate else ()))
+
+
+def topk_quantize_donated(x: jax.Array, key: jax.Array, k: int, *,
+                          interpret: bool = False):
+    """:func:`topk_quantize` with the flat input donated (see
+    :func:`topk_sparsify_donated`) — the uplink encode's steady-state
+    entry point."""
+    return _donated_topk_quantize(int(k), bool(interpret),
+                                  _donate_flat_input())(x, key)
 
 
 @functools.partial(jax.jit, static_argnames=("d",))

@@ -15,11 +15,12 @@ from fedml_tpu.state.population import (VirtualFederatedDataset,
                                         write_federation_store)
 from fedml_tpu.state.residuals import SiloResidualStore
 from fedml_tpu.state.store import (DEFAULT_CACHE_CLIENTS,
-                                   DEFAULT_SHARD_CLIENTS, ClientStateStore)
+                                   DEFAULT_SHARD_CLIENTS, ClientStateStore,
+                                   StoreFlusher)
 
 __all__ = [
     "ClientStateStore", "DEFAULT_CACHE_CLIENTS", "DEFAULT_SHARD_CLIENTS",
-    "SiloResidualStore", "VirtualFederatedDataset",
+    "SiloResidualStore", "StoreFlusher", "VirtualFederatedDataset",
     "load_federation_store", "make_virtual_powerlaw_population",
     "pareto_sizes", "write_federation_store",
 ]

@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-from fedml_tpu.state.store import ClientStateStore
+from fedml_tpu.state.store import ClientStateStore, StoreFlusher
 
 #: rounds of residual history kept, matching the legacy manager's
 #: ``keep_last_n`` default (older rounds are GC'd at save)
@@ -39,7 +39,7 @@ _SHARD_ROUNDS = 4
 
 class SiloResidualStore:
     def __init__(self, state_dir: str, keep_last_n: int = KEEP_LAST_N,
-                 timer=None):
+                 timer=None, async_writeback: bool = False):
         self.state_dir = state_dir
         self.keep_last_n = int(keep_last_n)
         self._store = ClientStateStore(state_dir,
@@ -48,6 +48,15 @@ class SiloResidualStore:
                                        * (self.keep_last_n + 1),
                                        timer=timer)
         self._store.register_field("residual", persist=True)
+        #: async write-back (writer-thread flush off the save() caller's
+        #: critical path, depth-1 coalesced). Crash semantics unchanged:
+        #: shard writes stay individually atomic, and a lost in-flight
+        #: flush is convergence-safe — the EF resume path falls back to
+        #: zeros, it never reads a torn file. ``close()`` is the durable
+        #: barrier (FINISH-time parity with the old inline flush).
+        self._flusher = (StoreFlusher(self._store,
+                                      name="silo-state-flusher")
+                         if async_writeback else None)
 
     def save(self, round_idx: int, residual: np.ndarray) -> None:
         """Persist the residual entering ``round_idx`` (same
@@ -59,8 +68,32 @@ class SiloResidualStore:
         for old in self._store.known_ids("residual"):
             if old <= round_idx - self.keep_last_n:
                 self._store.delete("residual", old)
-        self._store.flush()
+        if self._flusher is not None:
+            self._flusher.request()
+        else:
+            self._store.flush()
         self._gc_legacy(round_idx)
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Durability barrier: every ``save`` so far is on disk after
+        this returns (async mode waits out the writer thread; sync mode
+        is already durable)."""
+        if self._flusher is not None:
+            self._flusher.barrier(timeout=timeout)
+        self._store.flush()
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Flush-and-stop (silo FINISH). Safe to call twice; after close
+        further ``save`` calls degrade to inline synchronous flushes."""
+        if self._flusher is not None:
+            self._flusher.close(timeout=timeout)
+        else:
+            self._store.flush()
+
+    def writeback_stats(self) -> Optional[dict]:
+        """Writer-thread counters (None in synchronous mode) — the
+        bench's write-back evidence row."""
+        return None if self._flusher is None else self._flusher.stats()
 
     def load(self, round_idx: int, dim: int) -> Optional[np.ndarray]:
         """The residual checkpointed for ``round_idx``, or None when no

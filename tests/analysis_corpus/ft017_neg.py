@@ -19,3 +19,10 @@ def roll_up(timer, hit, name, seen):
     # serving-tier names (fedml_tpu/serve) are registered
     timer.count("serve_shed")
     timer.gauge("serve_p99_ms", 12.5)
+    # round-close I/O telemetry (async checkpoint writer + group-commit
+    # flight durability + jitted codec) is registered
+    timer.gauge("cp_capture_ms", 0.8)
+    timer.gauge("cp_flush_ms", 6.5)
+    timer.count("cp_writer_queue_coalesced", 2)
+    timer.count("obs_fsync_batches", 3)
+    timer.gauge("codec_encode_ms", 1.2)

@@ -13,3 +13,8 @@ def roll_up(timer):
     timer.gauge("mfu_frac", 0.5)               # registry: "mfu"
     # serving-tier near-miss: the registry knows "serve_shed"
     timer.count("serve_sheds")
+    # round-close I/O telemetry near-misses: the registry knows
+    # cp_capture_ms / cp_flush_ms / obs_fsync_batches / codec_encode_ms
+    timer.gauge("cp_captured_ms", 1.0)
+    timer.count("obs_fsyncs")
+    timer.gauge("codec_encode_s", 0.002)

@@ -25,3 +25,23 @@ class Coalescer:
     def flush(self):
         with self._lock:
             return self._drain_one_locked()
+
+
+class Ledger:
+    """fsync on the receive/round thread while holding the shared state
+    lock: every heartbeat/counter path stalls behind the disk barrier —
+    durability belongs on a writer thread or behind group commit."""
+
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._fh = open(path, "a")
+
+    def append(self, line):
+        import os
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def close(self):
+        self._fh.close()

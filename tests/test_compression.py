@@ -486,3 +486,104 @@ class TestDownlinkCompression:
         server._worker_base = {0: (0, fp), 1: (0, fp)}
         server.global_model = new
         assert not is_compressed(server._encode_broadcast())
+
+
+class TestTopkParityOracle:
+    """The jitted (and donated-buffer) codec against the pure-numpy
+    reference: indices, values, and the EF residual pinned BIT-exact
+    across dtypes and tie cases. ``lax.top_k`` breaks magnitude ties by
+    lowest index first; the reference's stable descending argsort is the
+    independent statement of that contract."""
+
+    def _vector(self, case, d, seed=7):
+        rng = np.random.RandomState(seed)
+        if case == "normal":
+            return rng.randn(d).astype(np.float32)
+        if case == "ties":
+            return np.tile(np.array([2.0, -2.0, 1.0, -1.0], np.float32),
+                           d // 4 + 1)[:d]
+        if case == "signed_ties":
+            return np.where(np.arange(d) % 2 == 0, 3.0,
+                            -3.0).astype(np.float32)
+        if case == "f16":
+            # half-precision-born values (heavily tied mantissas)
+            return rng.randn(d).astype(np.float16).astype(np.float32)
+        return np.zeros(d, np.float32)
+
+    @pytest.mark.parametrize("case", ["normal", "ties", "signed_ties",
+                                      "f16", "zeros"])
+    @pytest.mark.parametrize("d,k", [(64, 8), (257, 9), (16, 16), (5, 1)])
+    def test_sparsify_matches_reference_bit_exact(self, case, d, k):
+        from fedml_tpu.ops.sparsify import (topk_sparsify,
+                                            topk_sparsify_donated,
+                                            topk_sparsify_reference)
+        x = self._vector(case, d)
+        ridx, rvals, rres = topk_sparsify_reference(x, k)
+        for fn in (topk_sparsify, topk_sparsify_donated):
+            idx, vals, res = fn(jnp.asarray(x), k)
+            np.testing.assert_array_equal(np.asarray(idx), ridx)
+            np.testing.assert_array_equal(np.asarray(vals), rvals)
+            np.testing.assert_array_equal(np.asarray(res), rres)
+
+    def test_quantize_donated_matches_undonated_bit_exact(self):
+        from fedml_tpu.ops.sparsify import (topk_quantize,
+                                            topk_quantize_donated)
+        rng = np.random.RandomState(3)
+        x = rng.randn(512).astype(np.float32)
+        key = jax.random.key(11)
+        plain = topk_quantize(jnp.asarray(x), key, 32, interpret=True)
+        donated = topk_quantize_donated(jnp.asarray(x), key, 32,
+                                        interpret=True)
+        for u, v in zip(plain, donated):
+            np.testing.assert_array_equal(np.asarray(u), np.asarray(v))
+
+    def test_quantize_survivors_ride_reference_selection(self):
+        """Composition oracle for the quantize path: the WIRE content is
+        bit-exact reproducible from the reference — selection equals the
+        reference's, and quantizing the reference's survivor values
+        (same key) yields the identical q/scales bytes. The residual's
+        survivor-error term is allclose-only: XLA fuses ``vals - q*s``
+        (FMA), so it can differ from the unfused host compute by an ulp
+        — never by content that reaches the wire."""
+        from fedml_tpu.ops.quantize import dequantize_int8, quantize_int8
+        from fedml_tpu.ops.sparsify import (topk_quantize,
+                                            topk_sparsify_reference)
+        rng = np.random.RandomState(5)
+        x = rng.randn(256).astype(np.float32)
+        idx, q, scales, res = topk_quantize(jnp.asarray(x),
+                                            jax.random.key(2), 16,
+                                            interpret=True)
+        ridx, rvals, rres = topk_sparsify_reference(x, 16)
+        np.testing.assert_array_equal(np.asarray(idx), ridx)
+        q2, s2 = quantize_int8(jnp.asarray(rvals), jax.random.key(2),
+                               interpret=True)
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(scales), np.asarray(s2))
+        deq = np.asarray(dequantize_int8(q, scales, 16, interpret=True))
+        expect = rres.copy()
+        expect[ridx] += rvals - deq
+        np.testing.assert_allclose(np.asarray(res), expect, rtol=0,
+                                   atol=1e-5)
+
+    def test_compress_topk_payload_matches_reference(self):
+        """End-to-end through the wire encoder: the payload's indices
+        and values equal the reference run on the same flat delta (+EF
+        residual), so the donated path changed WHERE the math runs,
+        never what ships."""
+        from fedml_tpu.ops.sparsify import k_for, topk_sparsify_reference
+        base, new = _trees()
+        rng = np.random.RandomState(9)
+        flat_ref = np.concatenate(
+            [np.asarray(l, np.float32).ravel()
+             for l in jax.tree.leaves(new)]) - np.concatenate(
+            [np.asarray(l, np.float32).ravel()
+             for l in jax.tree.leaves(base)])
+        residual = rng.randn(flat_ref.size).astype(np.float32)
+        payload, res = compress_topk(new, base, residual, jax.random.key(4),
+                                     frac=0.05, quantize=False,
+                                     interpret=True)
+        ridx, rvals, rres = topk_sparsify_reference(
+            flat_ref + residual, k_for(flat_ref.size, 0.05))
+        np.testing.assert_array_equal(payload["i"], ridx)
+        np.testing.assert_array_equal(payload["v"], rvals)
+        np.testing.assert_array_equal(res, rres)

@@ -252,6 +252,211 @@ class TestServerCheckpointer:
 
 
 # ---------------------------------------------------------------------------
+class TestIncrementalSerializer:
+    """The byte-splice serializer against the monolithic flax output —
+    cached-field reuse must be byte-INVISIBLE (the torn-write and
+    restore oracles read blobs, not field lists)."""
+
+    def _state(self, r):
+        return {"round_idx": r, "format": 1,
+                "global_model": {"w": np.arange(8, dtype=np.float32) * r},
+                "zeta": None, "alpha": [1, {"b": 2}]}
+
+    def test_splice_is_byte_identical_and_caches(self):
+        from flax import serialization as fser
+
+        from fedml_tpu.control.checkpoint import IncrementalStateSerializer
+        ser = IncrementalStateSerializer()
+        s1 = self._state(1)
+        blob = ser.serialize(s1, versions={"global_model": 0})
+        assert blob == fser.msgpack_serialize(s1)
+        assert ser.cache_misses == 1 and ser.cache_hits == 0
+        # token unchanged -> cached bytes, still byte-identical
+        blob2 = ser.serialize(dict(s1, round_idx=2),
+                              versions={"global_model": 0})
+        assert blob2 == fser.msgpack_serialize(dict(s1, round_idx=2))
+        assert ser.cache_hits == 1
+        assert ser.field_sha("global_model") is not None
+        # token bumped -> fresh bytes for the new value
+        s3 = dict(s1, round_idx=3,
+                  global_model={"w": np.arange(8, dtype=np.float32) * 9})
+        blob3 = ser.serialize(s3, versions={"global_model": 1})
+        assert blob3 == fser.msgpack_serialize(s3)
+        assert ser.cache_misses == 2
+
+    def test_no_versions_means_monolithic(self):
+        from flax import serialization as fser
+
+        from fedml_tpu.control.checkpoint import IncrementalStateSerializer
+        ser = IncrementalStateSerializer()
+        s = self._state(4)
+        assert ser.serialize(s, versions=None) == fser.msgpack_serialize(s)
+        assert ser.cache_misses == 0
+
+    def test_mismatch_falls_back_permanently(self, caplog):
+        """A poisoned cache entry (stands in for a future msgpack/flax
+        encoding change) must trip the one-time parity oracle: the call
+        returns the CORRECT monolithic bytes and the splice is retired
+        for the process."""
+        from flax import serialization as fser
+
+        from fedml_tpu.control.checkpoint import IncrementalStateSerializer
+        ser = IncrementalStateSerializer()
+        ser._cache["global_model"] = (0, b"\xc0", "bogus")
+        s = self._state(5)
+        import logging as _logging
+        with caplog.at_level(_logging.WARNING):
+            blob = ser.serialize(s, versions={"global_model": 0})
+        assert blob == fser.msgpack_serialize(s)
+        assert ser._fallback and not ser._cache
+        assert ser.serialize(s, versions={"global_model": 0}) == blob
+
+    def test_map_headers_match_packb_across_sizes(self):
+        import msgpack
+
+        from fedml_tpu.control.checkpoint import _msgpack_map_header
+        for n in (0, 15, 16, 255, 0xFFFF, 0x10000):
+            # the hand-written header must equal what packb itself
+            # writes for an n-entry map (fixmap / map16 / map32)
+            probe = msgpack.packb({str(i): None for i in range(n)})
+            assert probe.startswith(_msgpack_map_header(n)), n
+
+
+class TestAsyncCheckpointWriter:
+    """The writer-thread layer's own contracts: coalescing under
+    backpressure, the flush barrier, abort-as-SIGKILL, ledger group
+    commit, and the ledger-before-snapshot durability ordering."""
+
+    def _state(self, r):
+        return {"round_idx": r, "tree": {"w": np.full(4, r, np.float32)}}
+
+    def _gated(self, tmp_path, **kw):
+        """An async writer whose inner save blocks until released —
+        deterministic backpressure."""
+        from fedml_tpu.control import AsyncCheckpointWriter
+        inner = ServerControlCheckpointer(str(tmp_path), **kw)
+        gate = threading.Event()
+        orig = inner.save
+
+        def gated_save(state, versions=None):
+            gate.wait(10)
+            return orig(state, versions=versions)
+
+        inner.save = gated_save
+        return AsyncCheckpointWriter(inner), inner, gate, orig
+
+    def test_flush_barrier_publishes_newest(self, tmp_path):
+        from fedml_tpu.control import AsyncCheckpointWriter
+        w = AsyncCheckpointWriter(ServerControlCheckpointer(str(tmp_path)))
+        for r in range(3):
+            w.save(self._state(r))
+        assert w.flush()
+        assert w.load_latest()["round_idx"] == 2
+        w.close()
+
+    def test_coalescing_under_backpressure(self, tmp_path):
+        w, inner, gate, _ = self._gated(tmp_path)
+        for r in range(5):
+            w.save(self._state(r))
+            time.sleep(0.02)  # let the writer pick up the FIRST save
+        gate.set()
+        assert w.flush()
+        stats = w.stats()
+        # first save in flight + newest-wins slot: intermediate
+        # snapshots were coalesced away, the final publish is round 4
+        assert stats["coalesced"] >= 1
+        assert stats["published"] + stats["coalesced"] == 5
+        assert w.load_latest()["round_idx"] == 4
+        assert w.pop_coalesced() == stats["coalesced"]
+        assert w.pop_coalesced() == 0
+        w.close()
+
+    def test_abort_mid_async_write_restores_older_boundary(self, tmp_path):
+        """Simulated SIGKILL mid-async-write: the ledger tail is newer
+        than the newest published snapshot and a stray .tmp sits in the
+        directory — restore lands on the older complete boundary and
+        the schedule replays forward (re-appended rows dedup by
+        round)."""
+        w, inner, gate, orig = self._gated(tmp_path)
+        gate.set()
+        w.append_ledger({"round": 0, "cohort": [1], "reported": [0]})
+        w.append_ledger({"round": 1, "cohort": [2], "reported": [0]})
+        w.save(self._state(1))
+        assert w.flush()
+        # round 2 closes: ledger appended, snapshot handed to the
+        # writer... and the process dies mid-write
+        gate.clear()
+        w.append_ledger({"round": 2, "cohort": [3], "reported": [0]})
+        w.save(self._state(2))
+        with open(os.path.join(str(tmp_path),
+                               "state_000000000099.msgpack.1.tmp"),
+                  "wb") as f:
+            f.write(b"torn mid-write")
+        w.abort()
+        gate.set()
+        # a fresh process opens the directory
+        ckp2 = ServerControlCheckpointer(str(tmp_path))
+        restored = ckp2.load_latest()
+        rows = ckp2.read_ledger()
+        assert restored["round_idx"] == 1  # older than the ledger tail
+        assert [r["round"] for r in rows] == [0, 1, 2]
+        # replay forward: round 2 re-closes, re-appends, snapshots
+        ckp2.append_ledger({"round": 2, "cohort": [3], "reported": [0]})
+        ckp2.save(self._state(2))
+        assert ckp2.load_latest()["round_idx"] == 2
+        rows = ckp2.read_ledger()
+        assert [r["round"] for r in rows] == [0, 1, 2]
+        assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
+        ckp2.close()
+
+    def test_post_close_save_degrades_inline(self, tmp_path):
+        from fedml_tpu.control import AsyncCheckpointWriter
+        w = AsyncCheckpointWriter(ServerControlCheckpointer(str(tmp_path)))
+        w.close()
+        w.save(self._state(7))  # no thread left — must still land
+        assert w.load_latest()["round_idx"] == 7
+
+    def test_ledger_group_commit_batches_fsyncs(self, tmp_path):
+        ckp = ServerControlCheckpointer(str(tmp_path),
+                                        group_commit_lines=4,
+                                        group_commit_ms=0.0)
+        for r in range(3):
+            ckp.append_ledger({"round": r, "cohort": [], "reported": []})
+        assert ckp.ledger_fsync_count == 0
+        # every line is already readable (write+flush per line)
+        assert [r["round"] for r in ckp.read_ledger()] == [0, 1, 2]
+        ckp.append_ledger({"round": 3, "cohort": [], "reported": []})
+        assert ckp.ledger_fsync_count == 1  # batch of 4 committed
+        ckp.sync_ledger()
+        assert ckp.ledger_fsync_count == 1  # nothing pending: no-op
+        ckp.append_ledger({"round": 4, "cohort": [], "reported": []})
+        ckp.close()  # flush-on-close commits the tail
+        assert ckp.ledger_fsync_count == 2
+
+    def test_writer_syncs_ledger_before_publish(self, tmp_path):
+        """The one new invariant async checkpointing needs: snapshot
+        durability never outruns ledger durability."""
+        from fedml_tpu.control import AsyncCheckpointWriter
+        inner = ServerControlCheckpointer(str(tmp_path),
+                                          group_commit_lines=100,
+                                          group_commit_ms=0.0)
+        w = AsyncCheckpointWriter(inner)
+        w.append_ledger({"round": 0, "cohort": [], "reported": []})
+        assert inner.ledger_fsync_count == 0  # far from the batch size
+        w.save(self._state(0))
+        assert w.flush()
+        assert inner.ledger_fsync_count >= 1  # pre-publish barrier
+        w.close()
+
+    def test_legacy_default_is_fsync_per_line(self, tmp_path):
+        ckp = ServerControlCheckpointer(str(tmp_path))
+        for r in range(3):
+            ckp.append_ledger({"round": r, "cohort": [], "reported": []})
+        assert ckp.ledger_fsync_count == 3
+        ckp.close()
+
+
+# ---------------------------------------------------------------------------
 def _run_federation(ds, tcfg, **kw):
     timer = RoundTimer()
     model, history = run_fedavg_cross_silo(

@@ -298,6 +298,62 @@ class TestLockHoldDataflow:
         assert len(findings) == 1
         assert "_pull_locked" in findings[0].message
 
+    def test_fsync_under_plain_lock_fires(self, tmp_path):
+        # the round/receive-thread durability hazard: a disk barrier is
+        # a blocking device wait, and every peer of the shared lock
+        # (heartbeats, counters, close) stalls behind it
+        src = """
+            import os
+            import threading
+
+
+            class Ledger:
+                def __init__(self, path):
+                    self._lock = threading.Lock()
+                    self._fh = open(path, "a")
+
+                def append(self, line):
+                    with self._lock:
+                        self._fh.write(line)
+                        os.fsync(self._fh.fileno())
+
+                def close(self):
+                    self._fh.close()
+        """
+        findings = _lint(tmp_path, src)
+        assert any(f.rule == "FT022" and "fsync" in f.message
+                   for f in findings)
+
+    def test_fsync_under_writer_lock_is_exempt(self, tmp_path):
+        # the writer-thread pattern: a lock named for the dedicated
+        # writer exists to serialize exactly this I/O (same standing as
+        # device gates and send locks in the exemption table)
+        src = """
+            import os
+            import threading
+
+
+            class Ledger:
+                def __init__(self, path):
+                    self._writer_lock = threading.Lock()
+                    self._ledger_wlock = threading.Lock()
+                    self._fh = open(path, "a")
+
+                def append(self, line):
+                    with self._ledger_wlock:
+                        self._fh.write(line)
+                        os.fsync(self._fh.fileno())
+
+                def barrier(self):
+                    with self._writer_lock:
+                        os.fsync(self._fh.fileno())
+
+                def close(self):
+                    self._fh.close()
+        """
+        assert [f for f in _lint(tmp_path, src)
+                if f.rule == "FT022"] == []
+
     def test_unbounded_join_under_lock_fires(self, tmp_path):
         src = """
             import threading
